@@ -1,0 +1,10 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh so multi-chip
+sharding paths run in CI without TPU hardware (SURVEY §2.7's mocktikv trick,
+TPU edition).  Must run before jax is imported anywhere."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

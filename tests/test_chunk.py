@@ -1,0 +1,84 @@
+"""Chunk/Column behavior (reference: util/chunk/chunk_test.go) and wire codec
+round-trip (reference: util/chunk/codec_test.go)."""
+import numpy as np
+
+from tinysql_tpu.mytypes import new_int_type, new_real_type, new_string_type
+from tinysql_tpu.chunk import (
+    Chunk, Column, chunk_from_rows, encode_chunk, decode_chunk,
+)
+
+FIELDS = [new_int_type(), new_real_type(), new_string_type()]
+
+
+def make_chunk():
+    rows = [
+        [1, 1.5, "a"],
+        [None, 2.5, "bb"],
+        [3, None, "ccc"],
+        [4, 4.5, None],
+        [5, 5.5, "eeeee"],
+    ]
+    return chunk_from_rows(FIELDS, rows), rows
+
+
+def test_append_get():
+    chk, rows = make_chunk()
+    assert chk.num_rows() == 5
+    assert chk.to_rows() == rows
+    assert chk.columns[0].is_null(1)
+    assert chk.columns[0].get(0) == 1
+    assert isinstance(chk.columns[1].get(0), float)
+
+
+def test_sel_vector():
+    chk, rows = make_chunk()
+    chk.set_sel(np.array([0, 2, 4]))
+    assert chk.num_rows() == 3
+    assert chk.get_row(1) == rows[2]
+    out = chk.compact()
+    assert out.sel is None
+    assert out.to_rows() == [rows[0], rows[2], rows[4]]
+
+
+def test_take_slice_extend():
+    chk, rows = make_chunk()
+    col = chk.columns[0]
+    t = col.take(np.array([4, 0]))
+    assert t.datums() == [5, 1]
+    s = col.slice(1, 3)
+    assert s.datums() == [None, 3]
+    c2 = Column(new_int_type())
+    c2.extend(col)
+    c2.extend(col)
+    assert len(c2) == 10
+
+
+def test_append_chunk_row():
+    chk, rows = make_chunk()
+    dst = Chunk(FIELDS)
+    dst.append_chunk_row(chk, 3)
+    assert dst.to_rows() == [rows[3]]
+    chk.set_sel(np.array([2]))
+    dst.append_chunk_row(chk, 0)
+    assert dst.to_rows() == [rows[3], rows[2]]
+
+
+def test_wire_codec_roundtrip():
+    chk, rows = make_chunk()
+    buf = encode_chunk(chk)
+    back = decode_chunk(buf, FIELDS)
+    assert back.to_rows() == rows
+
+
+def test_wire_codec_with_sel():
+    chk, rows = make_chunk()
+    chk.set_sel(np.array([1, 3]))
+    back = decode_chunk(encode_chunk(chk), FIELDS)
+    assert back.to_rows() == [rows[1], rows[3]]
+
+
+def test_unsigned_column():
+    ft = new_int_type(unsigned=True)
+    c = Column(ft)
+    c.append((1 << 64) - 1)
+    assert c.get(0) == (1 << 64) - 1

@@ -1,0 +1,115 @@
+"""Memcomparable codec ordering properties (reference: util/codec/codec_test.go)
+and table/row codecs (reference: tablecodec/tablecodec_test.go — graded
+TestRecordKey/TestDecodeIndexKey; util/rowcodec tests)."""
+import random
+
+import pytest
+
+from tinysql_tpu.codec import keycodec, tablecodec, rowcodec
+from tinysql_tpu.mytypes import new_int_type, new_real_type, new_string_type, sort_key
+
+
+def enc1(v, unsigned=False):
+    out = bytearray()
+    keycodec.encode_datum(out, v, unsigned)
+    return bytes(out)
+
+
+def test_int_order_preserved():
+    vals = [-(1 << 63), -100000, -1, 0, 1, 7, 255, 1 << 40, (1 << 63) - 1]
+    encs = [enc1(v) for v in vals]
+    assert encs == sorted(encs)
+    for v in vals:
+        d, _ = keycodec.decode_one(enc1(v), 0)
+        assert d == v
+
+
+def test_float_order_preserved():
+    vals = [float("-inf"), -1e300, -3.5, -0.0, 0.0, 1e-10, 2.5, 1e300, float("inf")]
+    encs = [enc1(v) for v in vals]
+    assert encs == sorted(encs)
+    for v in vals:
+        d, _ = keycodec.decode_one(enc1(v), 0)
+        assert d == v
+
+
+def test_bytes_order_preserved():
+    random.seed(7)
+    vals = [b"", b"\x00", b"\x00\x00", b"a", b"ab", b"abcdefgh", b"abcdefghi",
+            b"abcdefgh\x00", b"b"] + [
+        bytes(random.randrange(256) for _ in range(random.randrange(0, 20)))
+        for _ in range(200)
+    ]
+    pairs = sorted((enc1(v), v) for v in set(vals))
+    assert [p[1] for p in pairs] == sorted(set(vals))
+    for v in vals:
+        d, pos = keycodec.decode_one(enc1(v), 0)
+        d = d.encode() if isinstance(d, str) else d
+        assert d == v
+        assert pos == len(enc1(v))
+
+
+def test_null_sorts_first_and_mixed_key():
+    assert enc1(None) < enc1(-(1 << 63))
+    key = keycodec.encode_key([None, 42, 1.5, "hi"])
+    assert keycodec.decode_key(key) == [None, 42, 1.5, "hi"]
+
+
+def test_unsigned_encoding():
+    big = (1 << 64) - 1
+    assert keycodec.decode_one(enc1(big, unsigned=True), 0)[0] == big
+    encs = [enc1(v, unsigned=True) for v in [0, 1, 1 << 63, big]]
+    assert encs == sorted(encs)
+
+
+def test_record_key_roundtrip():
+    key = tablecodec.encode_row_key(55, 7)
+    assert tablecodec.is_record_key(key)
+    assert tablecodec.decode_record_key(key) == (55, 7)
+    assert tablecodec.decode_table_id(key) == 55
+    # ordering: same table, increasing handle
+    assert tablecodec.encode_row_key(55, 7) < tablecodec.encode_row_key(55, 8)
+    assert tablecodec.encode_row_key(55, -1) < tablecodec.encode_row_key(55, 0)
+    with pytest.raises(ValueError):
+        tablecodec.decode_record_key(b"bogus")
+
+
+def test_record_range_contains_all_handles():
+    lo, hi = tablecodec.record_range(9)
+    for h in (-(1 << 63), -1, 0, (1 << 63) - 1):
+        assert lo <= tablecodec.encode_row_key(9, h) < hi
+
+
+def test_index_key_roundtrip():
+    key = tablecodec.encode_index_key(55, 2, [10, "x"], handle=99)
+    assert tablecodec.is_index_key(key)
+    tid, iid, vals = tablecodec.decode_index_key(key)
+    assert (tid, iid) == (55, 2)
+    assert vals == [10, "x", 99]  # trailing handle decodes as final int
+
+
+def test_rowcodec_roundtrip():
+    row = {1: 42, 2: None, 3: 2.5, 4: "hello", 7: -1}
+    buf = rowcodec.encode_row(row)
+    assert rowcodec.decode_row(buf) == row
+    fts = [new_int_type(), new_real_type(), new_string_type(), new_int_type()]
+    vals = rowcodec.decode_row_to_datums(buf, [1, 3, 4, 9], fts)
+    assert vals == [42, 2.5, "hello", None]
+
+
+def test_negative_zero_same_key():
+    assert enc1(0.0) == enc1(-0.0)
+
+
+def test_decode_bytes_malformed():
+    with pytest.raises(ValueError):
+        keycodec.decode_one(enc1(b"abcdefgh")[:-2], 0)   # truncated
+    bad = bytearray(enc1(b"abc"))
+    bad[-1] = 0x10  # corrupt marker
+    with pytest.raises(ValueError):
+        keycodec.decode_one(bytes(bad), 0)
+
+
+def test_rowcodec_wraps_like_column():
+    buf = rowcodec.encode_row({1: 2 ** 64 - 1})
+    assert rowcodec.decode_row(buf) == {1: -1}

@@ -1,0 +1,76 @@
+"""Datum conversion/comparison semantics (reference: types/*_test.go)."""
+import pytest
+
+from tinysql_tpu.mytypes import (
+    datum_compare, to_int, to_real, to_string, to_bool, cast_datum,
+    new_int_type, new_real_type, new_string_type, agg_field_type, EvalType,
+)
+
+
+def test_to_int():
+    assert to_int(None) is None
+    assert to_int(5) == 5
+    assert to_int(3.5) == 4
+    assert to_int(-3.5) == -4
+    assert to_int(2.4) == 2
+    assert to_int("42abc") == 42
+    assert to_int("  -17.6 ") == -18
+    assert to_int("abc") == 0
+    assert to_int("1e3") == 1000
+
+
+def test_to_real_and_string():
+    assert to_real("3.25xyz") == 3.25
+    assert to_real(None) is None
+    assert to_string(3.0) == "3"
+    assert to_string(3.5) == "3.5"
+    assert to_string(None) is None
+
+
+def test_bool_semantics():
+    assert to_bool("0.0") == 0
+    assert to_bool("1abc") == 1
+    assert to_bool("") == 0
+    assert to_bool(None) is None
+    assert to_bool(0.5) == 1
+
+
+def test_compare():
+    assert datum_compare(1, 2) == -1
+    assert datum_compare(2.0, 2) == 0
+    assert datum_compare("b", "a") == 1
+    assert datum_compare("10", 9) == 1       # numeric compare when one side numeric
+    assert datum_compare("abc", 0) == 0      # 'abc' -> 0.0
+    assert datum_compare(None, 1) is None
+    assert datum_compare(1, None) is None
+
+
+def test_cast_datum():
+    assert cast_datum("12", new_int_type()) == 12
+    assert cast_datum(7, new_real_type()) == 7.0
+    assert cast_datum(1.5, new_string_type()) == "1.5"
+    with pytest.raises(ValueError):
+        cast_datum("toolongg", new_string_type(flen=4))
+    with pytest.raises(ValueError):
+        cast_datum(-1, new_int_type(unsigned=True))
+
+
+def test_agg_field_type():
+    assert agg_field_type([new_int_type(), new_real_type()]).eval_type is EvalType.REAL
+    assert agg_field_type([new_int_type(), new_string_type()]).eval_type is EvalType.STRING
+    assert agg_field_type([new_int_type(unsigned=True)]).is_unsigned
+
+
+def test_big_int_strings_exact():
+    # integer-shaped strings must not lose precision through float
+    assert to_int("9007199254740993") == 9007199254740993
+    assert to_int("9223372036854775807") == 9223372036854775807
+
+
+def test_unsigned_cast_full_range():
+    from tinysql_tpu.mytypes import to_uint
+    u = new_int_type(unsigned=True)
+    assert cast_datum(2 ** 63, u) == 2 ** 63
+    assert cast_datum("18446744073709551615", u) == 2 ** 64 - 1
+    with pytest.raises(ValueError):
+        to_uint(2 ** 64)

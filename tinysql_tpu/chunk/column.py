@@ -1,0 +1,159 @@
+"""Columnar vector: fixed-width numpy buffer + null bitmap.
+
+Capability parity with reference util/chunk/column.go:28 (data buffer +
+null bitmap + elem buf), redesigned TPU-first: the numeric families are
+contiguous numpy int64/float64 arrays that marshal zero-copy-ish to
+`jax.Array`; the null bitmap is a boolean mask (True = NULL) that becomes the
+device-side validity mask.  Strings stay host-side (object array) — the
+planner's device enforcer (planner/core/task.py) keeps them off TPU, mirroring
+the north-star numeric-only gate.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..mytypes import EvalType, FieldType, Datum
+
+_INIT_CAP = 32
+
+
+def _np_dtype(et: EvalType):
+    if et is EvalType.INT:
+        return np.int64
+    if et is EvalType.REAL:
+        return np.float64
+    return object
+
+
+class Column:
+    """A growable typed vector with a null mask."""
+
+    __slots__ = ("ft", "_data", "_null", "_len")
+
+    def __init__(self, ft: FieldType, cap: int = _INIT_CAP):
+        self.ft = ft
+        dt = _np_dtype(ft.eval_type)
+        self._data = np.zeros(max(cap, 1), dtype=dt)
+        self._null = np.zeros(max(cap, 1), dtype=bool)
+        self._len = 0
+
+    # ---- constructors -------------------------------------------------
+    @classmethod
+    def from_numpy(cls, ft: FieldType, data: np.ndarray,
+                   null: Optional[np.ndarray] = None) -> "Column":
+        c = cls(ft, cap=1)
+        n = len(data)
+        dt = _np_dtype(ft.eval_type)
+        c._data = np.ascontiguousarray(data, dtype=dt)
+        c._null = (np.zeros(n, dtype=bool) if null is None
+                   else np.asarray(null, dtype=bool).copy())
+        c._len = n
+        return c
+
+    @classmethod
+    def from_datums(cls, ft: FieldType, values: Iterable[Datum]) -> "Column":
+        c = cls(ft)
+        for v in values:
+            c.append(v)
+        return c
+
+    # ---- size ---------------------------------------------------------
+    def __len__(self) -> int:
+        return self._len
+
+    def _grow(self, need: int) -> None:
+        cap = len(self._data)
+        if self._len + need <= cap:
+            return
+        new_cap = max(cap * 2, self._len + need)
+        self._data = np.resize(self._data, new_cap)
+        self._null = np.resize(self._null, new_cap)
+
+    # ---- append -------------------------------------------------------
+    def append(self, v: Datum) -> None:
+        self._grow(1)
+        i = self._len
+        if v is None:
+            self._null[i] = True
+            self._data[i] = 0 if self.ft.eval_type is not EvalType.STRING else ""
+        else:
+            self._null[i] = False
+            if isinstance(v, int) and not (-(1 << 63) <= v < (1 << 63)):
+                # unsigned values live in the int64 buffer two's-complement
+                # wrapped (reference: column.go stores uint64 in the same buf)
+                v = (v & ((1 << 64) - 1)) - (1 << 64) if v & (1 << 63) else v & ((1 << 64) - 1)
+            self._data[i] = v
+        self._len = i + 1
+
+    def append_null(self) -> None:
+        self.append(None)
+
+    def extend(self, other: "Column", start: int = 0,
+               end: Optional[int] = None) -> None:
+        end = other._len if end is None else end
+        n = end - start
+        if n <= 0:
+            return
+        self._grow(n)
+        self._data[self._len:self._len + n] = other._data[start:end]
+        self._null[self._len:self._len + n] = other._null[start:end]
+        self._len += n
+
+    def extend_take(self, other: "Column", idx: np.ndarray) -> None:
+        n = len(idx)
+        if n == 0:
+            return
+        self._grow(n)
+        self._data[self._len:self._len + n] = other._data[:other._len][idx]
+        self._null[self._len:self._len + n] = other._null[:other._len][idx]
+        self._len += n
+
+    # ---- access -------------------------------------------------------
+    def get(self, i: int) -> Datum:
+        if self._null[i]:
+            return None
+        v = self._data[i]
+        et = self.ft.eval_type
+        if et is EvalType.INT:
+            iv = int(v)
+            if self.ft.is_unsigned and iv < 0:
+                iv += 1 << 64
+            return iv
+        if et is EvalType.REAL:
+            return float(v)
+        return v  # str
+
+    def is_null(self, i: int) -> bool:
+        return bool(self._null[i])
+
+    def values(self) -> np.ndarray:
+        """Raw buffer view, length-trimmed (reference: column.go Int64s())."""
+        return self._data[:self._len]
+
+    def null_mask(self) -> np.ndarray:
+        return self._null[:self._len]
+
+    def datums(self) -> List[Datum]:
+        return [self.get(i) for i in range(self._len)]
+
+    # ---- transforms ---------------------------------------------------
+    def take(self, idx: np.ndarray) -> "Column":
+        c = Column(self.ft, cap=max(len(idx), 1))
+        c.extend_take(self, np.asarray(idx, dtype=np.int64))
+        return c
+
+    def slice(self, start: int, end: int) -> "Column":
+        c = Column(self.ft, cap=max(end - start, 1))
+        c.extend(self, start, end)
+        return c
+
+    def copy(self) -> "Column":
+        return self.slice(0, self._len)
+
+    def truncate(self, n: int) -> None:
+        self._len = min(self._len, n)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Column({self.ft.type_name()}, {self.datums()[:8]}{'...' if self._len > 8 else ''})"
