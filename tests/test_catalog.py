@@ -1,0 +1,192 @@
+"""Catalog meta + table abstraction (reference: meta/meta_test.go,
+table/tables/tables_test.go)."""
+import pytest
+
+from tinysql_tpu.catalog import (
+    Allocator, ColumnInfo, DBInfo, DuplicateKeyError, IndexColumn, IndexInfo,
+    Meta, SchemaState, Table, TableInfo,
+)
+from tinysql_tpu.kv import KeyNotFound, new_mock_storage
+from tinysql_tpu.mytypes import (FLAG_PRI_KEY, new_int_type, new_real_type,
+                                 new_string_type)
+
+
+def make_table_info(tid=101, pk_handle=True):
+    pk_ft = new_int_type()
+    if pk_handle:
+        pk_ft.flag |= FLAG_PRI_KEY
+    return TableInfo(
+        id=tid, name="t",
+        columns=[
+            ColumnInfo(1, "a", 0, pk_ft),
+            ColumnInfo(2, "b", 1, new_real_type()),
+            ColumnInfo(3, "c", 2, new_string_type()),
+        ],
+        indices=[
+            IndexInfo(1, "idx_c", [IndexColumn("c", 2)], unique=False),
+            IndexInfo(2, "uniq_b", [IndexColumn("b", 1)], unique=True),
+        ],
+        pk_is_handle=pk_handle, max_column_id=3, max_index_id=2)
+
+
+def test_meta_crud_and_counters():
+    s = new_mock_storage()
+    txn = s.begin()
+    m = Meta(txn)
+    assert m.gen_global_id() == 1
+    assert m.gen_global_id() == 2
+    db = DBInfo(m.gen_global_id(), "test")
+    m.create_database(db)
+    ti = make_table_info(m.gen_global_id())
+    m.create_table(db.id, ti)
+    assert m.bump_schema_version() == 1
+    txn.commit()
+
+    txn2 = s.begin()
+    m2 = Meta(txn2)
+    assert [d.name for d in m2.list_databases()] == ["test"]
+    got = m2.get_table(db.id, ti.id)
+    assert got.name == "t"
+    assert [c.name for c in got.columns] == ["a", "b", "c"]
+    assert got.indices[1].unique
+    assert m2.schema_version() == 1
+    assert m2.gen_global_id() == 5
+
+
+def test_table_add_get_remove_record():
+    s = new_mock_storage()
+    tbl = Table(make_table_info(), Allocator(s, 101))
+    txn = s.begin()
+    h1 = tbl.add_record(txn, [1, 2.5, "x"])
+    h2 = tbl.add_record(txn, [2, 3.5, "y"])
+    assert (h1, h2) == (1, 2)  # pk-as-handle
+    txn.commit()
+
+    txn = s.begin()
+    assert tbl.row(txn, 1) == [1, 2.5, "x"]
+    rows = list(tbl.iter_records(txn))
+    assert [r for _, r in rows] == [[1, 2.5, "x"], [2, 3.5, "y"]]
+    tbl.remove_record(txn, 1, [1, 2.5, "x"])
+    txn.commit()
+
+    txn = s.begin()
+    with pytest.raises(KeyNotFound):
+        tbl.row(txn, 1)
+
+
+def test_pk_handle_duplicate():
+    s = new_mock_storage()
+    tbl = Table(make_table_info(), Allocator(s, 101))
+    txn = s.begin()
+    tbl.add_record(txn, [7, 1.0, "a"])
+    txn.commit()
+    txn = s.begin()
+    tbl.add_record(txn, [7, 2.0, "b"])
+    with pytest.raises(DuplicateKeyError):
+        txn.commit()  # record-key uniqueness enforced at prewrite
+
+
+def test_unique_index_duplicate():
+    s = new_mock_storage()
+    tbl = Table(make_table_info(), Allocator(s, 101))
+    txn = s.begin()
+    tbl.add_record(txn, [1, 5.0, "a"])
+    txn.commit()
+    txn = s.begin()
+    with pytest.raises(DuplicateKeyError) as ei:
+        tbl.add_record(txn, [2, 5.0, "b"])
+        txn.commit()
+    assert "uniq_b" in str(ei.value)
+    # NULL never conflicts in a unique index
+    txn = s.begin()
+    tbl.add_record(txn, [3, None, "c"])
+    tbl.add_record(txn, [4, None, "d"])
+    txn.commit()
+
+
+def test_index_lookup_via_kv():
+    from tinysql_tpu.codec import tablecodec
+    s = new_mock_storage()
+    tbl = Table(make_table_info(), Allocator(s, 101))
+    txn = s.begin()
+    tbl.add_record(txn, [1, 1.0, "hello"])
+    tbl.add_record(txn, [2, 2.0, "hello"])
+    tbl.add_record(txn, [3, 3.0, "world"])
+    txn.commit()
+    # scan the non-unique index 'idx_c' for c='hello' -> handles 1,2
+    txn = s.begin()
+    prefix = tablecodec.encode_index_key(101, 1, ["hello"])
+    handles = []
+    for k, _ in txn.iter_range(prefix, prefix + b"\xff"):
+        _, _, vals = tablecodec.decode_index_key(k)
+        handles.append(vals[-1])
+    assert handles == [1, 2]
+
+
+def test_autoid_without_pk_handle():
+    s = new_mock_storage()
+    info = make_table_info(pk_handle=False)
+    tbl = Table(info, Allocator(s, info.id, step=2))
+    txn = s.begin()
+    hs = [tbl.add_record(txn, [10, 1.0, "a"]),
+          tbl.add_record(txn, [20, 2.0, "b"]),
+          tbl.add_record(txn, [30, 3.0, "c"])]
+    txn.commit()
+    assert hs == [1, 2, 3]
+    txn = s.begin()
+    assert [r[0] for _, r in tbl.iter_records(txn)] == [10, 20, 30]
+
+
+def test_allocator_rebase():
+    s = new_mock_storage()
+    a = Allocator(s, 55, step=10)
+    assert a.alloc() == 1
+    a.rebase(100)
+    assert a.alloc() == 101
+
+
+def test_schema_state_gating():
+    """WRITE_ONLY index is maintained on writes; DELETE_ONLY only on
+    deletes (F1 rules, reference: tables.go + model.go:32-44)."""
+    from tinysql_tpu.codec import tablecodec
+    s = new_mock_storage()
+    info = make_table_info()
+    info.indices[0].state = SchemaState.DELETE_ONLY
+    tbl = Table(info, Allocator(s, 101))
+    txn = s.begin()
+    tbl.add_record(txn, [1, 1.0, "x"])
+    txn.commit()
+    txn = s.begin()
+    prefix = tablecodec.encode_index_prefix(101, 1)
+    assert list(txn.iter_range(prefix, prefix + b"\xff")) == []  # not written
+    tbl.remove_record(txn, 1, [1, 1.0, "x"])  # delete still maintains it
+    txn.commit()
+
+
+def test_update_record_roundtrip():
+    """Regression: in-place update (remove+add with same handle) must not
+    trip the PRIMARY duplicate check."""
+    s = new_mock_storage()
+    tbl = Table(make_table_info(), Allocator(s, 101))
+    txn = s.begin()
+    tbl.add_record(txn, [5, 1.0, "a"])
+    txn.commit()
+    txn = s.begin()
+    tbl.update_record(txn, 5, [5, 1.0, "a"], [5, 9.0, "z"])
+    txn.commit()
+    txn = s.begin()
+    assert tbl.row(txn, 5) == [5, 9.0, "z"]
+
+
+def test_add_record_with_nonwritable_column():
+    """Regression: offsets stay valid when a preceding column is mid-DROP."""
+    s = new_mock_storage()
+    info = make_table_info(pk_handle=False)
+    info.columns[0].state = SchemaState.DELETE_ONLY  # dropping column 'a'
+    tbl = Table(info, Allocator(s, info.id))
+    txn = s.begin()
+    h = tbl.add_record(txn, [None, 42.0, "keep"])
+    txn.commit()
+    txn = s.begin()
+    vals = tbl.row(txn, h, cols=[c for c in info.columns if c.name != "a"])
+    assert vals == [42.0, "keep"]

@@ -113,7 +113,7 @@ def encode_datum(out: bytearray, v: Datum, unsigned: bool = False) -> None:
     elif isinstance(v, float):
         encode_float(out, v)
     elif isinstance(v, str):
-        encode_bytes(out, v.encode("utf-8"))
+        encode_bytes(out, v.encode("utf-8", "surrogateescape"))
     elif isinstance(v, bytes):
         encode_bytes(out, v)
     else:
@@ -154,10 +154,9 @@ def decode_one(buf: bytes, pos: int) -> Tuple[Datum, int]:
         return f, pos + 8
     if flag == BYTES_FLAG:
         b, pos = decode_bytes(buf, pos)
-        try:
-            return b.decode("utf-8"), pos
-        except UnicodeDecodeError:
-            return b, pos
+        # deterministic type: BYTES always decodes to str; surrogateescape
+        # makes arbitrary binary round-trip losslessly through the str form
+        return b.decode("utf-8", "surrogateescape"), pos
     raise ValueError(f"bad codec flag {flag:#x} at {pos - 1}")
 
 

@@ -1,0 +1,235 @@
+"""In-process Percolator MVCC store — the storage-node keystone.
+
+Capability parity with reference store/mockstore/mocktikv/mvcc_leveldb.go
+(lock/write/data column layout, prewrite/commit/rollback/scan/resolve-lock,
+1,547 LoC) — the fake backend every integration test rides (SURVEY §2.7).
+One instance holds the whole keyspace; the Cluster/RPC layers shard access
+by region on top of it.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from .errors import (KeyExists, KeyIsLocked, KeyNotFound, TxnAborted,
+                     WriteConflict)
+
+# write-record types (reference: mvcc.go WriteType)
+W_PUT, W_DELETE, W_ROLLBACK = 0, 1, 2
+
+OP_PUT, OP_DEL, OP_INSERT = 0, 1, 2  # mutation ops (kvrpcpb.Op subset)
+
+
+@dataclass
+class Lock:
+    primary: bytes
+    start_ts: int
+    ttl_ms: int
+    op: int
+    value: bytes
+
+
+@dataclass
+class _Entry:
+    lock: Optional[Lock] = None
+    # (commit_ts desc, write_type, start_ts) — newest first
+    writes: List[Tuple[int, int, int]] = field(default_factory=list)
+    data: Dict[int, bytes] = field(default_factory=dict)  # start_ts -> value
+
+
+@dataclass
+class Mutation:
+    op: int
+    key: bytes
+    value: bytes = b""
+
+
+class MVCCStore:
+    def __init__(self):
+        self._entries: Dict[bytes, _Entry] = {}
+        self._sorted: List[bytes] = []
+        self._dirty = False
+        self._mu = threading.RLock()
+
+    # ---- helpers ------------------------------------------------------
+    def _entry(self, key: bytes) -> _Entry:
+        e = self._entries.get(key)
+        if e is None:
+            e = self._entries[key] = _Entry()
+            self._dirty = True
+        return e
+
+    def _keys(self) -> List[bytes]:
+        if self._dirty:
+            self._sorted = sorted(self._entries)
+            self._dirty = False
+        return self._sorted
+
+    @staticmethod
+    def _find_write(e: _Entry, ts: int) -> Optional[Tuple[int, int, int]]:
+        """Newest committed write with commit_ts <= ts, skipping rollbacks."""
+        for w in e.writes:
+            if w[0] <= ts and w[1] != W_ROLLBACK:
+                return w
+        return None
+
+    # ---- reads --------------------------------------------------------
+    def get(self, key: bytes, ts: int, resolved: Tuple[int, ...] = ()) -> bytes:
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None:
+                raise KeyNotFound(key)
+            lk = e.lock
+            if lk is not None and lk.start_ts <= ts and lk.start_ts not in resolved:
+                raise KeyIsLocked(key, lk.primary, lk.start_ts, lk.ttl_ms)
+            w = self._find_write(e, ts)
+            if w is None or w[1] == W_DELETE:
+                raise KeyNotFound(key)
+            return e.data[w[2]]
+
+    def scan(self, start: Optional[bytes], end: Optional[bytes], ts: int,
+             limit: int = 0, resolved: Tuple[int, ...] = ()) -> List[Tuple[bytes, bytes]]:
+        out: List[Tuple[bytes, bytes]] = []
+        with self._mu:
+            ks = self._keys()
+            i = bisect.bisect_left(ks, start) if start is not None else 0
+            while i < len(ks):
+                k = ks[i]
+                if end is not None and k >= end:
+                    break
+                e = self._entries[k]
+                lk = e.lock
+                if lk is not None and lk.start_ts <= ts and lk.start_ts not in resolved:
+                    raise KeyIsLocked(k, lk.primary, lk.start_ts, lk.ttl_ms)
+                w = self._find_write(e, ts)
+                if w is not None and w[1] == W_PUT:
+                    out.append((k, e.data[w[2]]))
+                    if limit and len(out) >= limit:
+                        break
+                i += 1
+        return out
+
+    # ---- percolator write protocol ------------------------------------
+    def prewrite(self, mutations: List[Mutation], primary: bytes,
+                 start_ts: int, ttl_ms: int) -> None:
+        """All-or-nothing prewrite of a batch (reference:
+        mvcc_leveldb.go Prewrite)."""
+        with self._mu:
+            errs = []
+            for m in mutations:
+                try:
+                    self._prewrite_one(m, primary, start_ts, ttl_ms)
+                except (KeyIsLocked, WriteConflict, KeyExists) as ex:
+                    errs.append(ex)
+            if errs:
+                raise errs[0]
+
+    def _prewrite_one(self, m: Mutation, primary: bytes, start_ts: int,
+                      ttl_ms: int) -> None:
+        e = self._entry(m.key)
+        if e.lock is not None:
+            if e.lock.start_ts != start_ts:
+                raise KeyIsLocked(m.key, e.lock.primary, e.lock.start_ts, e.lock.ttl_ms)
+            return  # idempotent re-prewrite
+        if e.writes:
+            newest = e.writes[0]
+            if newest[0] >= start_ts:
+                raise WriteConflict(m.key, start_ts, newest[0])
+            # our own rollback record aborts the txn
+            for w in e.writes:
+                if w[2] == start_ts and w[1] == W_ROLLBACK:
+                    raise WriteConflict(m.key, start_ts, w[0])
+        if m.op == OP_INSERT:
+            w = self._find_write(e, start_ts)
+            if w is not None and w[1] == W_PUT:
+                raise KeyExists(m.key)
+        e.lock = Lock(primary, start_ts, ttl_ms, m.op, m.value)
+
+    def commit(self, keys: List[bytes], start_ts: int, commit_ts: int) -> None:
+        with self._mu:
+            for k in keys:
+                self._commit_one(k, start_ts, commit_ts)
+
+    def _commit_one(self, key: bytes, start_ts: int, commit_ts: int) -> None:
+        e = self._entries.get(key)
+        if e is None:
+            raise TxnAborted(f"commit of unknown key {key!r}")
+        lk = e.lock
+        if lk is not None and lk.start_ts == start_ts:
+            wtype = W_DELETE if lk.op == OP_DEL else W_PUT
+            if wtype == W_PUT:
+                e.data[start_ts] = lk.value
+            e.writes.append((commit_ts, wtype, start_ts))
+            e.writes.sort(key=lambda w: -w[0])  # keep newest-first invariant
+            e.lock = None
+            return
+        # lock gone: committed already (idempotent) or rolled back (abort)
+        for w in e.writes:
+            if w[2] == start_ts:
+                if w[1] == W_ROLLBACK:
+                    raise TxnAborted(f"txn {start_ts} already rolled back")
+                return
+        raise TxnAborted(f"txn {start_ts} has no lock and no write on {key!r}")
+
+    def rollback(self, keys: List[bytes], start_ts: int) -> None:
+        with self._mu:
+            for k in keys:
+                e = self._entry(k)
+                if e.lock is not None and e.lock.start_ts == start_ts:
+                    e.lock = None
+                for w in e.writes:
+                    if w[2] == start_ts:
+                        if w[1] != W_ROLLBACK:
+                            raise TxnAborted(
+                                f"cannot roll back committed txn {start_ts}")
+                        break
+                else:
+                    e.writes.append((start_ts, W_ROLLBACK, start_ts))
+                    e.writes.sort(key=lambda w: -w[0])
+
+    # ---- recovery (lock resolution) -----------------------------------
+    def check_txn_status(self, primary: bytes, lock_ts: int,
+                         expired: bool) -> Tuple[int, bool]:
+        """Return (commit_ts, is_committed); commit_ts==0 + False means the
+        txn was (or now is) rolled back (reference: lock_resolver.go
+        getTxnStatus).  `expired` tells whether the caller observed TTL
+        expiry — only then may we unilaterally roll back the primary."""
+        with self._mu:
+            e = self._entries.get(primary)
+            if e is not None:
+                for w in e.writes:
+                    if w[2] == lock_ts:
+                        if w[1] == W_ROLLBACK:
+                            return 0, False
+                        return w[0], True
+                lk = e.lock
+                if lk is not None and lk.start_ts == lock_ts:
+                    if not expired:
+                        raise KeyIsLocked(primary, lk.primary, lk.start_ts, lk.ttl_ms)
+                    self.rollback([primary], lock_ts)
+                    return 0, False
+            # no lock, no write: orphan prewrite never reached the primary —
+            # write a rollback record to fence it out
+            self.rollback([primary], lock_ts)
+            return 0, False
+
+    def resolve_lock(self, key: bytes, start_ts: int, commit_ts: int) -> None:
+        """Resolve one secondary per txn status (reference:
+        lock_resolver.go resolveLock)."""
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None or e.lock is None or e.lock.start_ts != start_ts:
+                return
+            if commit_ts > 0:
+                self._commit_one(key, start_ts, commit_ts)
+            else:
+                self.rollback([key], start_ts)
+
+    # ---- raw/debug ----------------------------------------------------
+    def locked_keys(self, start_ts: Optional[int] = None) -> List[bytes]:
+        with self._mu:
+            return [k for k, e in self._entries.items()
+                    if e.lock is not None and
+                    (start_ts is None or e.lock.start_ts == start_ts)]

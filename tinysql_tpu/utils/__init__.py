@@ -1,0 +1,4 @@
+"""Cross-cutting utilities (reference: util/*)."""
+from . import failpoint
+
+__all__ = ["failpoint"]

@@ -1,0 +1,70 @@
+"""Failpoint injection library (reference: pingcap/failpoint — 20 inject
+sites across the reference, SURVEY §5.3).
+
+Usage at an inject site:
+    failpoint.inject("commitFailed")          # raises if enabled w/ error
+    if failpoint.eval("rpcHang"):             # truthy value if enabled
+        ...
+Tests:
+    with failpoint.enable("commitFailed", exc=IOError("boom")): ...
+    failpoint.enable_times("x", exc=..., times=2)  # fire twice then off
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, Optional
+
+_mu = threading.Lock()
+_points: Dict[str, dict] = {}
+
+
+def enable_point(name: str, value: Any = True, exc: Optional[Exception] = None,
+                 times: int = -1) -> None:
+    with _mu:
+        _points[name] = {"value": value, "exc": exc, "times": times}
+
+
+def disable_point(name: str) -> None:
+    with _mu:
+        _points.pop(name, None)
+
+
+def disable_all() -> None:
+    with _mu:
+        _points.clear()
+
+
+@contextlib.contextmanager
+def enable(name: str, value: Any = True, exc: Optional[Exception] = None,
+           times: int = -1):
+    enable_point(name, value, exc, times)
+    try:
+        yield
+    finally:
+        disable_point(name)
+
+
+def _consume(name: str) -> Optional[dict]:
+    with _mu:
+        p = _points.get(name)
+        if p is None:
+            return None
+        if p["times"] == 0:
+            return None
+        if p["times"] > 0:
+            p["times"] -= 1
+        return p
+
+
+def eval(name: str) -> Any:  # noqa: A001 - mirrors failpoint.Eval
+    p = _consume(name)
+    if p is None:
+        return None
+    if p["exc"] is not None:
+        raise p["exc"]
+    return p["value"]
+
+
+def inject(name: str) -> None:
+    eval(name)
