@@ -1,0 +1,208 @@
+"""Expression semantics: vectorized-vs-scalar equivalence property tests
+(reference: expression/builtin_*_vec_test.go compare vec against scalar
+paths over random chunks; bench_test.go)."""
+import random
+
+import numpy as np
+import pytest
+
+from tinysql_tpu.chunk import Chunk, Column as CCol, chunk_from_rows
+from tinysql_tpu.expression import (Column, Constant, Schema, fold_constants,
+                                    new_function, split_cnf, compose_cnf,
+                                    vectorized_filter)
+from tinysql_tpu.mytypes import (new_int_type, new_real_type,
+                                 new_string_type)
+
+INT, REAL, STR = new_int_type(), new_real_type(), new_string_type()
+
+
+def make_random_chunk(n=200, seed=3):
+    rng = random.Random(seed)
+    rows = []
+    for _ in range(n):
+        rows.append([
+            rng.choice([None, rng.randint(-50, 50)]),
+            rng.choice([None, rng.uniform(-10, 10), 0.0]),
+            rng.choice([None, "", "a", "ab", "abc", "b%", "xyz"]),
+            rng.choice([rng.randint(-3, 3)]),
+        ])
+    return chunk_from_rows([INT, REAL, STR, INT], rows)
+
+
+def cols():
+    return (Column(INT, 0), Column(REAL, 1), Column(STR, 2), Column(INT, 3))
+
+
+def check_vec_matches_scalar(expr, chk):
+    """The graded property: vec path == row path, including nulls."""
+    v, null = expr.vec_eval(chk)
+    for i in range(chk.num_rows()):
+        row = chk.get_row(i)
+        want = expr.eval(row)
+        if want is None:
+            assert null[i], f"row {i}: want NULL got {v[i]}"
+        else:
+            assert not null[i], f"row {i}: want {want} got NULL"
+            got = v[i]
+            if isinstance(want, float):
+                assert got == pytest.approx(want, rel=1e-12), f"row {i}"
+            else:
+                assert got == want, f"row {i}: want {want!r} got {got!r}"
+
+
+@pytest.mark.parametrize("op", ["+", "-", "*", "/", "div", "%"])
+def test_arith_int_int(op):
+    a, b, _, d = cols()
+    check_vec_matches_scalar(new_function(op, [a, d]), make_random_chunk())
+
+
+@pytest.mark.parametrize("op", ["+", "-", "*", "/", "div", "%"])
+def test_arith_mixed(op):
+    a, b, _, _ = cols()
+    check_vec_matches_scalar(new_function(op, [a, b]), make_random_chunk())
+
+
+@pytest.mark.parametrize("op", ["=", "!=", "<", "<=", ">", ">=", "<=>"])
+def test_compare_ops(op):
+    a, b, c, d = cols()
+    chk = make_random_chunk()
+    check_vec_matches_scalar(new_function(op, [a, d]), chk)   # int-int
+    check_vec_matches_scalar(new_function(op, [a, b]), chk)   # int-real
+    check_vec_matches_scalar(new_function(op, [c, c]), chk)   # str-str
+    check_vec_matches_scalar(new_function(op, [c, a]), chk)   # str-int (real)
+
+
+@pytest.mark.parametrize("op", ["and", "or", "xor"])
+def test_logic_3vl(op):
+    a, _, _, d = cols()
+    e = new_function(op, [new_function(">", [a, Constant(0, INT)]),
+                          new_function("<", [d, Constant(0, INT)])])
+    check_vec_matches_scalar(e, make_random_chunk())
+    # NULL involvement
+    e2 = new_function(op, [Constant(None, INT), Constant(1, INT)])
+    e3 = new_function(op, [Constant(None, INT), Constant(0, INT)])
+    if op == "and":
+        assert e2.eval([]) is None and e3.eval([]) == 0
+    elif op == "or":
+        assert e2.eval([]) == 1 and e3.eval([]) is None
+    else:
+        assert e2.eval([]) is None and e3.eval([]) is None
+
+
+def test_not_isnull_istruth():
+    a, b, c, _ = cols()
+    chk = make_random_chunk()
+    check_vec_matches_scalar(new_function("not", [a]), chk)
+    check_vec_matches_scalar(new_function("isnull", [b]), chk)
+    check_vec_matches_scalar(new_function("istrue", [b]), chk)
+    check_vec_matches_scalar(new_function("isfalse", [a]), chk)
+
+
+def test_if_ifnull_case():
+    a, b, c, d = cols()
+    chk = make_random_chunk()
+    cond = new_function(">", [a, Constant(0, INT)])
+    check_vec_matches_scalar(new_function("if", [cond, a, d]), chk)
+    check_vec_matches_scalar(new_function("ifnull", [a, d]), chk)
+    # mixed-type result arms get casts
+    check_vec_matches_scalar(new_function("ifnull", [a, b]), chk)
+    case = new_function("case", [
+        new_function(">", [a, Constant(10, INT)]), Constant("big", STR),
+        new_function(">", [a, Constant(0, INT)]), Constant("small", STR),
+        Constant("neg", STR)])
+    check_vec_matches_scalar(case, chk)
+    # case without else -> NULL fallthrough
+    case2 = new_function("case", [
+        new_function(">", [a, Constant(1000, INT)]), Constant(1, INT)])
+    check_vec_matches_scalar(case2, chk)
+
+
+def test_like_and_in():
+    a, _, c, d = cols()
+    chk = make_random_chunk()
+    check_vec_matches_scalar(
+        new_function("like", [c, Constant("a%", STR)]), chk)
+    check_vec_matches_scalar(
+        new_function("like", [c, Constant("_b_", STR)]), chk)
+    check_vec_matches_scalar(
+        new_function("in", [a, Constant(1, INT), Constant(2, INT),
+                            Constant(None, INT)]), chk)
+    check_vec_matches_scalar(
+        new_function("in", [d, Constant(0, INT), Constant(3, INT)]), chk)
+
+
+def test_string_builtins():
+    _, _, c, _ = cols()
+    chk = make_random_chunk()
+    for fn in ["length", "char_length", "upper", "lower"]:
+        check_vec_matches_scalar(new_function(fn, [c]), chk)
+    check_vec_matches_scalar(new_function("strcmp", [c, Constant("ab", STR)]), chk)
+    check_vec_matches_scalar(new_function("concat", [c, Constant("-", STR), c]), chk)
+    assert new_function("substring", [Constant("hello", STR), Constant(2, INT)]).eval([]) == "ello"
+    assert new_function("substring", [Constant("hello", STR), Constant(-3, INT),
+                                      Constant(2, INT)]).eval([]) == "ll"
+    assert new_function("substring", [Constant("hello", STR), Constant(0, INT)]).eval([]) == ""
+
+
+def test_div_mod_by_zero_null():
+    for op in ["/", "div", "%"]:
+        e = new_function(op, [Constant(5, INT), Constant(0, INT)])
+        assert e.eval([]) is None
+    e = new_function("%", [Constant(-7, INT), Constant(2, INT)])
+    assert e.eval([]) == -1  # truncation toward zero, MySQL style
+    e = new_function("div", [Constant(-7, INT), Constant(2, INT)])
+    assert e.eval([]) == -3
+
+
+def test_int_overflow_wraps():
+    e = new_function("+", [Constant((1 << 63) - 1, INT), Constant(1, INT)])
+    assert e.eval([]) == -(1 << 63)
+    chk = chunk_from_rows([INT], [[(1 << 63) - 1]])
+    v, null = new_function("+", [Column(INT, 0), Constant(1, INT)]).vec_eval(chk)
+    assert v[0] == -(1 << 63) and not null[0]
+
+
+def test_fold_constants_and_cnf():
+    e = new_function("+", [Constant(1, INT),
+                           new_function("*", [Constant(2, INT), Constant(3, INT)])])
+    f = fold_constants(e)
+    assert isinstance(f, Constant) and f.value == 7
+    a, b, c, d = cols()
+    conj = compose_cnf([new_function(">", [a, Constant(0, INT)]),
+                        new_function("<", [d, Constant(3, INT)]),
+                        new_function("isnull", [b])])
+    assert len(split_cnf(conj)) == 3
+
+
+def test_vectorized_filter_with_sel():
+    a, b, c, d = cols()
+    chk = make_random_chunk()
+    mask = vectorized_filter([new_function(">", [a, Constant(0, INT)])], chk)
+    for i in range(chk.num_rows()):
+        row = chk.get_row(i)
+        want = row[0] is not None and row[0] > 0
+        assert mask[i] == want
+
+
+def test_schema_resolve_indices():
+    a = Column(INT, name="a")
+    b = Column(REAL, name="b")
+    schema = Schema([a, b])
+    e = new_function("+", [a, b])
+    r = e.resolve_indices(schema)
+    assert r.args[0].index == 0 and r.args[1].index == 1
+    chk = chunk_from_rows([INT, REAL], [[1, 2.5]])
+    assert r.eval(chk.get_row(0)) == 3.5
+
+
+def test_like_case_sensitive_and_escape():
+    # binary collation: LIKE is case-sensitive (reference builtinLikeSig)
+    e = new_function("like", [Constant("ABC", STR), Constant("abc", STR)])
+    assert e.eval([]) == 0
+    # ESCAPE via 3rd const arg
+    e = new_function("like", [Constant("x%", STR), Constant("x|%", STR),
+                              Constant("|", STR)])
+    assert e.eval([]) == 1
+    e = new_function("like", [Constant("xy", STR), Constant("x|%", STR),
+                              Constant("|", STR)])
+    assert e.eval([]) == 0

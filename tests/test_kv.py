@@ -255,3 +255,23 @@ def test_delete_then_insert_same_txn():
     t1.insert(b"k", b"new")
     t1.commit()
     assert s.get_snapshot().get(b"k") == b"new"
+
+
+def test_batch_get_region_batched():
+    s = new_mock_storage()
+    t = s.begin()
+    for i in range(30):
+        t.set(b"bg%03d" % i, b"v%d" % i)
+    t.commit()
+    s.cluster.split(b"bg010")
+    s.cluster.split(b"bg020")
+    s.cache.invalidate_all()
+    t2 = s.begin()
+    t2.set(b"bg000", b"buffered")
+    t2.delete(b"bg001")
+    keys = [b"bg%03d" % i for i in range(30)] + [b"missing"]
+    got = t2.batch_get(keys)
+    assert got[b"bg000"] == b"buffered"
+    assert b"bg001" not in got and b"missing" not in got
+    assert got[b"bg029"] == b"v29"
+    assert len(got) == 29

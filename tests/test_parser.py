@@ -1,0 +1,287 @@
+"""Parser coverage (reference: parser/parser_test.go — graded TestDMLStmt —
+plus the proj2 JoinTable production)."""
+import pytest
+
+from tinysql_tpu.parser import ParseError, parse, parse_one
+from tinysql_tpu.parser import ast
+
+
+# ---- helpers ---------------------------------------------------------------
+
+def sel(sql):
+    s = parse_one(sql)
+    assert isinstance(s, ast.SelectStmt)
+    return s
+
+
+def ok(sql):
+    return parse_one(sql)
+
+
+# ---- select core -----------------------------------------------------------
+
+def test_select_basic():
+    s = sel("SELECT a, b AS x, t.c, 42, 'str', 1.5 FROM t")
+    assert len(s.fields) == 6
+    assert s.fields[1].as_name == "x"
+    assert isinstance(s.fields[0].expr, ast.ColumnRef)
+    assert s.fields[3].expr.value == 42
+    src = s.from_.left
+    assert isinstance(src, ast.TableSource)
+    assert src.source.name == "t"
+
+
+def test_select_wildcards():
+    s = sel("select *, t.* from t")
+    assert s.fields[0].is_wildcard and s.fields[0].wildcard_table == ""
+    assert s.fields[1].wildcard_table == "t"
+
+
+def test_select_full_clauses():
+    s = sel("select a, count(*) from t where b > 1 and c like 'x%' "
+            "group by a having count(*) > 2 order by a desc, b limit 3, 7")
+    assert s.where is not None
+    assert len(s.group_by) == 1
+    assert s.having is not None
+    assert s.order_by[0][1] is True and s.order_by[1][1] is False
+    assert s.limit == (3, 7)
+
+
+def test_limit_offset_forms():
+    assert sel("select 1 limit 5").limit == (0, 5)
+    assert sel("select 1 limit 5 offset 2").limit == (2, 5)
+    assert sel("select 1 limit 2, 5").limit == (2, 5)
+
+
+def test_distinct():
+    assert sel("select distinct a from t").distinct
+    assert not sel("select all a from t").distinct
+
+
+# ---- joins (proj2 JoinTable) -----------------------------------------------
+
+def test_joins():
+    s = sel("select * from t1 join t2 on t1.a = t2.a")
+    j = s.from_
+    assert j.tp == "inner" and j.on is not None
+    s = sel("select * from t1 left join t2 on t1.a=t2.a right join t3 using (b)")
+    j = s.from_
+    assert j.tp == "right" and j.using == ["b"]
+    assert j.left.tp == "left"
+    s = sel("select * from t1, t2, t3")
+    assert s.from_.tp == "cross"
+    s = sel("select * from t1 cross join t2")
+    assert s.from_.tp == "cross"
+
+
+def test_outer_join_requires_on():
+    with pytest.raises(ParseError):
+        parse_one("select * from t1 left join t2")
+
+
+def test_derived_table():
+    s = sel("select x.a from (select a from t) as x")
+    src = s.from_.left
+    assert isinstance(src.source, ast.SelectStmt)
+    assert src.as_name == "x"
+
+
+def test_table_alias():
+    s = sel("select a.x from t a")
+    assert s.from_.left.as_name == "a"
+    s = sel("select * from db1.t as b")
+    assert s.from_.left.source.db == "db1"
+
+
+# ---- expressions -----------------------------------------------------------
+
+def test_precedence():
+    e = sel("select 1 + 2 * 3").fields[0].expr
+    assert e.op == "+" and e.right.op == "*"
+    e = sel("select 1 = 2 or 3 < 4 and 5 > 6").fields[0].expr
+    assert e.op == "or" and e.right.op == "and"
+    e = sel("select not a = b").fields[0].expr
+    assert isinstance(e, ast.UnaryOp) and e.op == "not"
+    assert e.operand.op == "="
+
+
+def test_predicates():
+    e = sel("select a between 1 and 10").fields[0].expr
+    assert isinstance(e, ast.BetweenExpr)
+    e = sel("select a not in (1, 2, 3)").fields[0].expr
+    assert isinstance(e, ast.InExpr) and e.negated and len(e.items) == 3
+    e = sel("select a is not null").fields[0].expr
+    assert isinstance(e, ast.IsNullExpr) and e.negated
+    e = sel("select a is true").fields[0].expr
+    assert isinstance(e, ast.IsTruthExpr) and e.truth
+    e = sel("select name not like '%x_' escape '|'").fields[0].expr
+    assert isinstance(e, ast.LikeExpr) and e.negated and e.escape == "|"
+
+
+def test_null_safe_eq_and_operators():
+    e = sel("select a <=> null").fields[0].expr
+    assert e.op == "<=>"
+    e = sel("select 7 div 2 + 7 mod 2").fields[0].expr
+    assert e.op == "+" and e.left.op == "div" and e.right.op == "%"
+    e = sel("select a <> b").fields[0].expr
+    assert e.op == "!="
+
+
+def test_case_expr():
+    e = sel("select case when a > 0 then 'pos' when a < 0 then 'neg' "
+            "else 'zero' end").fields[0].expr
+    assert isinstance(e, ast.CaseExpr) and len(e.when_clauses) == 2
+    e = sel("select case a when 1 then 'one' end").fields[0].expr
+    assert e.operand is not None and e.else_clause is None
+
+
+def test_agg_funcs():
+    e = sel("select count(*)").fields[0].expr
+    assert isinstance(e, ast.AggFunc) and e.name == "count"
+    e = sel("select count(distinct a), sum(b), avg(c), max(d), min(e) from t").fields
+    assert e[0].expr.distinct
+    assert [f.expr.name for f in e] == ["count", "sum", "avg", "max", "min"]
+
+
+def test_scalar_funcs():
+    e = sel("select ifnull(length(a), strcmp(b, c)) from t").fields[0].expr
+    assert isinstance(e, ast.FuncCall) and e.name == "ifnull"
+    assert e.args[0].name == "length"
+
+
+def test_negative_number_literal_folding():
+    e = sel("select -9223372036854775808").fields[0].expr
+    assert isinstance(e, ast.Literal) and e.value == -(1 << 63)
+
+
+def test_string_escapes_and_quotes():
+    assert sel(r"select 'a\'b'").fields[0].expr.value == "a'b"
+    assert sel("select 'a''b'").fields[0].expr.value == "a'b"
+    assert sel('select "dq"').fields[0].expr.value == "dq"
+    assert sel(r"select 'tab\there'").fields[0].expr.value == "tab\there"
+
+
+def test_quoted_identifiers_and_comments():
+    s = sel("select `select`, `weird``name` from `table` -- trailing\n")
+    assert s.fields[0].expr.name == "select"
+    assert s.fields[1].expr.name == "weird`name"
+    s = sel("select /* block */ a from t # end comment")
+    assert s.fields[0].expr.name == "a"
+
+
+def test_hex_and_sci_literals():
+    assert sel("select 0xFF").fields[0].expr.value == 255
+    assert sel("select 1e3").fields[0].expr.value == 1000.0
+    assert sel("select .5").fields[0].expr.value == 0.5
+
+
+# ---- DML -------------------------------------------------------------------
+
+def test_insert_forms():
+    s = ok("insert into t values (1, 2.5, 'x'), (2, default, null)")
+    assert isinstance(s, ast.InsertStmt) and len(s.lists) == 2
+    assert isinstance(s.lists[1][1], ast.DefaultExpr)
+    assert s.lists[1][2].value is None
+    s = ok("insert into t (a, b) values (1, 2)")
+    assert s.columns == ["a", "b"]
+    s = ok("insert into t select a, b from s")
+    assert s.select is not None
+    s = ok("replace into t values (1)")
+    assert s.is_replace
+
+
+def test_delete():
+    s = ok("delete from t where a = 1")
+    assert isinstance(s, ast.DeleteStmt)
+    assert s.table.source.name == "t"
+    assert s.where is not None
+
+
+# ---- DDL -------------------------------------------------------------------
+
+def test_create_table_full():
+    s = ok("""create table if not exists test.t (
+        id bigint primary key auto_increment,
+        a int not null default 5,
+        b double,
+        c varchar(64) unique,
+        d char(4),
+        u bigint unsigned,
+        index idx_ab (a, b),
+        unique key uk (c, d(2))
+    )""")
+    assert isinstance(s, ast.CreateTableStmt) and s.if_not_exists
+    assert s.table.db == "test"
+    assert [c.name for c in s.cols] == ["id", "a", "b", "c", "d", "u"]
+    opts = {o.tp for o in s.cols[0].options}
+    assert {"primary", "auto_increment"} <= opts
+    assert s.cols[1].options[1].tp == "default" and s.cols[1].options[1].value == 5
+    assert s.cols[5].ft.is_unsigned
+    assert s.constraints[0].tp == "index"
+    assert s.constraints[1].columns == [("c", -1), ("d", 2)]
+
+
+def test_create_drop_database_index():
+    assert ok("create database if not exists d").if_not_exists
+    assert ok("drop database if exists d").if_exists
+    s = ok("create unique index i on t (a, b(3))")
+    assert s.unique and s.columns == [("a", -1), ("b", 3)]
+    s = ok("drop index i on t")
+    assert s.index_name == "i"
+    s = ok("drop table t1, t2")
+    assert len(s.tables) == 2
+    assert isinstance(ok("truncate table t"), ast.TruncateTableStmt)
+
+
+def test_alter_table():
+    s = ok("alter table t add column x int, drop column y, "
+           "add index i (x), drop index j")
+    tps = [sp.tp for sp in s.specs]
+    assert tps == ["add_column", "drop_column", "add_index", "drop_index"]
+
+
+# ---- simple statements -----------------------------------------------------
+
+def test_show_set_use_txn_explain_admin():
+    assert ok("show databases").tp == "databases"
+    s = ok("show tables from d like 't%'")
+    assert s.db == "d" and s.pattern == "t%"
+    assert ok("show columns from t").tp == "columns"
+    assert ok("show create table t").tp == "create_table"
+    s = ok("set @@tidb_executor_concurrency = 8, @u = 5, global x = 'y'")
+    assert s.assignments[0] == ("session", "tidb_executor_concurrency",
+                                s.assignments[0][2])
+    assert s.assignments[1][0] == "user"
+    assert s.assignments[2][0] == "global"
+    assert ok("use test").db == "test"
+    assert isinstance(ok("begin"), ast.BeginStmt)
+    assert isinstance(ok("start transaction"), ast.BeginStmt)
+    assert isinstance(ok("commit"), ast.CommitStmt)
+    assert isinstance(ok("rollback"), ast.RollbackStmt)
+    e = ok("explain select 1")
+    assert isinstance(e, ast.ExplainStmt) and isinstance(e.stmt, ast.SelectStmt)
+    assert ok("admin show ddl jobs").tp == "show_ddl_jobs"
+    assert ok("admin check table t").tp == "check_table"
+    assert ok("desc t").tp == "columns"
+
+
+def test_multi_statement_and_errors():
+    stmts = parse("select 1; select 2;")
+    assert len(stmts) == 2
+    for bad in ["select from t", "insert t values", "select * from",
+                "create table t", "select a from t where", "selec 1",
+                "select 'unterminated", "select ((1)", "update t set a=1"]:
+        with pytest.raises(ParseError):
+            parse(bad)
+
+
+def test_keyword_case_insensitive():
+    s = sel("SeLeCt A fRoM T wHeRe B = 1 OrDeR bY a LiMiT 1")
+    assert s.limit == (0, 1)
+
+
+def test_system_and_user_vars_in_expr():
+    e = sel("select @@global.autocommit, @@sql_mode, @x").fields
+    assert e[0].expr.scope == "global"
+    assert e[1].expr.is_system
+    assert not e[2].expr.is_system

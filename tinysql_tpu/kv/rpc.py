@@ -15,7 +15,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..utils import failpoint
 from .cluster import Cluster, Region
-from .errors import RegionError
+from .errors import KeyNotFound, RegionError
 from .mvcc import MVCCStore, Mutation
 
 
@@ -63,6 +63,18 @@ class RPCClient:
                resolved: Tuple[int, ...] = ()) -> bytes:
         self._check(ctx, keys=[key])
         return self.mvcc.get(key, ts, resolved)
+
+    def kv_batch_get(self, ctx: RegionCtx, keys: List[bytes], ts: int,
+                     resolved: Tuple[int, ...] = ()) -> List[Tuple[bytes, bytes]]:
+        """Region-batched point gets (reference: kvrpcpb BatchGet)."""
+        self._check(ctx, keys=keys)
+        out = []
+        for k in keys:
+            try:
+                out.append((k, self.mvcc.get(k, ts, resolved)))
+            except KeyNotFound:
+                pass
+        return out
 
     def kv_scan(self, ctx: RegionCtx, start: bytes, end: bytes, ts: int,
                 limit: int = 0,
@@ -132,13 +144,17 @@ class RegionCache:
         with self._mu:
             self._by_id.clear()
 
-    def group_keys_by_region(self, keys: List[bytes]) -> List[Tuple[Region, List[bytes]]]:
-        """reference: 2pc.go GroupKeysByRegion."""
-        groups: Dict[int, Tuple[Region, List[bytes]]] = {}
-        for k in sorted(keys):
-            r = self.locate_key(k)
-            groups.setdefault(r.id, (r, []))[1].append(k)
+    def group_by_region(self, items, key_fn) -> List[Tuple[Region, list]]:
+        """Generic locate-and-group (reference: 2pc.go GroupKeysByRegion) —
+        single implementation shared by prewrite/commit/batch-get paths."""
+        groups: Dict[int, Tuple[Region, list]] = {}
+        for item in sorted(items, key=key_fn):
+            r = self.locate_key(key_fn(item))
+            groups.setdefault(r.id, (r, []))[1].append(item)
         return list(groups.values())
+
+    def group_keys_by_region(self, keys: List[bytes]) -> List[Tuple[Region, List[bytes]]]:
+        return self.group_by_region(keys, lambda k: k)
 
     def split_range_by_regions(self, start: bytes, end: bytes) -> List[Tuple[Region, bytes, bytes]]:
         """Split [start,end) into per-region subranges (reference:

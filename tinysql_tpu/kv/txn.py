@@ -109,12 +109,27 @@ class Snapshot:
                     boer.backoff(bo.BO_TXN_LOCK_FAST, lk)
 
     def batch_get(self, keys: List[bytes]) -> Dict[bytes, bytes]:
+        """Region-batched point gets: O(regions) RPCs, not O(keys)
+        (reference: snapshot.go BatchGet)."""
         out: Dict[bytes, bytes] = {}
-        for k in keys:
-            try:
-                out[k] = self.get(k)
-            except KeyNotFound:
-                pass
+        boer = Backoffer(bo.GET_MAX_BACKOFF)
+        pending = list(dict.fromkeys(keys))
+        while pending:
+            retry: List[bytes] = []
+            for r, ks in self.storage.cache.group_keys_by_region(pending):
+                try:
+                    for k, v in self.storage.client.kv_batch_get(
+                            RegionCtx(r.id, r.epoch), ks, self.ts):
+                        out[k] = v
+                except RegionError as e:
+                    self.storage.cache.invalidate(r.id)
+                    boer.backoff(bo.BO_REGION_MISS, e)
+                    retry.extend(ks)
+                except KeyIsLocked as lk:
+                    if not self.storage.resolver.resolve(boer, lk):
+                        boer.backoff(bo.BO_TXN_LOCK_FAST, lk)
+                    retry.extend(ks)
+            pending = retry
         return out
 
     # -- range scan ------------------------------------------------------
@@ -172,11 +187,8 @@ class TwoPhaseCommitter:
 
     # ---- region batching ------------------------------------------------
     def _group_mutations(self) -> List[Tuple[Region, List[Mutation]]]:
-        groups: Dict[int, Tuple[Region, List[Mutation]]] = {}
-        for m in sorted(self.mutations, key=lambda m: m.key):
-            r = self.storage.cache.locate_key(m.key)
-            groups.setdefault(r.id, (r, []))[1].append(m)
-        return list(groups.values())
+        return self.storage.cache.group_by_region(self.mutations,
+                                                  lambda m: m.key)
 
     def _run_batches(self, action: Callable, batches, primary_first: bool) -> None:
         """Bounded-parallel per-region execution (reference: 2pc.go:672-721);
@@ -234,11 +246,7 @@ class TwoPhaseCommitter:
         self._run_batches(one, self._group_mutations(), primary_first=False)
 
     def _regroup(self, muts: List[Mutation]):
-        groups: Dict[int, Tuple[Region, List[Mutation]]] = {}
-        for m in muts:
-            r = self.storage.cache.locate_key(m.key)
-            groups.setdefault(r.id, (r, []))[1].append(m)
-        return list(groups.values())
+        return self.storage.cache.group_by_region(muts, lambda m: m.key)
 
     def commit_keys(self) -> None:
         keys = [m.key for m in self.mutations]
@@ -338,12 +346,17 @@ class Transaction:
         return self.us.get(key)
 
     def batch_get(self, keys: List[bytes]) -> Dict[bytes, bytes]:
-        out = {}
+        """Buffer-aware batch get: buffered values shadow the snapshot;
+        the rest go through the region-batched snapshot path."""
+        out: Dict[bytes, bytes] = {}
+        missing: List[bytes] = []
         for k in keys:
-            try:
-                out[k] = self.get(k)
-            except KeyNotFound:
-                pass
+            v = self.us.buffer.get(k)
+            if v is None:
+                missing.append(k)
+            elif v != TOMBSTONE:
+                out[k] = v
+        out.update(self.snapshot.batch_get(missing))
         return out
 
     def iter_range(self, start: Optional[bytes],
