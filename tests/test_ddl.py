@@ -1,0 +1,146 @@
+"""Online DDL: F1 state machine, reorg backfill, rollback
+(reference: ddl/db_test.go, ddl/ddl_worker_test.go, courses/proj3)."""
+import pytest
+
+from tinysql_tpu.catalog.meta import Meta
+from tinysql_tpu.utils.testkit import TestKit, rows
+from tinysql_tpu.utils import failpoint
+
+
+@pytest.fixture()
+def tk():
+    t = TestKit()
+    t.must_exec("create database test")
+    t.must_exec("use test")
+    return t
+
+
+@pytest.fixture(autouse=True)
+def _clean_fp():
+    yield
+    failpoint.disable_all()
+
+
+def test_create_drop_database(tk):
+    tk.must_exec("create database d2")
+    assert "exists" in str(tk.exec_err("create database d2"))
+    tk.must_exec("create database if not exists d2")
+    tk.must_exec("drop database d2")
+    tk.must_exec("drop database if exists d2")
+    assert "doesn't exist" in str(tk.exec_err("drop database d2"))
+
+
+def test_create_drop_table(tk):
+    tk.must_exec("create table t (a int)")
+    assert "already exists" in str(tk.exec_err("create table t (a int)"))
+    tk.must_exec("create table if not exists t (a int)")
+    tk.must_exec("insert into t values (1)")
+    tk.must_exec("drop table t")
+    assert "doesn't exist" in str(tk.exec_err("select * from t"))
+    # recreate: data must be gone
+    tk.must_exec("create table t (a int)")
+    tk.must_query("select count(*) from t").check(rows("0"))
+
+
+def test_truncate(tk):
+    tk.must_exec("create table t (a int primary key)")
+    tk.must_exec("insert into t values (1), (2)")
+    tk.must_exec("truncate table t")
+    tk.must_query("select count(*) from t").check(rows("0"))
+    tk.must_exec("insert into t values (1)")  # no dup error: fresh keyspace
+
+
+def test_add_index_backfills_existing_rows(tk):
+    tk.must_exec("create table t (a int primary key, b int)")
+    for i in range(0, 600, 100):
+        tk.must_exec(f"insert into t values ({i}, {i * 2})")
+    tk.must_exec("create index ib on t (b)")
+    tk.must_query("admin check table t").check(rows("OK"))
+    # new writes maintain it
+    tk.must_exec("insert into t values (1000, 2000)")
+    tk.must_query("admin check table t").check(rows("OK"))
+    # big table exercises multi-batch reorg (REORG_BATCH=256)
+    tk2 = TestKit(tk.session.storage, "test")
+    tk2.must_exec("create table big (a int primary key, b int)")
+    tk2.session.execute("begin")
+    for i in range(700):
+        tk2.must_exec(f"insert into big values ({i}, {i % 7})")
+    tk2.session.execute("commit")
+    tk2.must_exec("create index ib on big (b)")
+    tk2.must_query("admin check table big").check(rows("OK"))
+    tk2.must_query("select count(*) from big where b = 3").check(rows("100"))
+
+
+def test_unique_index_backfill_rollback_on_duplicate(tk):
+    tk.must_exec("create table t (a int primary key, b int)")
+    tk.must_exec("insert into t values (1, 5), (2, 5)")
+    e = tk.exec_err("create unique index ub on t (b)")
+    assert "Duplicate" in str(e) or "rolled back" in str(e)
+    # index must not exist and table must still work
+    idx_names = [r[2] for r in
+                 tk.must_query("show index from t").as_str()]
+    assert "ub" not in idx_names
+    tk.must_exec("insert into t values (3, 5)")  # not blocked by ghost index
+    tk.must_query("admin check table t").check(rows("OK"))
+
+
+def test_drop_index(tk):
+    tk.must_exec("create table t (a int primary key, b int)")
+    tk.must_exec("insert into t values (1, 2)")
+    tk.must_exec("create index ib on t (b)")
+    tk.must_exec("drop index ib on t")
+    assert "check that index exists" in str(tk.exec_err("drop index ib on t"))
+    tk.must_exec("insert into t values (2, 3)")
+    tk.must_query("admin check table t").check(rows("OK"))
+
+
+def test_add_drop_column(tk):
+    tk.must_exec("create table t (a int primary key)")
+    tk.must_exec("insert into t values (1), (2)")
+    tk.must_exec("alter table t add column b int default 9")
+    tk.must_query("select a, b from t order by a").check(rows("1 9", "2 9"))
+    tk.must_exec("insert into t values (3, 30)")
+    tk.must_exec("alter table t add column c varchar(5)")
+    tk.must_query("select c from t where a = 1").check(rows("<nil>"))
+    tk.must_exec("alter table t drop column b")
+    assert "Unknown column" in str(tk.exec_err("select b from t"))
+    tk.must_query("select a, c from t order by a").check(
+        rows("1 <nil>", "2 <nil>", "3 <nil>"))
+    # dropping a column covered by an index is refused
+    tk.must_exec("create index ic on t (c)")
+    assert "covered by index" in str(
+        tk.exec_err("alter table t drop column c"))
+
+
+def test_schema_change_visible_across_sessions(tk):
+    tk.must_exec("create table t (a int)")
+    tk2 = TestKit(tk.session.storage, "test")
+    tk2.must_query("select count(*) from t").check(rows("0"))
+    tk.must_exec("alter table t add column b int default 5")
+    tk2.must_query("select b from t").check(rows())  # sees new schema (0 rows)
+    tk2.must_exec("insert into t values (1, 2)")
+    tk.must_query("select a, b from t").check(rows("1 2"))
+
+
+def test_ddl_history_jobs(tk):
+    tk.must_exec("create table t (a int)")
+    tk.must_exec("alter table t add column b int")
+    jobs = tk.must_query("admin show ddl jobs").as_str()
+    kinds = [j[1] for j in jobs]
+    assert "ADD_COLUMN" in kinds and "CREATE_TABLE" in kinds
+    assert all(j[4] in ("SYNCED", "CANCELLED") for j in jobs)
+
+
+def test_schema_version_bumps_per_state(tk):
+    """Each F1 state transition commits its own schema version bump —
+    the invariant online DDL depends on."""
+    txn = tk.session.storage.begin()
+    v0 = Meta(txn).schema_version()
+    txn.rollback()
+    tk.must_exec("create table t (a int primary key, b int)")
+    tk.must_exec("insert into t values (1, 2)")
+    tk.must_exec("create index ib on t (b)")  # 4 states = 4+ bumps
+    txn = tk.session.storage.begin()
+    v1 = Meta(txn).schema_version()
+    txn.rollback()
+    assert v1 - v0 >= 5

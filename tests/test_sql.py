@@ -1,0 +1,316 @@
+"""SQL-level integration tests on mock storage via TestKit — the dominant
+reference test pattern (executor/executor_test.go, join_test.go TestJoin,
+aggregate_test.go, sort/limit coverage, session_test.go)."""
+import pytest
+
+from tinysql_tpu.utils.testkit import TestKit, rows
+
+
+@pytest.fixture()
+def tk():
+    t = TestKit()
+    t.must_exec("create database test")
+    t.must_exec("use test")
+    return t
+
+
+def test_create_insert_select(tk):
+    tk.must_exec("create table t (a int primary key, b double, c varchar(20))")
+    tk.must_exec("insert into t values (1, 1.5, 'x'), (2, 2.5, 'y')")
+    tk.must_exec("insert into t (c, a) values ('z', 3)")
+    tk.must_query("select * from t order by a").check(
+        rows("1 1.5 x", "2 2.5 y", "3 <nil> z"))
+    tk.must_query("select c, a from t where b > 1.5").check(rows("y 2"))
+
+
+def test_expressions_in_select(tk):
+    tk.must_exec("create table t (a int, b int)")
+    tk.must_exec("insert into t values (5, 2), (7, 0), (null, 3)")
+    tk.must_query("select a + b, a * b, a / b, a div b, a % b from t "
+                  "where a = 5").check(rows("7 10 2.5 2 1"))
+    tk.must_query("select a is null, a <=> null from t order by a").check(
+        rows("1 1", "0 0", "0 0"))
+    tk.must_query("select if(a > 6, 'big', 'small') from t where a is not null "
+                  "order by a").check(rows("small", "big"))
+    tk.must_query("select case when a is null then 'n' else 'v' end from t "
+                  "order by a").check(rows("n", "v", "v"))
+
+
+def test_where_like_in_between(tk):
+    tk.must_exec("create table t (a int, s varchar(10))")
+    tk.must_exec("insert into t values (1,'apple'), (2,'banana'), (3,'cherry'),"
+                 " (4, null)")
+    tk.must_query("select a from t where s like 'b%'").check(rows("2"))
+    tk.must_query("select a from t where s like '_anana'").check(rows("2"))
+    tk.must_query("select a from t where a in (1, 3) order by a").check(
+        rows("1", "3"))
+    tk.must_query("select a from t where a not in (1, 3) order by a").check(
+        rows("2", "4"))
+    tk.must_query("select a from t where a between 2 and 3 order by a").check(
+        rows("2", "3"))
+
+
+def test_aggregates(tk):
+    tk.must_exec("create table t (g varchar(5), v int, r double)")
+    tk.must_exec("insert into t values ('a', 1, 0.5), ('a', 2, 1.5), "
+                 "('b', 3, 2.5), ('b', null, null), ('c', 5, 4.5)")
+    tk.must_query(
+        "select g, count(*), count(v), sum(v), avg(v), max(v), min(v) "
+        "from t group by g order by g").check(
+        rows("a 2 2 3 1.5 2 1",
+             "b 2 1 3 3 3 3",
+             "c 1 1 5 5 5 5"))
+    tk.must_query("select count(*), sum(r) from t").check(rows("5 9"))
+    tk.must_query("select count(distinct g) from t").check(rows("3"))
+    # empty input: COUNT=0, SUM=NULL (MySQL)
+    tk.must_query("select count(*), sum(v), max(v) from t where v > 100").check(
+        rows("0 <nil> <nil>"))
+    # empty input WITH group by: no rows
+    assert tk.must_query(
+        "select g, count(*) from t where v > 100 group by g").as_str() == []
+
+
+def test_group_by_expr_and_having(tk):
+    tk.must_exec("create table t (a int, b int)")
+    tk.must_exec("insert into t values (1,1),(2,1),(3,2),(4,2),(5,3)")
+    tk.must_query("select b, sum(a) s from t group by b having s > 3 "
+                  "order by b").check(rows("2 7", "3 5"))
+    tk.must_query("select a % 2 p, count(*) from t group by p order by p").check(
+        rows("0 2", "1 3"))
+    tk.must_query("select b, count(*) from t group by 1 order by 1").check(
+        rows("1 2", "2 2", "3 1"))
+
+
+def test_joins(tk):
+    tk.must_exec("create table t1 (a int primary key, b int)")
+    tk.must_exec("create table t2 (x int primary key, y varchar(10))")
+    tk.must_exec("insert into t1 values (1,10),(2,20),(3,30)")
+    tk.must_exec("insert into t2 values (1,'one'),(3,'three'),(5,'five')")
+    tk.must_query("select t1.a, t2.y from t1 join t2 on t1.a = t2.x "
+                  "order by a").check(rows("1 one", "3 three"))
+    tk.must_query("select t1.a, t2.y from t1 left join t2 on t1.a = t2.x "
+                  "order by a").check(rows("1 one", "2 <nil>", "3 three"))
+    tk.must_query("select t1.a, t2.y from t1 right join t2 on t1.a = t2.x "
+                  "order by x").check(rows("1 one", "3 three", "<nil> five"))
+    # cross join
+    tk.must_query("select count(*) from t1, t2").check(rows("9"))
+    # join with extra filter on ON clause
+    tk.must_query("select t1.a from t1 join t2 on t1.a = t2.x and t1.b > 10 "
+                  "order by a").check(rows("3"))
+    # self join with aliases
+    tk.must_query("select p.a, q.a from t1 p join t1 q on p.a = q.a - 1 "
+                  "order by p.a").check(rows("1 2", "2 3"))
+    # using
+    tk.must_exec("create table t3 (a int, z int)")
+    tk.must_exec("insert into t3 values (1, 100), (9, 900)")
+    tk.must_query("select t1.b, t3.z from t1 join t3 using (a)").check(
+        rows("10 100"))
+
+
+def test_join_null_keys_never_match(tk):
+    tk.must_exec("create table a (k int)")
+    tk.must_exec("create table b (k int)")
+    tk.must_exec("insert into a values (1), (null)")
+    tk.must_exec("insert into b values (1), (null)")
+    tk.must_query("select count(*) from a join b on a.k = b.k").check(rows("1"))
+    tk.must_query("select a.k, b.k from a left join b on a.k = b.k "
+                  "order by a.k").check(rows("<nil> <nil>", "1 1"))
+
+
+def test_sort_limit_topn(tk):
+    tk.must_exec("create table t (a int, b double)")
+    tk.must_exec("insert into t values (3, 1.0), (1, 3.0), (2, null), "
+                 "(2, 2.0), (null, 9.9)")
+    tk.must_query("select a from t order by a").check(
+        rows("<nil>", "1", "2", "2", "3"))
+    tk.must_query("select a from t order by a desc").check(
+        rows("3", "2", "2", "1", "<nil>"))
+    tk.must_query("select a, b from t order by a, b desc limit 3").check(
+        rows("<nil> 9.9", "1 3", "2 2"))
+    tk.must_query("select a from t order by a limit 1, 2").check(
+        rows("1", "2"))
+    tk.must_query("select a from t order by a limit 2 offset 2").check(
+        rows("2", "2"))
+
+
+def test_derived_tables_and_aliases(tk):
+    tk.must_exec("create table t (a int, b int)")
+    tk.must_exec("insert into t values (1, 10), (2, 20), (3, 30)")
+    tk.must_query("select s.total from (select sum(b) total from t) s").check(
+        rows("60"))
+    tk.must_query("select x.a, y.a from (select a from t where a < 3) x "
+                  "join (select a from t where a > 1) y on x.a = y.a").check(
+        rows("2 2"))
+    tk.must_query("select t.a * 2 twice from t where t.a = 2").check(rows("4"))
+
+
+def test_distinct(tk):
+    tk.must_exec("create table t (a int, b int)")
+    tk.must_exec("insert into t values (1,1),(1,1),(1,2),(2,1)")
+    tk.must_query("select distinct a, b from t order by a, b").check(
+        rows("1 1", "1 2", "2 1"))
+    tk.must_query("select distinct a from t order by a").check(rows("1", "2"))
+
+
+def test_delete(tk):
+    tk.must_exec("create table t (a int primary key, b int)")
+    tk.must_exec("insert into t values (1,1),(2,2),(3,3),(4,4)")
+    tk.must_exec("delete from t where b % 2 = 0")
+    tk.must_query("select a from t order by a").check(rows("1", "3"))
+    tk.must_exec("delete from t")
+    tk.must_query("select count(*) from t").check(rows("0"))
+
+
+def test_replace_and_duplicates(tk):
+    tk.must_exec("create table t (a int primary key, b varchar(5) unique, "
+                 "c int)")
+    tk.must_exec("insert into t values (1, 'x', 100)")
+    e = tk.exec_err("insert into t values (1, 'y', 200)")
+    assert "Duplicate" in str(e) or "PRIMARY" in str(e)
+    e = tk.exec_err("insert into t values (2, 'x', 200)")
+    assert "Duplicate" in str(e)
+    tk.must_exec("replace into t values (1, 'z', 300)")
+    tk.must_query("select * from t").check(rows("1 z 300"))
+    # replace that collides on unique index of ANOTHER row
+    tk.must_exec("insert into t values (2, 'w', 400)")
+    tk.must_exec("replace into t values (3, 'z', 500)")  # steals b='z' from a=1
+    tk.must_query("select * from t order by a").check(
+        rows("2 w 400", "3 z 500"))
+
+
+def test_autoincrement_and_defaults(tk):
+    tk.must_exec("create table t (id int primary key auto_increment, "
+                 "v int not null default 7, s varchar(5) default 'dd')")
+    tk.must_exec("insert into t (v) values (1)")
+    tk.must_exec("insert into t values (10, 2, 'x')")
+    tk.must_exec("insert into t (v) values (3)")
+    tk.must_query("select * from t order by id").check(
+        rows("1 1 dd", "10 2 x", "11 3 dd"))
+    e = tk.exec_err("insert into t values (20, null, 'x')")
+    assert "cannot be null" in str(e)
+
+
+def test_txn_visibility(tk):
+    tk.must_exec("create table t (a int primary key)")
+    tk2 = TestKit(tk.session.storage, "test")
+    tk.must_exec("begin")
+    tk.must_exec("insert into t values (1)")
+    tk.must_query("select count(*) from t").check(rows("1"))  # own writes
+    tk2.must_query("select count(*) from t").check(rows("0"))  # isolation
+    tk.must_exec("commit")
+    tk2.must_query("select count(*) from t").check(rows("1"))
+
+
+def test_txn_conflict_error(tk):
+    tk.must_exec("create table t (a int primary key, b int)")
+    tk.must_exec("insert into t values (1, 0)")
+    tk2 = TestKit(tk.session.storage, "test")
+    tk.must_exec("begin")
+    tk.must_exec("delete from t where a = 1")
+    tk2.must_exec("begin")
+    tk2.must_exec("delete from t where a = 1")
+    tk2.must_exec("insert into t values (1, 2)")
+    tk2.must_exec("commit")
+    e = tk.exec_err("commit")
+    assert "conflict" in str(e).lower()
+    tk.must_query("select b from t").check(rows("2"))
+
+
+def test_select_no_table(tk):
+    tk.must_query("select 1 + 1, 'hi', 2.5 * 2").check(rows("2 hi 5"))
+    tk.must_query("select @@autocommit").check(rows("1"))
+
+
+def test_set_and_show_variables(tk):
+    tk.must_exec("set @@tidb_max_chunk_size = 64, @x = 41")
+    tk.must_query("select @@tidb_max_chunk_size, @x + 1").check(rows("64 42"))
+    r = tk.must_query("show variables like 'tidb_max%'")
+    assert r.as_str() == [["tidb_max_chunk_size", "64"]]
+
+
+def test_show_statements(tk):
+    tk.must_exec("create table t (a int primary key, b varchar(10) not null)")
+    assert ["test"] in tk.must_query("show databases").as_str()
+    tk.must_query("show tables").check(rows("t"))
+    cols = tk.must_query("show columns from t").as_str()
+    assert cols[0][:4] == ["a", "int", "NO", "PRI"]
+    assert cols[1][:3] == ["b", "varchar(10)", "NO"]
+    sct = tk.must_query("show create table t").as_str()
+    assert "CREATE TABLE `t`" in sct[0][1]
+
+
+def test_string_functions_e2e(tk):
+    tk.must_exec("create table t (s varchar(20))")
+    tk.must_exec("insert into t values ('Hello'), (null)")
+    tk.must_query("select length(s), upper(s), lower(s), "
+                  "substring(s, 2, 3), concat(s, '!') from t "
+                  "where s is not null").check(rows("5 HELLO hello ell Hello!"))
+    tk.must_query("select ifnull(s, 'NONE') from t order by s").check(
+        rows("NONE", "Hello"))
+
+
+def test_insert_select(tk):
+    tk.must_exec("create table src (a int, b int)")
+    tk.must_exec("create table dst (a int, b int)")
+    tk.must_exec("insert into src values (1,2),(3,4)")
+    tk.must_exec("insert into dst select a * 10, b from src")
+    tk.must_query("select * from dst order by a").check(rows("10 2", "30 4"))
+
+
+def test_multiple_databases(tk):
+    tk.must_exec("create database other")
+    tk.must_exec("create table other.t (v int)")
+    tk.must_exec("insert into other.t values (42)")
+    tk.must_query("select * from other.t").check(rows("42"))
+    tk.must_exec("drop database other")
+    e = tk.exec_err("select * from other.t")
+    assert "Unknown database" in str(e) or "doesn't exist" in str(e)
+
+
+def test_error_messages(tk):
+    tk.must_exec("create table t (a int)")
+    assert "Unknown column" in str(tk.exec_err("select nope from t"))
+    assert "doesn't exist" in str(tk.exec_err("select * from missing"))
+    tk.must_exec("create table t2 (a int)")
+    tk.must_exec("insert into t values (1); insert into t2 values (1)")
+    assert "ambiguous" in str(
+        tk.exec_err("select a from t, t2")).lower()
+
+
+def test_unsigned_column_e2e(tk):
+    tk.must_exec("create table t (u bigint unsigned)")
+    tk.must_exec("insert into t values (18446744073709551615), (0)")
+    tk.must_query("select u from t order by u").check(
+        rows("0", "18446744073709551615"))
+    e = tk.exec_err("insert into t values (-1)")
+    assert "overflow" in str(e).lower()
+
+
+def test_order_by_hidden_column_trim(tk):
+    tk.must_exec("create table t (a int, b int)")
+    tk.must_exec("insert into t values (1, 30), (2, 20), (3, 10)")
+    r = tk.must_query("select a from t order by b")
+    assert r.columns == ["a"]
+    r.check(rows("3", "2", "1"))
+
+
+def test_zero_column_chunks_keep_rows(tk):
+    # regression: virtual row counts survive selection/sort/limit operators
+    tk.must_query("select 1 where 1 = 1").check(rows("1"))
+    assert tk.must_query("select 1 where 1 = 0").as_str() == []
+    tk.must_query("select 1 order by 1 limit 5").check(rows("1"))
+    tk.must_query("select count(*)").check(rows("1"))
+
+
+def test_eager_duplicate_detection_and_stmt_rollback(tk):
+    # regression: dup-key INSERT fails at the statement, not at commit
+    tk.must_exec("create table t (a int primary key, b int)")
+    tk.must_exec("insert into t values (1, 2)")
+    assert "Duplicate" in str(tk.exec_err("insert into t values (1, 9)"))
+    # failed statement inside explicit txn: txn survives, stmt rolled back
+    tk.must_exec("begin")
+    tk.must_exec("insert into t values (2, 4)")
+    assert "Duplicate" in str(tk.exec_err("insert into t values (1, 9)"))
+    tk.must_query("select a, b from t order by a").check(rows("1 2", "2 4"))
+    tk.must_exec("commit")
+    tk.must_query("select a, b from t order by a").check(rows("1 2", "2 4"))

@@ -172,11 +172,14 @@ class Table:
         v = txn.get(tablecodec.encode_row_key(self.info.id, handle))
         return self.decode_row(v, handle, cols)
 
-    def iter_records(self, txn, start_handle: Optional[int] = None
+    def iter_records(self, txn, start_handle: Optional[int] = None,
+                     cols: Optional[List[ColumnInfo]] = None
                      ) -> Iterator[Tuple[int, List[Datum]]]:
+        """Scan records in handle order, decoding only `cols` (column
+        pruning reaches all the way to the decode loop)."""
         lo, hi = tablecodec.record_range(self.info.id)
         if start_handle is not None:
             lo = tablecodec.encode_row_key(self.info.id, start_handle)
         for k, v in txn.iter_range(lo, hi):
             _, handle = tablecodec.decode_record_key(k)
-            yield handle, self.decode_row(v, handle)
+            yield handle, self.decode_row(v, handle, cols)

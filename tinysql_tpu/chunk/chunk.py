@@ -18,17 +18,20 @@ MAX_CHUNK_SIZE = 1024     # reference: sessionctx tidb_vars.go:242
 
 
 class Chunk:
-    __slots__ = ("columns", "sel", "required_rows")
+    __slots__ = ("columns", "sel", "required_rows", "virtual_rows")
 
     def __init__(self, fields: Sequence[FieldType], cap: int = INIT_CHUNK_SIZE):
         self.columns: List[Column] = [Column(ft, cap) for ft in fields]
         self.sel: Optional[np.ndarray] = None
         self.required_rows: int = MAX_CHUNK_SIZE
+        # row count for zero-column chunks (TableDual / `SELECT 1`)
+        self.virtual_rows: int = 0
 
     @classmethod
-    def from_columns(cls, cols: List[Column]) -> "Chunk":
+    def from_columns(cls, cols: List[Column], virtual_rows: int = 0) -> "Chunk":
         c = cls([], 1)
         c.columns = cols
+        c.virtual_rows = virtual_rows
         return c
 
     # ---- size ---------------------------------------------------------
@@ -36,12 +39,12 @@ class Chunk:
         if self.sel is not None:
             return len(self.sel)
         if not self.columns:
-            return 0
+            return self.virtual_rows
         return len(self.columns[0])
 
     def full_rows(self) -> int:
         """Physical row count ignoring the selection vector."""
-        return len(self.columns[0]) if self.columns else 0
+        return len(self.columns[0]) if self.columns else self.virtual_rows
 
     def num_cols(self) -> int:
         return len(self.columns)
@@ -53,6 +56,7 @@ class Chunk:
         for c in self.columns:
             c.truncate(0)
         self.sel = None
+        self.virtual_rows = 0
 
     # ---- selection vector ---------------------------------------------
     def set_sel(self, sel: Optional[np.ndarray]) -> None:
@@ -63,8 +67,10 @@ class Chunk:
         reference keeps Sel lazy, chunk.go:573)."""
         if self.sel is None:
             return self
-        out = Chunk.from_columns([c.take(self.sel) for c in self.columns])
-        return out
+        if not self.columns:
+            # zero-column chunk: the sel vector's length IS the row count
+            return Chunk.from_columns([], virtual_rows=len(self.sel))
+        return Chunk.from_columns([c.take(self.sel) for c in self.columns])
 
     # ---- row append ----------------------------------------------------
     def append_row(self, values: Sequence[Datum]) -> None:
@@ -73,12 +79,18 @@ class Chunk:
             c.append(v)
 
     def append_chunk_row(self, other: "Chunk", i: int) -> None:
+        if not self.columns:
+            self.virtual_rows += 1
+            return
         phys = other.sel[i] if other.sel is not None else i
         for dst, src in zip(self.columns, other.columns):
             dst.extend(src, phys, phys + 1)
 
     def append_chunk(self, other: "Chunk") -> None:
         o = other.compact()
+        if not self.columns:
+            self.virtual_rows += o.num_rows()
+            return
         for dst, src in zip(self.columns, o.columns):
             dst.extend(src)
 

@@ -1,0 +1,18 @@
+"""TPU executor tier — placeholder until the ops/ kernels land.
+
+Capability slot for the north-star BASELINE.json: TPU-backed HashJoin /
+HashAgg / Sort / Projection registered behind the same build_executor
+switch, chosen by the planner's device enforcer.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def try_build_tpu(plan) -> Optional[object]:
+    from ..planner.physical import (PhysicalHashAgg, PhysicalHashJoin,
+                                    PhysicalSort, PhysicalTopN)
+    if getattr(plan, "use_tpu", False):
+        from .tpu_executors import build_tpu_executor
+        return build_tpu_executor(plan)
+    return None

@@ -392,6 +392,19 @@ class Transaction:
     def is_readonly(self) -> bool:
         return len(self.us.buffer) == 0
 
+    # -- statement-level rollback (reference: session/txn.go StmtCommit /
+    # StmtRollback over the membuffer) ------------------------------------
+    def checkpoint(self) -> tuple:
+        return (dict(self.us.buffer._m), set(self.presume_not_exists),
+                dict(self.dup_info))
+
+    def restore(self, cp: tuple) -> None:
+        m, pne, dup = cp
+        self.us.buffer._m = dict(m)
+        self.us.buffer._dirty = True
+        self.presume_not_exists = set(pne)
+        self.dup_info = dict(dup)
+
     def size(self) -> int:
         return len(self.us.buffer)
 

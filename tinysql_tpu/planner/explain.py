@@ -1,0 +1,64 @@
+"""EXPLAIN text rendering (reference: planner/core/explain.go + stringer)."""
+from __future__ import annotations
+
+from typing import List
+
+from .physical import (PhysicalHashAgg, PhysicalHashJoin, PhysicalLimit,
+                       PhysicalPlan, PhysicalProjection, PhysicalSelection,
+                       PhysicalSort, PhysicalTableDual, PhysicalTableReader,
+                       PhysicalTopN)
+
+
+def _info(p: PhysicalPlan) -> str:
+    if isinstance(p, PhysicalTableReader):
+        s = p.scan
+        filt = f", filters:{len(s.filters)}" if s.filters else ""
+        return f"table:{s.alias}, keep order:false{filt}"
+    if isinstance(p, PhysicalSelection):
+        return ", ".join(c.key() for c in p.conditions)
+    if isinstance(p, PhysicalProjection):
+        return ", ".join(e.key() for e in p.exprs)
+    if isinstance(p, PhysicalHashAgg):
+        gb = ",".join(e.key() for e in p.group_by) or "-"
+        aggs = ",".join(f"{d.name}({','.join(a.key() for a in d.args)})"
+                        for d in p.aggs)
+        return f"group by:{gb}, funcs:{aggs}"
+    if isinstance(p, PhysicalHashJoin):
+        keys = ",".join(f"{l.key()}={r.key()}" for l, r in
+                        zip(p.left_keys, p.right_keys)) or "CARTESIAN"
+        return f"{p.tp} join, equal:[{keys}]"
+    if isinstance(p, (PhysicalSort, PhysicalTopN)):
+        by = ",".join(f"{e.key()}{' desc' if d else ''}" for e, d in p.by)
+        extra = (f", offset:{p.offset}, count:{p.count}"
+                 if isinstance(p, PhysicalTopN) else "")
+        return by + extra
+    if isinstance(p, PhysicalLimit):
+        return f"offset:{p.offset}, count:{p.count}"
+    if isinstance(p, PhysicalTableDual):
+        return f"rows:{p.row_count}"
+    return ""
+
+
+def _task(p: PhysicalPlan) -> str:
+    if isinstance(p, PhysicalTableReader):
+        return "root"
+    if getattr(p, "use_tpu", False):
+        return "tpu"
+    return "root"
+
+
+def explain_text(p: PhysicalPlan, depth: int = 0,
+                 out: List[list] = None) -> List[list]:
+    if out is None:
+        out = []
+    name = p.op_name()
+    if getattr(p, "use_tpu", False):
+        name += "(TPU)"
+    out.append(["  " * depth + name, _task(p), _info(p)])
+    children = list(p.children)
+    if isinstance(p, PhysicalTableReader):
+        out.append(["  " * (depth + 1) + "TableScan", "cop",
+                    f"table:{p.scan.alias}"])
+    for c in children:
+        explain_text(c, depth + 1, out)
+    return out

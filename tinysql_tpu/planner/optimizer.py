@@ -1,0 +1,313 @@
+"""Logical optimization rules + logical->physical conversion.
+
+Capability parity with reference planner/core/optimizer.go:44-55 (the
+fixed-order rule list) — this module carries predicate pushdown
+(rule_predicate_push_down.go), column pruning (rule_column_pruning.go), and
+TopN pushdown (rule_topn_push_down.go); further rules (agg pushdown, join
+reorder, max/min elimination) land in rules.py as the planner widens.
+Physical conversion binds every expression to child schema offsets
+(reference: resolve_indices.go).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Set, Tuple
+
+from ..expression import (AggFuncDesc, Column, Constant, Expression, Schema,
+                          new_function, substitute_column)
+from .builder import HANDLE_COL_NAME, PlanError
+from .logical import (JOIN_INNER, JOIN_LEFT, LogicalAggregation,
+                      LogicalDataSource, LogicalJoin, LogicalLimit,
+                      LogicalPlan, LogicalProjection, LogicalSelection,
+                      LogicalSort, LogicalTableDual, LogicalTopN)
+from .physical import (PhysicalHashAgg, PhysicalHashJoin, PhysicalLimit,
+                       PhysicalPlan, PhysicalProjection, PhysicalSelection,
+                       PhysicalSort, PhysicalTableDual, PhysicalTableReader,
+                       PhysicalTableScan, PhysicalTopN)
+
+
+# ===== predicate pushdown ===================================================
+
+def predicate_pushdown(p: LogicalPlan,
+                       conds: List[Expression]) -> Tuple[List[Expression], LogicalPlan]:
+    """Push `conds` (from parents) into p; returns (retained, new plan)
+    (reference: rule_predicate_push_down.go PredicatePushDown)."""
+    if isinstance(p, LogicalSelection):
+        child_conds = conds + p.conditions
+        retained, child = predicate_pushdown(p.child(0), child_conds)
+        if retained:
+            return [], LogicalSelection(retained, child)
+        return [], child
+
+    if isinstance(p, LogicalDataSource):
+        p.pushed_conds.extend(conds)
+        p.all_conds = list(p.pushed_conds)
+        return [], p
+
+    if isinstance(p, LogicalProjection):
+        pushable, retained = [], []
+        for c in conds:
+            cols = c.collect_columns()
+            if all(p.schema.column_index(x) >= 0 for x in cols):
+                pushable.append(substitute_column(c, p.schema, p.exprs))
+            else:
+                retained.append(c)
+        r2, child = predicate_pushdown(p.child(0), pushable)
+        p.children[0] = (LogicalSelection(r2, child) if r2 else child)
+        return retained, p
+
+    if isinstance(p, LogicalJoin):
+        lsch, rsch = p.children[0].schema, p.children[1].schema
+        left_push = list(p.left_conditions)
+        right_push = list(p.right_conditions)
+        retained: List[Expression] = []
+        for c in conds:
+            cols = c.collect_columns()
+            on_left = all(lsch.contains(x) for x in cols)
+            on_right = all(rsch.contains(x) for x in cols)
+            if p.tp == JOIN_INNER:
+                if isinstance(c, type(c)) and getattr(c, "name", "") == "=":
+                    a, b = c.children()
+                    ac, bc = a.collect_columns(), b.collect_columns()
+                    if (ac and bc and all(lsch.contains(x) for x in ac)
+                            and all(rsch.contains(x) for x in bc)):
+                        p.eq_conditions.append((a, b))
+                        continue
+                    if (ac and bc and all(rsch.contains(x) for x in ac)
+                            and all(lsch.contains(x) for x in bc)):
+                        p.eq_conditions.append((b, a))
+                        continue
+                if on_left:
+                    left_push.append(c)
+                elif on_right:
+                    right_push.append(c)
+                else:
+                    p.other_conditions.append(c)
+            else:  # left outer join
+                if on_left:
+                    left_push.append(c)
+                elif on_right:
+                    # WHERE cond on right side of LEFT JOIN: NULL rows fail
+                    # the filter anyway, but pushing below the join would
+                    # change which rows get NULL-extended; keep above.
+                    retained.append(c)
+                else:
+                    retained.append(c)
+        p.left_conditions, p.right_conditions = [], []
+        r1, lc = predicate_pushdown(p.children[0], left_push)
+        r2, rc = predicate_pushdown(p.children[1], right_push)
+        p.children[0] = LogicalSelection(r1, lc) if r1 else lc
+        p.children[1] = LogicalSelection(r2, rc) if r2 else rc
+        return retained, p
+
+    if isinstance(p, LogicalAggregation):
+        gb_uids = {c.unique_id for e in p.group_by
+                   for c in ([e] if isinstance(e, Column) else [])}
+        push, retained = [], []
+        for c in conds:
+            cols = c.collect_columns()
+            if cols and all(x.unique_id in gb_uids for x in cols):
+                push.append(c)
+            else:
+                retained.append(c)
+        r, child = predicate_pushdown(p.child(0), push)
+        p.children[0] = LogicalSelection(r, child) if r else child
+        return retained, p
+
+    if isinstance(p, (LogicalSort, LogicalTopN)):
+        r, child = predicate_pushdown(p.child(0), conds)
+        p.children[0] = LogicalSelection(r, child) if r else child
+        return [], p
+
+    if isinstance(p, (LogicalLimit, LogicalTableDual)):
+        for i, c in enumerate(p.children):
+            r, nc = predicate_pushdown(c, [])
+            p.children[i] = LogicalSelection(r, nc) if r else nc
+        return conds, p
+
+    # default: stop pushing
+    for i, c in enumerate(p.children):
+        r, nc = predicate_pushdown(c, [])
+        p.children[i] = LogicalSelection(r, nc) if r else nc
+    return conds, p
+
+
+# ===== column pruning =======================================================
+
+def _cols_of(exprs) -> Set[int]:
+    out: Set[int] = set()
+    for e in exprs:
+        for c in e.collect_columns():
+            out.add(c.unique_id)
+    return out
+
+
+def column_pruning(p: LogicalPlan, needed: Set[int]) -> None:
+    """reference: rule_column_pruning.go PruneColumns."""
+    if isinstance(p, LogicalProjection):
+        keep = [i for i, c in enumerate(p.schema.columns)
+                if c.unique_id in needed]
+        if not keep:
+            keep = [0]
+        p.exprs = [p.exprs[i] for i in keep]
+        p.schema = Schema([p.schema.columns[i] for i in keep])
+        column_pruning(p.child(0), _cols_of(p.exprs))
+        return
+    if isinstance(p, LogicalSelection):
+        column_pruning(p.child(0), needed | _cols_of(p.conditions))
+        p.schema = p.child(0).schema
+        return
+    if isinstance(p, (LogicalSort, LogicalTopN)):
+        column_pruning(p.child(0), needed | _cols_of(e for e, _ in p.by))
+        p.schema = p.child(0).schema
+        return
+    if isinstance(p, LogicalLimit):
+        column_pruning(p.child(0), needed)
+        p.schema = p.child(0).schema
+        return
+    if isinstance(p, LogicalAggregation):
+        keep_idx = [i for i, c in enumerate(p.output_cols)
+                    if c.unique_id in needed]
+        gb_needed = {c.unique_id for c in getattr(p, "gb_out_cols", [])
+                     if c.unique_id in needed}
+        if not keep_idx and not gb_needed and p.agg_funcs:
+            keep_idx = [0]
+        p.agg_funcs = [p.agg_funcs[i] for i in keep_idx]
+        p.output_cols = [p.output_cols[i] for i in keep_idx]
+        new_schema = [c for c in p.schema.columns
+                      if c.unique_id in needed
+                      or any(c.unique_id == oc.unique_id for oc in p.output_cols)]
+        if new_schema:
+            p.schema = Schema(new_schema)
+        child_needed = set()
+        for d in p.agg_funcs:
+            child_needed |= _cols_of(d.args)
+        child_needed |= _cols_of(p.group_by)
+        column_pruning(p.child(0), child_needed)
+        return
+    if isinstance(p, LogicalJoin):
+        used = set(needed)
+        for a, b in p.eq_conditions:
+            used |= _cols_of([a, b])
+        used |= _cols_of(p.other_conditions)
+        used |= _cols_of(p.left_conditions) | _cols_of(p.right_conditions)
+        column_pruning(p.children[0], used)
+        column_pruning(p.children[1], used)
+        p.schema = p.children[0].schema.merge(p.children[1].schema)
+        return
+    if isinstance(p, LogicalDataSource):
+        used = needed | _cols_of(p.pushed_conds)
+        cols = [c for c in p.schema.columns if c.unique_id in used]
+        if not cols:
+            cols = [p.schema.columns[0]]
+        p.schema = Schema(cols)
+        return
+    if isinstance(p, LogicalTableDual):
+        p.schema = Schema([c for c in p.schema.columns if c.unique_id in needed])
+        return
+    for c in p.children:
+        column_pruning(c, needed)
+
+
+# ===== topn pushdown ========================================================
+
+def topn_pushdown(p: LogicalPlan) -> LogicalPlan:
+    """Limit(Sort) -> TopN; TopN pushes through Projection
+    (reference: rule_topn_push_down.go)."""
+    if isinstance(p, LogicalLimit) and isinstance(p.child(0), LogicalSort):
+        s = p.child(0)
+        t = LogicalTopN(s.by, p.offset, p.count, s.child(0))
+        t.schema = s.schema
+        return topn_pushdown(t)
+    if isinstance(p, LogicalTopN) and isinstance(p.child(0), LogicalProjection):
+        proj: LogicalProjection = p.child(0)
+        cols = [c for e, _ in p.by for c in e.collect_columns()]
+        if all(proj.schema.column_index(c) >= 0 for c in cols):
+            new_by = [(substitute_column(e, proj.schema, proj.exprs), d)
+                      for e, d in p.by]
+            t = LogicalTopN(new_by, p.offset, p.count, proj.child(0))
+            t.schema = proj.child(0).schema
+            proj.children[0] = topn_pushdown(t)
+            return proj
+    p.children = [topn_pushdown(c) for c in p.children]
+    return p
+
+
+# ===== logical -> physical ==================================================
+
+def _bind(exprs: List[Expression], schema: Schema) -> List[Expression]:
+    return [e.resolve_indices(schema) for e in exprs]
+
+
+def to_physical(p: LogicalPlan) -> PhysicalPlan:
+    if isinstance(p, LogicalDataSource):
+        with_handle = any(c.name == HANDLE_COL_NAME for c in p.schema.columns)
+        scan = PhysicalTableScan(p.table_info, p.db_name, p.alias, p.schema,
+                                 with_handle)
+        scan.filters = _bind(p.pushed_conds, p.schema)
+        return PhysicalTableReader(scan)
+    if isinstance(p, LogicalSelection):
+        child = to_physical(p.child(0))
+        return PhysicalSelection(_bind(p.conditions, child.schema), child)
+    if isinstance(p, LogicalProjection):
+        child = to_physical(p.child(0))
+        return PhysicalProjection(_bind(p.exprs, child.schema), p.schema, child)
+    if isinstance(p, LogicalAggregation):
+        child = to_physical(p.child(0))
+        gb = _bind(p.group_by, child.schema)
+        aggs = []
+        for d in p.agg_funcs:
+            d2 = d.clone()
+            d2.args = _bind(d.args, child.schema)
+            aggs.append(d2)
+        # map each schema column to ('agg', i) or ('gb', i)
+        output_map: List[Tuple[str, int]] = []
+        for c in p.schema.columns:
+            for i, oc in enumerate(getattr(p, "output_cols", [])):
+                if oc.unique_id == c.unique_id:
+                    output_map.append(("agg", i))
+                    break
+            else:
+                for i, gc in enumerate(getattr(p, "gb_out_cols", [])):
+                    if gc.unique_id == c.unique_id:
+                        output_map.append(("gb", i))
+                        break
+                else:
+                    raise PlanError(f"agg schema column {c!r} unmapped")
+        agg = PhysicalHashAgg(gb, aggs, p.schema, child, [])
+        agg.output_map = output_map
+        return agg
+    if isinstance(p, LogicalJoin):
+        left = to_physical(p.children[0])
+        right = to_physical(p.children[1])
+        join = PhysicalHashJoin(p.tp, left, right, p.schema)
+        join.left_keys = _bind([a for a, _ in p.eq_conditions], left.schema)
+        join.right_keys = _bind([b for _, b in p.eq_conditions], right.schema)
+        join.other_conditions = _bind(p.other_conditions, p.schema)
+        # leftover one-side conds (outer joins keep them at the join)
+        join.left_conditions = _bind(p.left_conditions, left.schema)
+        join.right_conditions = _bind(p.right_conditions, right.schema)
+        return join
+    if isinstance(p, LogicalSort):
+        child = to_physical(p.child(0))
+        by = [(e.resolve_indices(child.schema), d) for e, d in p.by]
+        return PhysicalSort(by, child)
+    if isinstance(p, LogicalTopN):
+        child = to_physical(p.child(0))
+        by = [(e.resolve_indices(child.schema), d) for e, d in p.by]
+        return PhysicalTopN(by, p.offset, p.count, child)
+    if isinstance(p, LogicalLimit):
+        return PhysicalLimit(p.offset, p.count, to_physical(p.child(0)))
+    if isinstance(p, LogicalTableDual):
+        return PhysicalTableDual(p.schema, p.row_count)
+    raise PlanError(f"no physical mapping for {type(p).__name__}")
+
+
+def optimize(logical: LogicalPlan) -> PhysicalPlan:
+    """The System-R style pipeline (reference: planner/core/optimizer.go:77):
+    rule rewrites, then physical conversion."""
+    retained, logical = predicate_pushdown(logical, [])
+    if retained:
+        logical = LogicalSelection(retained, logical)
+    column_pruning(logical, {c.unique_id for c in logical.schema.columns})
+    logical = topn_pushdown(logical)
+    return to_physical(logical)

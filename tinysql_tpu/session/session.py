@@ -1,0 +1,477 @@
+"""Session: the statement lifecycle loop.
+
+Capability parity with reference session/session.go (parse→compile→run
+:569-629, txn lifecycle with lazy TSO :638-663, autocommit handling,
+sysvar get/set :464-523), executor/compiler.go, executor/adapter.go
+(ExecStmt), plus the SHOW / EXPLAIN / ADMIN / SimpleExec statement family
+(executor/show.go, simple.go, set.go, ddl.go, explain.go).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..catalog.infoschema import InfoSchema
+from ..catalog.meta import Meta
+from ..catalog.model import SchemaState, TableInfo
+from ..ddl.ddl import DDL, DDLError
+from ..executor.executors import ExecContext, build_executor
+from ..executor.write import DeleteExec, InsertExec, WriteError
+from ..expression import Constant, Schema
+from ..kv import RetryableError, new_mock_storage
+from ..mytypes import Datum, to_string
+from ..parser import ParseError, ast, parse
+from ..planner.builder import (ExprRewriter, HANDLE_COL_NAME, PlanBuilder,
+                               PlanError)
+from ..planner.logical import LogicalSelection
+from ..planner.optimizer import optimize
+from ..expression import Column as ExprColumn, split_cnf
+from ..mytypes import new_int_type
+
+DEFAULT_SYSVARS: Dict[str, Datum] = {
+    # reference: sessionctx/variable/tidb_vars.go defaults
+    "autocommit": 1,
+    "tidb_max_chunk_size": 1024,
+    "tidb_init_chunk_size": 32,
+    "tidb_hash_join_concurrency": 5,
+    "tidb_projection_concurrency": 4,
+    "tidb_hashagg_partial_concurrency": 4,
+    "tidb_hashagg_final_concurrency": 4,
+    "tidb_distsql_scan_concurrency": 15,
+    "tidb_index_lookup_concurrency": 4,
+    "tidb_use_tpu": 1,           # device enforcer master switch
+    "sql_mode": "STRICT_TRANS_TABLES",
+    "max_execution_time": 0,
+}
+
+
+@dataclass
+class ResultSet:
+    columns: List[str]
+    rows: List[list]
+
+    def __iter__(self):
+        return iter(self.rows)
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class SessionError(Exception):
+    pass
+
+
+class Session:
+    """reference: session/session.go session struct."""
+
+    _GLOBAL_VARS: Dict[int, Dict[str, Datum]] = {}  # per-storage global scope
+
+    def __init__(self, storage, current_db: str = ""):
+        self.storage = storage
+        self.current_db = current_db
+        self.sysvars: Dict[str, Datum] = dict(DEFAULT_SYSVARS)
+        self.uservars: Dict[str, Datum] = {}
+        self._txn = None
+        self._explicit_txn = False
+        self.ddl = self._shared_ddl(storage)
+        self._is: Optional[InfoSchema] = None
+        self.last_affected = 0
+
+    # ---- shared per-storage singletons ---------------------------------
+    @staticmethod
+    def _shared_ddl(storage) -> DDL:
+        d = getattr(storage, "_ddl", None)
+        if d is None:
+            d = storage._ddl = DDL(storage)
+        return d
+
+    def _globals(self) -> Dict[str, Datum]:
+        return Session._GLOBAL_VARS.setdefault(id(self.storage), {})
+
+    # ---- schema cache (reference: domain.Reload; lazy version check) ---
+    def infoschema(self) -> InfoSchema:
+        txn = self.storage.begin()
+        ver = Meta(txn).schema_version()
+        txn.rollback()
+        if self._is is None or self._is.version != ver:
+            self._is = InfoSchema.load(self.storage)
+        return self._is
+
+    # ---- variables ------------------------------------------------------
+    def get_sysvar(self, name: str, scope: str = "") -> Datum:
+        if scope == "global":
+            return self._globals().get(name, DEFAULT_SYSVARS.get(name))
+        return self.sysvars.get(name, self._globals().get(
+            name, DEFAULT_SYSVARS.get(name)))
+
+    def get_uservar(self, name: str) -> Datum:
+        return self.uservars.get(name)
+
+    # ---- txn lifecycle (reference: session/txn.go TxnState) ------------
+    def get_txn(self):
+        if self._txn is None:
+            self._txn = self.storage.begin()
+        return self._txn
+
+    def in_txn(self) -> bool:
+        return self._explicit_txn
+
+    def commit_txn(self) -> None:
+        if self._txn is not None:
+            txn, self._txn = self._txn, None
+            self._explicit_txn = False
+            txn.commit()
+
+    def rollback_txn(self) -> None:
+        if self._txn is not None:
+            self._txn.rollback()
+            self._txn = None
+        self._explicit_txn = False
+
+    def _finish_stmt(self, ok: bool) -> None:
+        """Autocommit boundary (reference: session/tidb.go finishStmt)."""
+        if self._explicit_txn:
+            if not ok:
+                self.rollback_txn()
+            return
+        if ok:
+            self.commit_txn()
+        else:
+            self.rollback_txn()
+
+    # ---- entry -----------------------------------------------------------
+    def execute(self, sql: str) -> List[Optional[ResultSet]]:
+        stmts = parse(sql)
+        return [self._execute_stmt(s) for s in stmts]
+
+    def query(self, sql: str) -> ResultSet:
+        out = [r for r in self.execute(sql) if r is not None]
+        if len(out) != 1:
+            raise SessionError(f"expected one result set, got {len(out)}")
+        return out[0]
+
+    def _execute_stmt(self, stmt: ast.StmtNode) -> Optional[ResultSet]:
+        # statement-level rollback inside an explicit txn (reference:
+        # session/txn.go StmtRollback): a failed statement undoes only its
+        # own buffered writes, the transaction stays open
+        cp = self._txn.checkpoint() if (self._explicit_txn and self._txn) else None
+        try:
+            rs = self._dispatch(stmt)
+            self._finish_stmt(ok=True)
+            return rs
+        except Exception:
+            if cp is not None and self._txn is not None:
+                self._txn.restore(cp)
+            else:
+                self._finish_stmt(ok=False)
+            raise
+
+    # ---- dispatch (reference: planbuilder.go:243 Build switch) ----------
+    def _dispatch(self, stmt: ast.StmtNode) -> Optional[ResultSet]:
+        if isinstance(stmt, ast.SelectStmt):
+            return self._exec_select(stmt)
+        if isinstance(stmt, ast.InsertStmt):
+            return self._exec_insert(stmt)
+        if isinstance(stmt, ast.DeleteStmt):
+            return self._exec_delete(stmt)
+        if isinstance(stmt, (ast.CreateDatabaseStmt, ast.DropDatabaseStmt,
+                             ast.CreateTableStmt, ast.DropTableStmt,
+                             ast.CreateIndexStmt, ast.DropIndexStmt,
+                             ast.AlterTableStmt, ast.TruncateTableStmt)):
+            return self._exec_ddl(stmt)
+        if isinstance(stmt, ast.UseStmt):
+            if not self.infoschema().schema_exists(stmt.db):
+                raise SessionError(f"Unknown database '{stmt.db}'")
+            self.current_db = stmt.db
+            return None
+        if isinstance(stmt, ast.SetStmt):
+            return self._exec_set(stmt)
+        if isinstance(stmt, ast.BeginStmt):
+            self.commit_txn()
+            self._txn = self.storage.begin()
+            self._explicit_txn = True
+            return None
+        if isinstance(stmt, ast.CommitStmt):
+            self.commit_txn()
+            return None
+        if isinstance(stmt, ast.RollbackStmt):
+            self.rollback_txn()
+            return None
+        if isinstance(stmt, ast.ShowStmt):
+            return self._exec_show(stmt)
+        if isinstance(stmt, ast.ExplainStmt):
+            return self._exec_explain(stmt)
+        if isinstance(stmt, ast.AnalyzeTableStmt):
+            return self._exec_analyze(stmt)
+        if isinstance(stmt, ast.AdminStmt):
+            return self._exec_admin(stmt)
+        if isinstance(stmt, ast.EmptyStmt):
+            return None
+        raise SessionError(f"unsupported statement {type(stmt).__name__}")
+
+    # ---- SELECT ---------------------------------------------------------
+    def _exec_select(self, stmt: ast.SelectStmt) -> ResultSet:
+        builder = PlanBuilder(self)
+        logical = builder.build_select(stmt)
+        columns = [c.name for c in logical.schema.columns]
+        phys = optimize(logical)
+        use_tpu = bool(self.get_sysvar("tidb_use_tpu"))
+        ex = build_executor(phys, use_tpu=use_tpu)
+        ex.open(ExecContext(self.get_txn(), self.sysvars,
+                            self.infoschema(), self.storage))
+        try:
+            rows = ex.drain()
+        finally:
+            ex.close()
+        return ResultSet(columns, rows)
+
+    def _run_select_plan(self, stmt: ast.SelectStmt, txn) -> List[list]:
+        builder = PlanBuilder(self)
+        phys = optimize(builder.build_select(stmt))
+        ex = build_executor(phys)
+        ex.open(ExecContext(txn, self.sysvars, self.infoschema(),
+                            self.storage))
+        try:
+            return ex.drain()
+        finally:
+            ex.close()
+
+    def eval_const_expr(self, e: ast.ExprNode) -> Datum:
+        rw = ExprRewriter(Schema([]), PlanBuilder(self))
+        return rw.rewrite(e).eval([])
+
+    # ---- INSERT / DELETE -------------------------------------------------
+    def _exec_insert(self, stmt: ast.InsertStmt) -> None:
+        db = stmt.table.db or self.current_db
+        if not db:
+            raise SessionError("No database selected")
+        info = self.infoschema().table_by_name(db, stmt.table.name)
+        ex = InsertExec(self, stmt, info, db)
+        self.last_affected = ex.execute(self.get_txn())
+        return None
+
+    def _exec_delete(self, stmt: ast.DeleteStmt) -> None:
+        builder = PlanBuilder(self)
+        src = stmt.table
+        ds = builder._build_table_source(src)
+        info = ds.table_info
+        handle_col = ExprColumn(new_int_type(), name=HANDLE_COL_NAME,
+                                table=ds.alias)
+        ds.schema = Schema(ds.schema.columns + [handle_col])
+        plan = ds
+        if stmt.where is not None:
+            rw = ExprRewriter(plan.schema, builder)
+            plan = LogicalSelection(split_cnf(rw.rewrite(stmt.where)), plan)
+        phys = optimize(plan)
+        txn = self.get_txn()
+        ex = build_executor(phys)
+        ex.open(ExecContext(txn, self.sysvars, self.infoschema(),
+                            self.storage))
+        try:
+            rows = ex.drain()
+        finally:
+            ex.close()
+        dex = DeleteExec(self, info)
+        self.last_affected = dex.execute(txn, rows)
+        return None
+
+    # ---- DDL (implicit commit, reference: session commits before DDL) ---
+    def _exec_ddl(self, stmt) -> None:
+        self.commit_txn()
+        d = self.ddl
+        if isinstance(stmt, ast.CreateDatabaseStmt):
+            d.create_database(stmt.name, stmt.if_not_exists)
+        elif isinstance(stmt, ast.DropDatabaseStmt):
+            d.drop_database(stmt.name, stmt.if_exists)
+            if self.current_db.lower() == stmt.name.lower():
+                self.current_db = ""
+        elif isinstance(stmt, ast.CreateTableStmt):
+            db = stmt.table.db or self.current_db
+            if not db:
+                raise SessionError("No database selected")
+            d.create_table(db, stmt)
+        elif isinstance(stmt, ast.DropTableStmt):
+            for tn in stmt.tables:
+                d.drop_table(tn.db or self.current_db, tn.name,
+                             stmt.if_exists)
+        elif isinstance(stmt, ast.CreateIndexStmt):
+            d.add_index(stmt.table.db or self.current_db, stmt.table.name,
+                        stmt.index_name, stmt.columns, stmt.unique)
+        elif isinstance(stmt, ast.DropIndexStmt):
+            d.drop_index(stmt.table.db or self.current_db, stmt.table.name,
+                         stmt.index_name)
+        elif isinstance(stmt, ast.TruncateTableStmt):
+            d.truncate_table(stmt.table.db or self.current_db,
+                             stmt.table.name)
+        elif isinstance(stmt, ast.AlterTableStmt):
+            db = stmt.table.db or self.current_db
+            for spec in stmt.specs:
+                if spec.tp == "add_column":
+                    d.add_column(db, stmt.table.name, spec.column)
+                elif spec.tp == "drop_column":
+                    d.drop_column(db, stmt.table.name, spec.name)
+                elif spec.tp == "add_index":
+                    cons = spec.constraint
+                    d.add_index(db, stmt.table.name, cons.name,
+                                [(c[0], c[1]) for c in
+                                 [(ic.name, ic.length) for ic in cons.columns]],
+                                cons.tp == "unique")
+                elif spec.tp == "drop_index":
+                    d.drop_index(db, stmt.table.name, spec.name)
+        self._is = None  # force schema cache reload
+        return None
+
+    # ---- SET -------------------------------------------------------------
+    def _exec_set(self, stmt: ast.SetStmt) -> None:
+        for scope, name, expr in stmt.assignments:
+            v = self.eval_const_expr(expr)
+            if scope == "user":
+                self.uservars[name] = v
+            elif scope == "global":
+                self._globals()[name] = v
+            else:
+                self.sysvars[name] = v
+        return None
+
+    # ---- SHOW (reference: executor/show.go) ------------------------------
+    def _exec_show(self, stmt: ast.ShowStmt) -> ResultSet:
+        from ..expression import like_to_regex
+        pat = like_to_regex(stmt.pattern) if stmt.pattern else None
+        isc = self.infoschema()
+        if stmt.tp == "databases":
+            names = sorted(d.name for d in isc.all_schemas())
+            rows = [[n] for n in names if pat is None or pat.match(n)]
+            return ResultSet(["Database"], rows)
+        if stmt.tp == "tables":
+            db = stmt.db or self.current_db
+            if not db:
+                raise SessionError("No database selected")
+            names = sorted(t.name for t in isc.schema_tables(db)
+                           if t.state == SchemaState.PUBLIC)
+            rows = [[n] for n in names if pat is None or pat.match(n)]
+            return ResultSet([f"Tables_in_{db}"], rows)
+        if stmt.tp == "columns":
+            db = stmt.table.db or stmt.db or self.current_db
+            t = isc.table_by_name(db, stmt.table.name)
+            rows = []
+            for c in t.public_columns():
+                tp = c.ft.type_name()
+                if c.ft.flen >= 0 and tp in ("varchar", "char"):
+                    tp = f"{tp}({c.ft.flen})"
+                null = "NO" if c.ft.not_null else "YES"
+                key = ("PRI" if c.ft.flag & 0x2 else
+                       ("UNI" if c.ft.flag & 0x4 else ""))
+                rows.append([c.name, tp, null, key,
+                             to_string(c.default), ""])
+            return ResultSet(["Field", "Type", "Null", "Key", "Default",
+                              "Extra"], rows)
+        if stmt.tp == "create_table":
+            db = stmt.table.db or self.current_db
+            t = isc.table_by_name(db, stmt.table.name)
+            return ResultSet(["Table", "Create Table"],
+                             [[t.name, _show_create_table(t)]])
+        if stmt.tp == "indexes":
+            db = stmt.table.db or self.current_db
+            t = isc.table_by_name(db, stmt.table.name)
+            rows = []
+            for idx in t.public_indices():
+                for seq, ic in enumerate(idx.columns):
+                    rows.append([t.name, 0 if idx.unique else 1, idx.name,
+                                 seq + 1, ic.name])
+            return ResultSet(["Table", "Non_unique", "Key_name",
+                              "Seq_in_index", "Column_name"], rows)
+        if stmt.tp == "variables":
+            merged = dict(DEFAULT_SYSVARS)
+            merged.update(self._globals())
+            if not stmt.global_scope:
+                merged.update(self.sysvars)
+            rows = [[k, to_string(v)] for k, v in sorted(merged.items())
+                    if pat is None or pat.match(k)]
+            return ResultSet(["Variable_name", "Value"], rows)
+        raise SessionError(f"unsupported SHOW {stmt.tp}")
+
+    # ---- EXPLAIN ---------------------------------------------------------
+    def _exec_explain(self, stmt: ast.ExplainStmt) -> ResultSet:
+        if not isinstance(stmt.stmt, ast.SelectStmt):
+            raise SessionError("EXPLAIN supports SELECT only for now")
+        builder = PlanBuilder(self)
+        phys = optimize(builder.build_select(stmt.stmt))
+        from ..planner.explain import explain_text
+        rows = explain_text(phys)
+        return ResultSet(["id", "task", "operator info"], rows)
+
+    # ---- ANALYZE (stats phase wires this up) ----------------------------
+    def _exec_analyze(self, stmt: ast.AnalyzeTableStmt) -> None:
+        from ..statistics.analyze import analyze_table
+        for tn in stmt.tables:
+            db = tn.db or self.current_db
+            info = self.infoschema().table_by_name(db, tn.name)
+            analyze_table(self, info)
+        return None
+
+    # ---- ADMIN -----------------------------------------------------------
+    def _exec_admin(self, stmt: ast.AdminStmt) -> ResultSet:
+        txn = self.storage.begin()
+        m = Meta(txn)
+        if stmt.tp in ("show_ddl", "show_ddl_jobs"):
+            jobs = m.history_jobs()[-20:]
+            queued = m._load_queue()
+            txn.rollback()
+            rows = []
+            for j in reversed(queued):
+                rows.append([j.id, j.tp.name, j.schema_id, j.table_id,
+                             j.state.name, j.row_count, j.error or ""])
+            for j in reversed(jobs):
+                rows.append([j.id, j.tp.name, j.schema_id, j.table_id,
+                             j.state.name, j.row_count, j.error or ""])
+            return ResultSet(["JOB_ID", "TYPE", "SCHEMA_ID", "TABLE_ID",
+                              "STATE", "ROW_COUNT", "ERROR"], rows)
+        if stmt.tp == "check_table":
+            txn.rollback()
+            from ..executor.admin import check_table
+            for tn in stmt.tables:
+                db = tn.db or self.current_db
+                info = self.infoschema().table_by_name(db, tn.name)
+                check_table(self.storage, info)
+            return ResultSet(["Result"], [["OK"]])
+        txn.rollback()
+        raise SessionError(f"unsupported ADMIN {stmt.tp}")
+
+
+def _show_create_table(t: TableInfo) -> str:
+    parts = []
+    for c in t.public_columns():
+        tp = c.ft.type_name()
+        if c.ft.flen >= 0 and tp in ("varchar", "char"):
+            tp = f"{tp}({c.ft.flen})"
+        s = f"  `{c.name}` {tp}"
+        if c.ft.is_unsigned:
+            s += " unsigned"
+        if c.ft.not_null:
+            s += " NOT NULL"
+        if c.default is not None:
+            s += f" DEFAULT '{c.default}'"
+        if c.ft.flag & 0x200:
+            s += " AUTO_INCREMENT"
+        parts.append(s)
+    pk = t.get_pk_handle_col()
+    if pk is not None:
+        parts.append(f"  PRIMARY KEY (`{pk.name}`)")
+    for idx in t.public_indices():
+        cols = ", ".join(f"`{ic.name}`" for ic in idx.columns)
+        if idx.primary:
+            parts.append(f"  PRIMARY KEY ({cols})")
+        elif idx.unique:
+            parts.append(f"  UNIQUE KEY `{idx.name}` ({cols})")
+        else:
+            parts.append(f"  KEY `{idx.name}` ({cols})")
+    body = ",\n".join(parts)
+    return f"CREATE TABLE `{t.name}` (\n{body}\n)"
+
+
+def new_session(storage=None, db: str = "") -> Session:
+    """Bootstrap entry (reference: session.BootstrapSession +
+    CreateSession)."""
+    if storage is None:
+        storage = new_mock_storage()
+    return Session(storage, db)

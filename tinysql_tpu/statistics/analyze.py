@@ -1,0 +1,26 @@
+"""ANALYZE TABLE collection — placeholder until the statistics phase lands
+(histograms + CMSketch + FMSketch per SURVEY §2.10).  Collects row counts so
+the planner's stats hooks have something real immediately."""
+from __future__ import annotations
+
+from typing import Dict
+
+from ..catalog.model import TableInfo
+from ..catalog.table import Table
+
+# per-storage, per-table basic stats (row counts) until the full Handle
+# (statistics/handle.py) replaces this
+_BASIC: Dict[int, Dict[int, int]] = {}
+
+
+def analyze_table(session, info: TableInfo) -> None:
+    txn = session.storage.begin()
+    try:
+        n = sum(1 for _ in Table(info).iter_records(txn))
+    finally:
+        txn.rollback()
+    _BASIC.setdefault(id(session.storage), {})[info.id] = n
+
+
+def table_row_count(storage, table_id: int) -> int:
+    return _BASIC.get(id(storage), {}).get(table_id, 0)

@@ -1,0 +1,63 @@
+"""TestKit: the SQL-level integration-test fixture.
+
+Capability parity with reference util/testkit/testkit.go:23-60 —
+MustExec / MustQuery().Check(rows) against an in-process session on mock
+storage; the dominant test pattern across the reference suite.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..mytypes import to_string
+from ..session.session import Session, new_session
+
+
+def rows(*lines: str) -> List[List[str]]:
+    """reference: testkit.Rows — each line is space-separated fields."""
+    return [line.split() for line in lines]
+
+
+class QueryResult:
+    def __init__(self, columns, data):
+        self.columns = columns
+        self.data = data
+
+    def check(self, expected: List[List[str]]) -> None:
+        got = self.sorted_str() if False else self.as_str()
+        if got != expected:
+            raise AssertionError(
+                f"query result mismatch:\n got: {got}\nwant: {expected}")
+
+    def check_sorted(self, expected: List[List[str]]) -> None:
+        got = sorted(self.as_str())
+        if got != sorted(expected):
+            raise AssertionError(
+                f"query result mismatch (sorted):\n got: {got}\nwant: {expected}")
+
+    def as_str(self) -> List[List[str]]:
+        return [[("<nil>" if v is None else to_string(v)) for v in row]
+                for row in self.data]
+
+    def sorted_str(self):
+        return sorted(self.as_str())
+
+
+class TestKit:
+    __test__ = False  # not a pytest class
+
+    def __init__(self, storage=None, db: str = ""):
+        self.session: Session = new_session(storage, db)
+
+    def must_exec(self, sql: str) -> None:
+        self.session.execute(sql)
+
+    def must_query(self, sql: str) -> QueryResult:
+        rs = self.session.query(sql)
+        return QueryResult(rs.columns, rs.rows)
+
+    def exec_err(self, sql: str) -> Exception:
+        try:
+            self.session.execute(sql)
+        except Exception as e:
+            return e
+        raise AssertionError(f"expected error for {sql!r}")
