@@ -1,10 +1,22 @@
 """Test env: force JAX onto a virtual 8-device CPU mesh so multi-chip
 sharding paths run in CI without TPU hardware (SURVEY §2.7's mocktikv trick,
-TPU edition).  Must run before jax is imported anywhere."""
+TPU edition).
+
+The runner image ships an `axon` PJRT plugin registered from sitecustomize
+at interpreter startup, which sets jax_platforms="axon,cpu" *in config* —
+overriding any later JAX_PLATFORMS env var and force-initialising the TPU
+tunnel on first backend use (it hangs when the relay is down).  Tests must
+be hermetic and bit-deterministic, so we override the config value itself
+before any backend is initialised.
+"""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses
+
+import jax  # noqa: E402  (sitecustomize already imported it anyway)
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -11,6 +11,10 @@ def tk():
     t = TestKit()
     t.must_exec("create database test")
     t.must_exec("use test")
+    # CPU tier: fast and deterministic; the TPU tier is oracle-tested in
+    # test_tpu_ops.py against this exact CPU behavior
+    t.must_exec("set @@global.tidb_use_tpu = 0")
+    t.must_exec("set @@tidb_use_tpu = 0")
     return t
 
 

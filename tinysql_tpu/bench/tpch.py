@@ -1,0 +1,140 @@
+"""TPC-H data generation + schema + Q1/Q3/Q6 (BASELINE.json configs).
+
+Numpy-vectorized generator with TPC-H-shaped cardinalities (SF=1:
+6M lineitem / 1.5M orders / 150k customer), loaded through the columnar
+bulk-ingest path (columnar/store.py).  Dates are 'YYYY-MM-DD' strings
+(lexicographic compare == date compare), matching the engine's 3-family
+type system (SURVEY §0.2 — no DATE type in the reference either).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+SCHEMAS = {
+    "customer": """create table customer (
+        c_custkey bigint primary key,
+        c_mktsegment varchar(10),
+        c_nationkey bigint,
+        c_acctbal double)""",
+    "orders": """create table orders (
+        o_orderkey bigint primary key,
+        o_custkey bigint,
+        o_orderstatus varchar(1),
+        o_totalprice double,
+        o_orderdate varchar(10),
+        o_shippriority bigint)""",
+    "lineitem": """create table lineitem (
+        l_id bigint primary key,
+        l_orderkey bigint,
+        l_quantity double,
+        l_extendedprice double,
+        l_discount double,
+        l_tax double,
+        l_returnflag varchar(1),
+        l_linestatus varchar(1),
+        l_shipdate varchar(10))""",
+}
+
+Q1 = """select l_returnflag, l_linestatus,
+    sum(l_quantity) as sum_qty,
+    sum(l_extendedprice) as sum_base_price,
+    sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+    sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+    avg(l_quantity) as avg_qty,
+    avg(l_extendedprice) as avg_price,
+    avg(l_discount) as avg_disc,
+    count(*) as count_order
+from lineitem
+where l_shipdate <= '1998-09-02'
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus"""
+
+Q3 = """select l_orderkey,
+    sum(l_extendedprice * (1 - l_discount)) as revenue,
+    o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING'
+  and c_custkey = o_custkey
+  and l_orderkey = o_orderkey
+  and o_orderdate < '1995-03-15'
+  and l_shipdate > '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate
+limit 10"""
+
+Q6 = """select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= '1994-01-01'
+  and l_shipdate < '1995-01-01'
+  and l_discount between 0.05 and 0.07
+  and l_quantity < 24"""
+
+QUERIES = {"Q1": Q1, "Q3": Q3, "Q6": Q6}
+
+_SEGMENTS = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE",
+                      "MACHINERY", "HOUSEHOLD"])
+_EPOCH = np.datetime64("1992-01-01")
+
+
+def _dates(rng, n, lo_days=0, hi_days=2405):
+    days = rng.integers(lo_days, hi_days, n)
+    return (_EPOCH + days.astype("timedelta64[D]")).astype("datetime64[D]").astype(str)
+
+
+def generate(sf: float = 1.0, seed: int = 7):
+    """Returns {table: {col: ndarray}} at scale factor sf."""
+    rng = np.random.default_rng(seed)
+    n_cust = int(150_000 * sf)
+    n_ord = int(1_500_000 * sf)
+    n_li_avg = 4  # ~6M lineitems at SF=1
+
+    customer = {
+        "c_custkey": np.arange(1, n_cust + 1, dtype=np.int64),
+        "c_mktsegment": _SEGMENTS[rng.integers(0, len(_SEGMENTS), n_cust)],
+        "c_nationkey": rng.integers(0, 25, n_cust).astype(np.int64),
+        "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_cust), 2),
+    }
+
+    o_orderdate = _dates(rng, n_ord)
+    orders = {
+        "o_orderkey": np.arange(1, n_ord + 1, dtype=np.int64),
+        "o_custkey": rng.integers(1, n_cust + 1, n_ord).astype(np.int64),
+        "o_orderstatus": np.array(["O", "F", "P"])[rng.integers(0, 3, n_ord)],
+        "o_totalprice": np.round(rng.uniform(800.0, 500_000.0, n_ord), 2),
+        "o_orderdate": o_orderdate,
+        "o_shippriority": np.zeros(n_ord, dtype=np.int64),
+    }
+
+    per_order = rng.integers(1, 2 * n_li_avg, n_ord)
+    l_orderkey = np.repeat(orders["o_orderkey"], per_order)
+    n_li = len(l_orderkey)
+    ship_delay = rng.integers(1, 122, n_li).astype("timedelta64[D]")
+    base_date = np.repeat(o_orderdate, per_order).astype("datetime64[D]")
+    l_shipdate = (base_date + ship_delay).astype(str)
+    lineitem = {
+        "l_id": np.arange(1, n_li + 1, dtype=np.int64),
+        "l_orderkey": l_orderkey,
+        "l_quantity": rng.integers(1, 51, n_li).astype(np.float64),
+        "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, n_li), 2),
+        "l_discount": np.round(rng.integers(0, 11, n_li) * 0.01, 2),
+        "l_tax": np.round(rng.integers(0, 9, n_li) * 0.01, 2),
+        "l_returnflag": np.array(["A", "N", "R"])[rng.integers(0, 3, n_li)],
+        "l_linestatus": np.array(["O", "F"])[rng.integers(0, 2, n_li)],
+        "l_shipdate": l_shipdate,
+    }
+    return {"customer": customer, "orders": orders, "lineitem": lineitem}
+
+
+def load(session, sf: float = 1.0, seed: int = 7) -> dict:
+    """Create schemas + columnar bulk-load (returns row counts)."""
+    from ..columnar.store import bulk_load
+    data = generate(sf, seed)
+    session.execute("create database if not exists tpch")
+    session.execute("use tpch")
+    counts = {}
+    for name, ddl in SCHEMAS.items():
+        session.execute(f"drop table if exists {name}")
+        session.execute(ddl)
+        info = session.infoschema().table_by_name("tpch", name)
+        counts[name] = bulk_load(session.storage, info, data[name])
+    return counts

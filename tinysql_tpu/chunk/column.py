@@ -123,7 +123,7 @@ class Column:
             return iv
         if et is EvalType.REAL:
             return float(v)
-        return v  # str
+        return str(v)  # normalize np.str_ -> str
 
     def is_null(self, i: int) -> bool:
         return bool(self._null[i])

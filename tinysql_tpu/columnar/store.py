@@ -1,0 +1,178 @@
+"""Columnar table store — the analytics fast path.
+
+TPU-first design decision (no direct reference counterpart; the TiFlash
+analogue of TiDB's row store): analytical scans read contiguous numpy
+columns that marshal straight onto device HBM, instead of decoding
+rowcodec values row-by-row.  The row-oriented KV + 2PC path (SURVEY §2.6)
+remains the write path and source of truth; this store is a cache/replica:
+
+- `bulk_load` ingests whole tables column-wise (the LOAD DATA analogue).
+- A full KV scan hydrates the cache as a side effect.
+- Any committed write touching a table bumps its data version
+  (hooked in the 2PC committer), invalidating the replica.
+- A transaction may read the replica only if it has no buffered writes on
+  the table and the replica was built from data unchanged since the txn's
+  snapshot.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..catalog.model import TableInfo
+from ..mytypes import EvalType
+
+
+@dataclass
+class ColumnarTable:
+    table_id: int
+    n_rows: int
+    built_ts: int                  # oracle ts when built
+    data_version: int              # storage table-version at build
+    # col_id -> (values ndarray, null ndarray); handles as int64 array
+    columns: Dict[int, Tuple[np.ndarray, np.ndarray]] = field(default_factory=dict)
+    handles: Optional[np.ndarray] = None
+
+
+class ColumnarStore:
+    def __init__(self):
+        self._tables: Dict[int, ColumnarTable] = {}
+        self._mu = threading.Lock()
+
+    def get(self, table_id: int) -> Optional[ColumnarTable]:
+        with self._mu:
+            return self._tables.get(table_id)
+
+    def put(self, tbl: ColumnarTable) -> None:
+        with self._mu:
+            self._tables[tbl.table_id] = tbl
+
+    def invalidate(self, table_id: int) -> None:
+        with self._mu:
+            self._tables.pop(table_id, None)
+
+
+def store_of(storage) -> ColumnarStore:
+    s = getattr(storage, "_columnar", None)
+    if s is None:
+        s = storage._columnar = ColumnarStore()
+    return s
+
+
+def table_data_version(storage, table_id: int) -> int:
+    versions = getattr(storage, "_table_versions", None)
+    if versions is None:
+        versions = storage._table_versions = {}
+    return versions.get(table_id, (0, 0))[0]
+
+
+def table_version_ts(storage, table_id: int) -> int:
+    """Oracle ts at which the table's data version was last bumped: a
+    snapshot at/after this ts sees all data of the current version."""
+    versions = getattr(storage, "_table_versions", None)
+    if versions is None:
+        versions = storage._table_versions = {}
+    return versions.get(table_id, (0, 0))[1]
+
+
+def bump_table_version(storage, table_id: int) -> None:
+    versions = getattr(storage, "_table_versions", None)
+    if versions is None:
+        versions = storage._table_versions = {}
+    ver = versions.get(table_id, (0, 0))[0]
+    versions[table_id] = (ver + 1, storage.current_version())
+    store_of(storage).invalidate(table_id)
+
+
+def replica_for_read(storage, txn, table_id: int) -> Optional[ColumnarTable]:
+    """The replica is readable by `txn` iff it reflects exactly the data the
+    txn's snapshot would see and the txn has no own writes on the table."""
+    rep = store_of(storage).get(table_id)
+    if rep is None:
+        return None
+    if rep.data_version != table_data_version(storage, table_id):
+        return None
+    if txn is not None and rep.built_ts > txn.start_ts:
+        return None  # built from newer data than the snapshot
+    if txn is not None and _txn_touches_table(txn, table_id):
+        return None
+    return rep
+
+
+def _txn_touches_table(txn, table_id: int) -> bool:
+    from ..codec import tablecodec
+    prefix = tablecodec.encode_table_prefix(table_id)
+    for k, _ in txn.us.buffer.iter_range(prefix, prefix + b"\xff" * 20):
+        return True
+    return False
+
+
+def _np_dtype(et: EvalType):
+    if et is EvalType.INT:
+        return np.int64
+    if et is EvalType.REAL:
+        return np.float64
+    return object
+
+
+def bulk_load(storage, info: TableInfo,
+              data: Dict[str, np.ndarray],
+              nulls: Optional[Dict[str, np.ndarray]] = None,
+              handles: Optional[np.ndarray] = None) -> int:
+    """Columnar bulk ingest (LOAD DATA analogue): columns keyed by name.
+    Writes the replica AND the row-store contract metadata (row count via
+    handles).  Returns n_rows."""
+    nulls = nulls or {}
+    cols: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+    n = None
+    for c in info.public_columns():
+        if c.name not in data:
+            raise ValueError(f"bulk_load missing column {c.name}")
+        v = np.asarray(data[c.name])
+        dt = _np_dtype(c.ft.eval_type)
+        if dt is object:
+            # keep fixed-width <U dtype: string filters vectorize in C
+            if v.dtype.kind != "U":
+                v = v.astype(str)
+        else:
+            v = v.astype(dt)
+        m = np.asarray(nulls.get(c.name, np.zeros(len(v), dtype=bool)),
+                       dtype=bool)
+        if n is None:
+            n = len(v)
+        assert len(v) == n and len(m) == n
+        cols[c.id] = (v, m)
+    if handles is None:
+        handles = np.arange(1, (n or 0) + 1, dtype=np.int64)
+    ver = table_data_version(storage, info.id)
+    rep = ColumnarTable(info.id, n or 0, storage.current_version(), ver,
+                        cols, np.asarray(handles, dtype=np.int64))
+    store_of(storage).put(rep)
+    return n or 0
+
+
+def hydrate_from_scan(storage, txn, info: TableInfo,
+                      col_ids: List[int],
+                      arrays: Dict[int, Tuple[np.ndarray, np.ndarray]],
+                      handles: np.ndarray) -> None:
+    """Cache the result of a completed full scan (only when the txn could
+    have used a replica, i.e. it had no own writes).
+
+    Staleness gate: the scan saw data as of txn.start_ts.  If the table's
+    version was bumped AFTER that snapshot, the scan is missing newer
+    committed rows and must not be published under the current version."""
+    if _txn_touches_table(txn, info.id):
+        return
+    if txn.start_ts < table_version_ts(storage, info.id):
+        return  # snapshot predates the current data version
+    existing = store_of(storage).get(info.id)
+    ver = table_data_version(storage, info.id)
+    if existing is not None and existing.data_version == ver:
+        existing.columns.update(arrays)
+        return
+    rep = ColumnarTable(info.id, len(handles), txn.start_ts, ver,
+                        dict(arrays), handles)
+    store_of(storage).put(rep)

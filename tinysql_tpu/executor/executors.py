@@ -19,7 +19,7 @@ import numpy as np
 from ..catalog.table import Table
 from ..chunk import Chunk, MAX_CHUNK_SIZE
 from ..expression import Schema, vectorized_filter
-from ..mytypes import sort_key
+from ..mytypes import EvalType, sort_key
 from ..planner.builder import HANDLE_COL_NAME
 from ..planner.physical import (PhysicalHashAgg, PhysicalHashJoin,
                                 PhysicalLimit, PhysicalPlan,
@@ -82,25 +82,62 @@ class TableReaderExec(Executor):
         self.scan = plan.scan
         self._iter = None
 
+    FAST_CHUNK = 1 << 16  # columnar-replica slice size
+
     def open(self, ctx: ExecContext) -> None:
         super().open(ctx)
         info = self.scan.table_info
         self._tbl = Table(info)
         # decode set: the real columns in schema order (handle -> None)
         self._decode_cols = []
-        self._handle_slots = []
-        for i, c in enumerate(self.scan.schema.columns):
+        for c in self.scan.schema.columns:
             if c.name == HANDLE_COL_NAME:
-                self._handle_slots.append(i)
                 self._decode_cols.append(None)
             else:
                 ci = info.find_column(c.name)
                 assert ci is not None, f"column {c.name} missing in {info.name}"
                 self._decode_cols.append(ci)
         self._real_cols = [ci for ci in self._decode_cols if ci is not None]
-        self._iter = self._tbl.iter_records(ctx.txn, cols=self._real_cols)
+        # columnar replica fast path (columnar/store.py)
+        self._replica = None
+        self._pos = 0
+        if ctx.storage is not None:
+            from ..columnar.store import replica_for_read
+            rep = replica_for_read(ctx.storage, ctx.txn, info.id)
+            if rep is not None and all(ci.id in rep.columns
+                                       for ci in self._real_cols):
+                self._replica = rep
+        self._iter = None
+        self._hydrate = None
+        if self._replica is None:
+            self._iter = self._tbl.iter_records(ctx.txn, cols=self._real_cols)
+            if (ctx.storage is not None and self.scan.ranges is None
+                    and self._real_cols):
+                self._hydrate = {"handles": [], "rows": []}
 
     def next(self) -> Optional[Chunk]:
+        if self._replica is not None:
+            return self._next_fast()
+        return self._next_scan()
+
+    def _next_fast(self) -> Optional[Chunk]:
+        rep = self._replica
+        if self._pos >= rep.n_rows:
+            return None
+        lo, hi = self._pos, min(self._pos + self.FAST_CHUNK, rep.n_rows)
+        self._pos = hi
+        from ..chunk import Column as CCol
+        cols = []
+        for c, ci in zip(self.scan.schema.columns, self._decode_cols):
+            if ci is None:
+                cols.append(CCol.from_numpy(c.ret_type, rep.handles[lo:hi]))
+            else:
+                v, m = rep.columns[ci.id]
+                cols.append(CCol.from_numpy(c.ret_type, v[lo:hi], m[lo:hi]))
+        chk = Chunk.from_columns(cols)
+        return self._apply_filters(chk)
+
+    def _next_scan(self) -> Optional[Chunk]:
         if self._iter is None:
             return None
         limit = self.ctx.max_chunk_size
@@ -112,20 +149,61 @@ class TableReaderExec(Executor):
             for ci in self._decode_cols:
                 vals.append(handle if ci is None else next(it))
             chk.append_row(vals)
+            if self._hydrate is not None:
+                self._hydrate["handles"].append(handle)
+                self._hydrate["rows"].append(row)
             n += 1
             if n >= limit:
                 break
         if n == 0:
             self._iter = None
+            self._finish_hydrate()
             return None
+        return self._apply_filters(chk)
+
+    def _apply_filters(self, chk: Chunk) -> Chunk:
         if self.scan.filters:
             mask = vectorized_filter(self.scan.filters, chk)
             chk.set_sel(np.nonzero(mask)[0])
             chk = chk.compact()
         return chk
 
+    def _finish_hydrate(self) -> None:
+        """A completed full scan hydrates the columnar replica so the next
+        analytical query skips row decode entirely."""
+        h = self._hydrate
+        self._hydrate = None
+        if h is None:
+            return
+        from ..columnar.store import hydrate_from_scan
+        handles = np.asarray(h["handles"], dtype=np.int64)
+        arrays = {}
+        for j, ci in enumerate(self._real_cols):
+            vals = [r[j] for r in h["rows"]]
+            null = np.array([v is None for v in vals], dtype=bool)
+            et = ci.ft.eval_type
+            if et is EvalType.STRING:
+                arr = np.array(["" if v is None else v for v in vals],
+                               dtype=str)  # fixed-width <U: C-speed filters
+            else:
+                dt = np.int64 if et is EvalType.INT else np.float64
+                if et is EvalType.INT:
+                    # unsigned values wrap two's-complement into the int64
+                    # buffer, same as Column.append
+                    vals = [0 if v is None else
+                            (v - (1 << 64) if v >= (1 << 63) else v)
+                            for v in vals]
+                else:
+                    vals = [0 if v is None else v for v in vals]
+                arr = np.array(vals, dtype=dt)
+            arrays[ci.id] = (arr, null)
+        hydrate_from_scan(self.ctx.storage, self.ctx.txn,
+                          self.scan.table_info, [c.id for c in self._real_cols],
+                          arrays, handles)
+
     def close(self) -> None:
         self._iter = None
+        self._hydrate = None
         super().close()
 
 
@@ -199,27 +277,25 @@ class HashAggExec(Executor):
                 break
             chk = chk.compact()
             n = chk.num_rows()
-            # vectorized group key computation
-            key_cols = []
-            for e in plan.group_by:
-                v, null = e.vec_eval(chk)
-                key_cols.append((v, null))
+            # vectorized group key computation (unsigned ints live wrapped
+            # in the int64 buffers — unwrap to semantic python values here)
+            key_cols = [(*e.vec_eval(chk), _uns_of(e))
+                        for e in plan.group_by]
             # agg arg values, vectorized
             arg_cols = []
             for d in plan.aggs:
-                arg_cols.append([a.vec_eval(chk) for a in d.args])
+                arg_cols.append([(*a.vec_eval(chk), _uns_of(a))
+                                 for a in d.args])
             for i in range(n):
-                key = tuple(None if null[i] else
-                            (v[i].item() if hasattr(v[i], "item") else v[i])
-                            for v, null in key_cols)
+                key = tuple(_semantic(v, null, i, u)
+                            for v, null, u in key_cols)
                 st = groups.get(key)
                 if st is None:
                     st = groups[key] = [new_state(d) for d in plan.aggs]
                     gb_vals[key] = list(key)
                 for d_idx, d in enumerate(plan.aggs):
-                    vals = [None if null[i] else
-                            (v[i].item() if hasattr(v[i], "item") else v[i])
-                            for v, null in arg_cols[d_idx]]
+                    vals = [_semantic(v, null, i, u)
+                            for v, null, u in arg_cols[d_idx]]
                     st[d_idx].update(vals)
         if not groups and not plan.group_by:
             # empty input, no GROUP BY: one row (COUNT()=0, SUM()=NULL)
@@ -265,12 +341,10 @@ class HashJoinExec(Executor):
                 mask = vectorized_filter(plan.right_conditions, chk)
                 chk.set_sel(np.nonzero(mask)[0])
                 chk = chk.compact()
-            keys = [e.vec_eval(chk) for e in plan.right_keys]
+            keys = [(*e.vec_eval(chk), _uns_of(e)) for e in plan.right_keys]
             for i in range(chk.num_rows()):
                 row = chk.get_row(i)
-                key = tuple(None if null[i] else
-                            (v[i].item() if hasattr(v[i], "item") else v[i])
-                            for v, null in keys)
+                key = tuple(_semantic(v, null, i, u) for v, null, u in keys)
                 if any(k is None for k in key):
                     continue  # NULL never equi-matches
                 idx = len(self._build_rows)
@@ -295,12 +369,10 @@ class HashJoinExec(Executor):
                 mask = vectorized_filter(plan.left_conditions, chk)
                 chk.set_sel(np.nonzero(mask)[0])
                 chk = chk.compact()
-            keys = [e.vec_eval(chk) for e in plan.left_keys]
+            keys = [(*e.vec_eval(chk), _uns_of(e)) for e in plan.left_keys]
             for i in range(chk.num_rows()):
                 lrow = chk.get_row(i)
-                key = tuple(None if null[i] else
-                            (v[i].item() if hasattr(v[i], "item") else v[i])
-                            for v, null in keys)
+                key = tuple(_semantic(v, null, i, u) for v, null, u in keys)
                 matches = [] if any(k is None for k in key) \
                     else self._table.get(key, [])
                 matched = False
@@ -319,6 +391,22 @@ class HashJoinExec(Executor):
     def _others_ok(self, joined_row) -> bool:
         from ..expression import eval_bool_scalar
         return eval_bool_scalar(self.plan.other_conditions, joined_row)
+
+
+def _uns_of(e) -> bool:
+    """INT expression whose int64 buffer holds wrapped uint64 values."""
+    return (e.eval_type is EvalType.INT
+            and getattr(e.ret_type, "is_unsigned", False))
+
+
+def _semantic(v, null, i: int, uns: bool):
+    """Buffer cell -> semantic python value (unwraps wrapped unsigned)."""
+    if null[i]:
+        return None
+    x = v[i].item() if hasattr(v[i], "item") else v[i]
+    if uns and isinstance(x, int) and x < 0:
+        x += 1 << 64
+    return x
 
 
 def _sort_keys_for_rows(by, chk: Chunk):
@@ -393,6 +481,8 @@ def _argsort_chunk(by, chk: Chunk) -> np.ndarray:
                 with np.errstate(over="ignore"):
                     if vv.dtype == np.uint64:
                         vv = np.iinfo(np.uint64).max - vv  # order-reversing
+                    elif vv.dtype == np.int64:
+                        vv = ~vv  # overflow-free (-v overflows at int64 min)
                     else:
                         vv = -vv
                 rank = np.where(null, 1, 0).astype(np.int8)  # NULL last

@@ -1,0 +1,444 @@
+"""TPU executor tier: the north-star operators.
+
+Capability parity with BASELINE.json: TPU-backed HashAgg / HashJoin /
+Sort / TopN / Projection / Selection registered behind the same volcano
+interface as the CPU tier — marshalling chunk columns to device arrays
+(SURVEY §2.9 note: Column {data, null} maps 1:1 onto array + mask), running
+ops/kernels.py sort/segment kernels, and materializing results back.
+
+String group/sort keys ride order-preserving dictionary codes built on the
+host (np.unique), so TPC-H-style char keys still hit the device path.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..chunk import Chunk, Column as CCol, MAX_CHUNK_SIZE
+from ..expression import vectorized_filter
+from ..expression.aggregation import (AGG_AVG, AGG_COUNT, AGG_FIRST_ROW,
+                                      AGG_MAX, AGG_MIN, AGG_SUM)
+from ..mytypes import EvalType, new_real_type
+from ..ops import kernels
+from ..ops.exprjit import compile_expr, compile_filter
+from ..planner.physical import (PhysicalHashAgg, PhysicalHashJoin,
+                                PhysicalProjection, PhysicalSelection,
+                                PhysicalSort, PhysicalTopN)
+from .executors import Executor, build_executor
+
+
+def _drain_chunk(ex: Executor, fields) -> Chunk:
+    out = Chunk(fields, cap=MAX_CHUNK_SIZE)
+    while True:
+        chk = ex.next()
+        if chk is None:
+            break
+        out.append_chunk(chk)
+    return out
+
+
+def _encode_key(e, chk: Chunk) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    """Evaluate a group/sort key over the chunk -> (codes, null, decode).
+    Strings become order-preserving dictionary codes; decode maps code ->
+    original value (None for numerics)."""
+    v, null = e.vec_eval(chk)
+    if v.dtype == object or v.dtype.kind == "U":
+        safe = np.where(null, "", v)
+        uniques, codes = np.unique(safe.astype(str), return_inverse=True)
+        return codes.astype(np.int64), null, uniques
+    if v.dtype == np.int64 and getattr(e.ret_type, "is_unsigned", False):
+        # unsigned values live two's-complement-wrapped in the int64 buffer;
+        # XOR with the sign bit maps unsigned order onto signed int64 order
+        # (bijective, so it's equally valid as a group key)
+        v = v ^ np.int64(-2**63)
+    return v, null, None
+
+
+class TPUHashAggExec(Executor):
+    """Group-by as device segment-reduce (SURVEY §2.11 P5 TPU counterpart)."""
+
+    def __init__(self, plan: PhysicalHashAgg, child: Executor):
+        super().__init__(plan.schema, [child])
+        self.plan = plan
+        self._done = False
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._done = False
+
+    def next(self) -> Optional[Chunk]:
+        if self._done:
+            return None
+        self._done = True
+        plan = self.plan
+        chk = _drain_chunk(self.children[0], self.children[0].field_types())
+        chk = chk.compact()
+        n = chk.num_rows()
+
+        # ---- keys (dictionary-encode strings) -------------------------
+        keys = [_encode_key(e, chk) for e in plan.group_by]
+        key_cols = [(v, m) for v, m, _ in keys]
+
+        # ---- agg specs --------------------------------------------------
+        # device does count/sum/min/max; avg = sum+count pair;
+        # first_row is gathered host-side by representative row id
+        specs: List[Tuple[str, bool]] = []
+        arg_cols: List[Tuple[np.ndarray, np.ndarray]] = []
+        slots: List[tuple] = []  # how to produce each desc's result
+
+        def add_arg(e, cast_real=False, order_map=False) -> bool:
+            """Returns True when the arg was XOR-sign-bit mapped (unsigned
+            min/max ordering) so the caller can un-map the result."""
+            v, m = e.vec_eval(chk)
+            uns = (e.eval_type is EvalType.INT
+                   and getattr(e.ret_type, "is_unsigned", False))
+            was_mapped = False
+            if cast_real and v.dtype != np.float64:
+                r = v.astype(np.float64)
+                if uns and v.dtype == np.int64:
+                    # unwrap wrapped uint64 into its real value
+                    r = np.where(v < 0, r + 2.0**64, r)
+                v = r
+            elif order_map and uns and v.dtype == np.int64:
+                # min/max compare on device: XOR maps unsigned order onto
+                # signed int64 order; un-mapped in agg_result
+                v = v ^ np.int64(-2**63)
+                was_mapped = True
+            arg_cols.append((v, m))
+            return was_mapped
+
+        for d in plan.aggs:
+            if d.name == AGG_COUNT:
+                from ..expression import Constant
+                a = d.args[0]
+                if isinstance(a, Constant) and a.value is not None:
+                    specs.append(("count_star", False))
+                    slots.append(("dev", len(specs) - 1))
+                else:
+                    specs.append(("count", True))
+                    add_arg(a)
+                    slots.append(("dev", len(specs) - 1))
+            elif d.name == AGG_SUM:
+                specs.append(("sum", True))
+                add_arg(d.args[0],
+                        cast_real=d.ret_type.eval_type is EvalType.REAL)
+                slots.append(("dev", len(specs) - 1))
+            elif d.name == AGG_AVG:
+                specs.append(("sum", True))
+                add_arg(d.args[0], cast_real=True)
+                specs.append(("count", True))
+                add_arg(d.args[0])
+                slots.append(("avg", len(specs) - 2, len(specs) - 1))
+            elif d.name in (AGG_MAX, AGG_MIN):
+                specs.append((("max" if d.name == AGG_MAX else "min"), True))
+                was_mapped = add_arg(d.args[0], order_map=True)
+                slots.append(("dev_mm", len(specs) - 1, was_mapped))
+            elif d.name == AGG_FIRST_ROW:
+                slots.append(("first", d.args[0]))
+            else:  # pragma: no cover — enforcer gates
+                raise ValueError(d.name)
+
+        out_keys, out_aggs, first_orig = kernels.group_aggregate(
+            key_cols, specs, arg_cols, n)
+        ng = len(first_orig)
+
+        # empty input + no GROUP BY: single default row (COUNT=0, SUM=NULL)
+        if ng == 0 and not plan.group_by:
+            from .aggfuncs import new_state
+            out = Chunk(self.field_types(), cap=1)
+            states = [new_state(d) for d in plan.aggs]
+            gbv = []
+            row = []
+            for src, idx in plan.output_map:
+                row.append(states[idx].result() if src == "agg" else None)
+            out.append_row(row)
+            return out
+
+        # ---- materialize output columns --------------------------------
+        def agg_result(i: int) -> CCol:
+            d = plan.aggs[i]
+            slot = slots[i]
+            if slot[0] in ("dev", "dev_mm"):
+                v, m = out_aggs[slot[1]]
+                if slot[0] == "dev_mm" and slot[2]:
+                    v = v ^ np.int64(-2**63)  # undo unsigned order map
+                if d.ret_type.eval_type is EvalType.REAL and v.dtype != np.float64:
+                    v = v.astype(np.float64)
+                return CCol.from_numpy(d.ret_type, v, m)
+            if slot[0] == "avg":
+                sv, sm = out_aggs[slot[1]]
+                cv, _ = out_aggs[slot[2]]
+                cnt = np.maximum(cv, 1)
+                return CCol.from_numpy(d.ret_type, sv / cnt, sm | (cv == 0))
+            # first_row: gather by representative row id (any type)
+            col_expr = slot[1]
+            v, m = col_expr.vec_eval(chk)
+            return CCol.from_numpy(d.ret_type, v[first_orig], m[first_orig])
+
+        def gb_result(i: int) -> CCol:
+            v, m, decode = keys[i]
+            e = plan.group_by[i]
+            if decode is not None:
+                vals = np.empty(ng, dtype=object)
+                kvals = out_keys[i][0]
+                for r in range(ng):
+                    vals[r] = str(decode[kvals[r]])  # np.str_ -> str
+                return CCol.from_numpy(e.ret_type, vals, out_keys[i][1])
+            kv, km = out_keys[i]
+            if (kv.dtype == np.int64 and e.eval_type is EvalType.INT
+                    and getattr(e.ret_type, "is_unsigned", False)):
+                kv = kv ^ np.int64(-2**63)  # undo _encode_key's order map
+            return CCol.from_numpy(e.ret_type, kv, km)
+
+        cols = []
+        for src, idx in plan.output_map:
+            cols.append(agg_result(idx) if src == "agg" else gb_result(idx))
+        return Chunk.from_columns(cols)
+
+
+class TPUHashJoinExec(Executor):
+    """Equi-join as device sort + searchsorted + expansion (SURVEY §2.11 P4
+    TPU counterpart: build via sorted scatter, probe via gather)."""
+
+    def __init__(self, plan: PhysicalHashJoin, left: Executor, right: Executor):
+        super().__init__(plan.schema, [left, right])
+        self.plan = plan
+        self._done = False
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._done = False
+
+    def next(self) -> Optional[Chunk]:
+        if self._done:
+            return None
+        self._done = True
+        plan = self.plan
+        lchk = _drain_chunk(self.children[0], self.children[0].field_types())
+        rchk = _drain_chunk(self.children[1], self.children[1].field_types())
+        if plan.left_conditions:
+            mask = vectorized_filter(plan.left_conditions, lchk)
+            lchk.set_sel(np.nonzero(mask)[0])
+            lchk = lchk.compact()
+        if plan.right_conditions:
+            mask = vectorized_filter(plan.right_conditions, rchk)
+            rchk.set_sel(np.nonzero(mask)[0])
+            rchk = rchk.compact()
+        lk, lnull = plan.left_keys[0].vec_eval(lchk)
+        rk, rnull = plan.right_keys[0].vec_eval(rchk)
+        if lk.dtype != rk.dtype:
+            lk = lk.astype(np.float64)
+            rk = rk.astype(np.float64)
+        li, ri = kernels.join_match((lk, lnull), lchk.num_rows(),
+                                    (rk, rnull), rchk.num_rows(),
+                                    outer=(plan.tp == "left"))
+        # gather output columns
+        unmatched = ri < 0
+        ri_safe = np.where(unmatched, 0, ri)
+        cols: List[CCol] = []
+        for c in lchk.columns:
+            cols.append(c.take(li))
+        for c in rchk.columns:
+            taken = c.take(ri_safe)
+            if unmatched.any():
+                taken.null_mask()[unmatched] = True
+            cols.append(taken)
+        out = Chunk.from_columns(cols)
+        if plan.other_conditions:
+            mask = vectorized_filter(plan.other_conditions, out)
+            if plan.tp == "left":
+                # failed other-cond on matched rows -> NULL-extended row
+                # must survive only if NO match passes; handled by
+                # re-checking per left row
+                keep = self._outer_fixup(li, ri, mask, lchk, out)
+                out.set_sel(np.nonzero(keep)[0])
+            else:
+                out.set_sel(np.nonzero(mask)[0])
+            out = out.compact()
+        return out if out.num_rows() else None
+
+    def _outer_fixup(self, li, ri, mask, lchk, out) -> np.ndarray:
+        """LEFT JOIN + other-conditions: a left row keeps exactly its
+        passing matches, or one NULL-extended row if none pass."""
+        n_left = lchk.num_rows()
+        passing = np.zeros(n_left, dtype=bool)
+        matched_rows = ri >= 0
+        np.logical_or.at(passing, li[matched_rows & mask],
+                         True)
+        keep = np.zeros(len(li), dtype=bool)
+        # keep matched rows that pass
+        keep |= matched_rows & mask
+        # left rows with no passing match: keep ONE row, null-extended
+        no_pass = ~passing
+        seen = set()
+        for idx in range(len(li)):
+            l = li[idx]
+            if no_pass[l] and l not in seen:
+                seen.add(l)
+                keep[idx] = True
+                # null-extend the right side of this surviving row
+                for c in out.columns[len(lchk.columns):]:
+                    c.null_mask()[idx] = True
+        return keep
+
+
+class TPUSortExec(Executor):
+    def __init__(self, plan: PhysicalSort, child: Executor):
+        super().__init__(plan.schema, [child])
+        self.plan = plan
+        self._out = None
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._out = None
+
+    def next(self) -> Optional[Chunk]:
+        if self._out is None:
+            chk = _drain_chunk(self.children[0],
+                               self.children[0].field_types()).compact()
+            n = chk.num_rows()
+            if n == 0:
+                self._out = iter([])
+            else:
+                keys = [(_encode_key(e, chk)[:2]) for e, _ in self.plan.by]
+                keys = [(v, m) for v, m in keys]
+                descs = [d for _, d in self.plan.by]
+                perm = kernels.sort_permutation(keys, descs, n)
+                chk.set_sel(perm)
+                self._out = iter([chk.compact()])
+        return next(self._out, None)
+
+
+class TPUTopNExec(Executor):
+    def __init__(self, plan: PhysicalTopN, child: Executor):
+        super().__init__(plan.schema, [child])
+        self.plan = plan
+        self._out = None
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._out = None
+
+    def next(self) -> Optional[Chunk]:
+        if self._out is None:
+            chk = _drain_chunk(self.children[0],
+                               self.children[0].field_types()).compact()
+            n = chk.num_rows()
+            if n == 0:
+                self._out = iter([])
+            else:
+                keys = [(_encode_key(e, chk)[:2]) for e, _ in self.plan.by]
+                descs = [d for _, d in self.plan.by]
+                k = self.plan.offset + self.plan.count
+                perm = kernels.top_k(keys, descs, n, k)
+                sel = perm[self.plan.offset:]
+                chk.set_sel(sel)
+                self._out = iter([chk.compact()] if len(sel) else [])
+        return next(self._out, None)
+
+
+class TPUProjectionExec(Executor):
+    """Expression trees fused by XLA into elementwise device kernels."""
+
+    def __init__(self, plan: PhysicalProjection, child: Executor):
+        super().__init__(plan.schema, [child])
+        self.plan = plan
+        self._fn = None
+
+    def _compiled(self):
+        if self._fn is None:
+            jax = kernels.jax()
+            exprs = [compile_expr(e) for e in self.plan.exprs]
+
+            @jax.jit
+            def run(cols):
+                return [f(cols) for f in exprs]
+            self._fn = run
+        return self._fn
+
+    def next(self) -> Optional[Chunk]:
+        chk = self.children[0].next()
+        if chk is None:
+            return None
+        chk = chk.compact()
+        if not chk.columns:
+            # zero-column (TableDual) input: host numpy path handles
+            # virtual row counts; nothing to gain on device
+            from ..chunk import Column as HostCol
+            cols = []
+            for e, oc in zip(self.plan.exprs, self.plan.schema.columns):
+                v, m = e.vec_eval(chk)
+                cols.append(HostCol.from_numpy(oc.ret_type, v, m))
+            return Chunk.from_columns(cols)
+        cols_dev = _marshal(chk)
+        outs = self._compiled()(cols_dev)
+        out_cols = []
+        for (v, m), oc in zip(outs, self.plan.schema.columns):
+            out_cols.append(CCol.from_numpy(oc.ret_type, np.asarray(v),
+                                            np.asarray(m)))
+        return Chunk.from_columns(out_cols)
+
+
+class TPUSelectionExec(Executor):
+    def __init__(self, plan: PhysicalSelection, child: Executor):
+        super().__init__(plan.schema, [child])
+        self.plan = plan
+        self._fn = None
+
+    def _compiled(self):
+        if self._fn is None:
+            flt = compile_filter(self.plan.conditions)
+            self._fn = kernels.jax().jit(flt)
+        return self._fn
+
+    def next(self) -> Optional[Chunk]:
+        while True:
+            chk = self.children[0].next()
+            if chk is None:
+                return None
+            chk = chk.compact()
+            if chk.num_rows() == 0:
+                continue
+            if not chk.columns:
+                mask = vectorized_filter(self.plan.conditions, chk)
+            else:
+                mask = np.asarray(self._compiled()(_marshal(chk)))
+            if not mask.any():
+                continue
+            chk.set_sel(np.nonzero(mask)[0])
+            return chk.compact()
+
+
+def _marshal(chk: Chunk):
+    """Chunk columns -> device (values, null) pairs.  String columns are
+    never touched by device exprs (enforcer), but must still occupy their
+    index slot — pass zeros."""
+    jnp = kernels.jnp()
+    out = []
+    n = chk.num_rows()
+    for c in chk.columns:
+        v = c.values()
+        if v.dtype == object:
+            out.append((jnp.zeros(n, dtype=jnp.int64),
+                        jnp.asarray(c.null_mask())))
+        else:
+            out.append((jnp.asarray(v), jnp.asarray(c.null_mask())))
+    return out
+
+
+def build_tpu_executor(plan) -> Optional[Executor]:
+    if isinstance(plan, PhysicalHashAgg):
+        return TPUHashAggExec(plan, build_executor(plan.children[0], True))
+    if isinstance(plan, PhysicalHashJoin):
+        return TPUHashJoinExec(plan, build_executor(plan.children[0], True),
+                               build_executor(plan.children[1], True))
+    if isinstance(plan, PhysicalTopN):
+        return TPUTopNExec(plan, build_executor(plan.children[0], True))
+    if isinstance(plan, PhysicalSort):
+        return TPUSortExec(plan, build_executor(plan.children[0], True))
+    if isinstance(plan, PhysicalProjection):
+        return TPUProjectionExec(plan, build_executor(plan.children[0], True))
+    if isinstance(plan, PhysicalSelection):
+        return TPUSelectionExec(plan, build_executor(plan.children[0], True))
+    return None

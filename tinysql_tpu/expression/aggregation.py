@@ -94,8 +94,10 @@ def infer_agg_ret_type(name: str, args: List[Expression]) -> FieldType:
             return new_real_type()
         if args and args[0].eval_type is EvalType.STRING:
             return new_real_type()
-        ft = new_int_type()
-        return ft
+        # unsigned input sums stay unsigned (wrap mod 2^64 like MySQL
+        # BIGINT UNSIGNED without the out-of-range error)
+        return new_int_type(unsigned=bool(
+            args and args[0].ret_type.is_unsigned))
     # max/min/first_row keep their arg type
     ft = args[0].ret_type.clone() if args else new_int_type()
     ft.flag &= ~0x1  # clear NOT NULL: aggs of empty groups yield NULL

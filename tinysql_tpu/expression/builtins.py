@@ -36,14 +36,40 @@ def _ints(n: int) -> np.ndarray:
     return np.zeros(n, dtype=_I64)
 
 
-def _cast_vv_to_real(a: VV) -> VV:
+def _cast_vv_to_real(a: VV, unsigned: bool = False) -> VV:
     v, nl = a
-    if v.dtype == object:  # strings -> numeric prefix
+    if v.dtype == object or v.dtype.kind == "U":  # strings -> numeric prefix
         out = np.empty(len(v), dtype=_F64)
         for i, s in enumerate(v):
             out[i] = to_real(s) if not nl[i] else 0.0
         return out, nl
-    return v.astype(_F64), nl
+    r = v.astype(_F64)
+    if unsigned and v.dtype == _I64:
+        # unsigned values live two's-complement-wrapped in int64 buffers
+        r = np.where(v < 0, r + 2.0**64, r)
+    return r, nl
+
+
+def _uns_flags(args: List[Expression]):
+    """Per-arg flag: INT-typed expression whose values are wrapped uint64."""
+    return tuple(a.eval_type is EvalType.INT and a.ret_type.is_unsigned
+                 for a in args)
+
+
+def _int_lt_eq(a, ua: bool, b, ub: bool):
+    """(lt, eq) masks over two int64 arrays with per-side unsignedness
+    (reference: types/compare.go CompareInt with mysql.UnsignedFlag)."""
+    if ua == ub:
+        if ua:  # XOR the sign bit: maps unsigned order onto signed order
+            a = a ^ np.int64(-2**63)
+            b = b ^ np.int64(-2**63)
+        return a < b, a == b
+    if ua:  # a unsigned (actual in [0, 2^64)), b signed
+        ok = (a >= 0) & (b >= 0)
+        return ok & (a < b), ok & (a == b)
+    # a signed, b unsigned
+    ok = (a >= 0) & (b >= 0)
+    return (a < 0) | (b < 0) | (a < b), ok & (a == b)
 
 
 def _cast_vv_to_int(a: VV) -> VV:
@@ -63,7 +89,7 @@ def _cast_vv_to_int(a: VV) -> VV:
 
 def _cast_vv_to_str(a: VV) -> VV:
     v, nl = a
-    if v.dtype == object:
+    if v.dtype == object or v.dtype.kind == "U":
         return v, nl
     out = np.empty(len(v), dtype=object)
     for i in range(len(v)):
@@ -74,7 +100,8 @@ def _cast_vv_to_str(a: VV) -> VV:
 def _truthy(a: VV) -> Tuple[np.ndarray, np.ndarray]:
     """SQL boolean of a value vector: (bool array, null mask)."""
     v, nl = a
-    if v.dtype == object:
+    if v.dtype == object or v.dtype.kind == "U":
+        # strings: MySQL numeric-prefix coercion ('0' and 'x' are falsy)
         b = np.empty(len(v), dtype=bool)
         for i, s in enumerate(v):
             b[i] = bool(to_bool(s)) if not nl[i] else False
@@ -86,17 +113,21 @@ def _truthy(a: VV) -> Tuple[np.ndarray, np.ndarray]:
 
 def _arith_ret_type(name: str, args: List[Expression]) -> FieldType:
     if name == "div":
-        return new_int_type()
+        return new_int_type(
+            unsigned=any(a.eval_type is EvalType.INT and a.ret_type.is_unsigned
+                         for a in args))
     if name == "/":
         return new_real_type()
     ets = [a.eval_type for a in args]
     if all(e is EvalType.INT for e in ets):
-        unsigned = all(a.ret_type.is_unsigned for a in args)
+        # MySQL: int arithmetic is unsigned if EITHER operand is unsigned
+        unsigned = any(a.ret_type.is_unsigned for a in args)
         return new_int_type(unsigned=unsigned)
     return new_real_type()
 
 
-def _make_arith(name: str, et: EvalType):
+def _make_arith(name: str, et: EvalType,
+                uns: Tuple[bool, bool] = (False, False)):
     is_int = et is EvalType.INT
 
     def scalar(vals: List[Datum]) -> Datum:
@@ -140,12 +171,15 @@ def _make_arith(name: str, et: EvalType):
         raise AssertionError(name)
 
     def vec(args: List[VV], chk) -> VV:
-        cast = _cast_vv_to_int if is_int and name != "/" else _cast_vv_to_real
-        (a, na), (b, nb) = cast(args[0]), cast(args[1])
+        if is_int and name != "/":
+            (a, na), (b, nb) = _cast_vv_to_int(args[0]), _cast_vv_to_int(args[1])
+        else:
+            (a, na) = _cast_vv_to_real(args[0], uns[0])
+            (b, nb) = _cast_vv_to_real(args[1], uns[1])
         null = na | nb
         with np.errstate(all="ignore"):
             if name == "+":
-                v = a + b
+                v = a + b  # int: wrap-correct mod 2^64 for any signedness
             elif name == "-":
                 v = a - b
             elif name == "*":
@@ -155,20 +189,15 @@ def _make_arith(name: str, et: EvalType):
                 null = null | (b == 0)
             elif name == "div":
                 if is_int:
-                    safe = np.where(b != 0, b, 1)
-                    q = np.abs(a) // np.abs(safe)
-                    v = np.where((a < 0) != (b < 0), -q, q)
+                    v = _int_divmod(a, b, uns)[0]
                 else:
                     v = np.where(b != 0, np.trunc(a / np.where(b != 0, b, 1)), 0)
                 null = null | (b == 0)
             elif name == "%":
-                safe = np.where(b != 0, b, 1)
                 if is_int:
-                    q = np.abs(a) // np.abs(safe)
-                    q = np.where((a < 0) != (b < 0), -q, q)
-                    v = a - b * q
+                    v = _int_divmod(a, b, uns)[1]
                 else:
-                    v = np.fmod(a, safe)
+                    v = np.fmod(a, np.where(b != 0, b, 1))
                 null = null | (b == 0)
             else:
                 raise AssertionError(name)
@@ -177,6 +206,33 @@ def _make_arith(name: str, et: EvalType):
         return v, null
 
     return scalar, vec
+
+
+def _int_divmod(a: np.ndarray, b: np.ndarray, uns: Tuple[bool, bool]):
+    """Truncating int64 div/mod honoring per-side unsignedness.  Same-sign
+    pairs run exactly (uint64 views when unsigned); the mixed case lifts the
+    unsigned side into float128 (64-bit mantissa: exact for all uint64)."""
+    safe = np.where(b != 0, b, 1)
+    if uns == (False, False):
+        q = np.abs(a) // np.abs(safe)
+        q = np.where((a < 0) != (b < 0), -q, q)
+        return q, a - b * q
+    if uns == (True, True):
+        ua, ub = a.view(np.uint64), np.where(b != 0, b, 1).view(np.uint64)
+        q = ua // ub
+        return (q).view(_I64), (ua - ub * q).view(_I64)
+    # mixed signedness: rare — exact via python bigints
+    qs = np.empty(len(a), dtype=_I64)
+    rs = np.empty(len(a), dtype=_I64)
+    for i in range(len(a)):
+        av = int(a[i]) + ((1 << 64) if uns[0] and a[i] < 0 else 0)
+        bv = int(safe[i]) + ((1 << 64) if uns[1] and safe[i] < 0 else 0)
+        q = abs(av) // abs(bv)
+        if (av < 0) != (bv < 0):
+            q = -q
+        qs[i] = wrap_i64(q)
+        rs[i] = wrap_i64(av - bv * q)
+    return qs, rs
 
 
 def _make_unary_minus(et: EvalType):
@@ -215,13 +271,18 @@ _CMP_NP = {
 }
 
 
-def _make_compare(op: str, family: EvalType):
+def _make_compare(op: str, family: EvalType,
+                  uns: Tuple[bool, bool] = (False, False)):
     null_safe = op == "<=>"
     base_op = "=" if null_safe else op
 
     def coerce_scalar(a, b):
         if family is EvalType.INT:
-            return to_int(a), to_int(b)
+            # scalar values are already semantic python ints (unsigned
+            # arrives unwrapped, e.g. 2^64-1) — python int compare is
+            # arbitrary-precision, so do NOT wrap_i64 here
+            return ((int(a) if not isinstance(a, str) else to_int(a)),
+                    (int(b) if not isinstance(b, str) else to_int(b)))
         if family is EvalType.STRING:
             return to_string(a), to_string(b)
         return to_real(a), to_real(b)
@@ -237,23 +298,30 @@ def _make_compare(op: str, family: EvalType):
              "<=": a <= b, ">": a > b, ">=": a >= b}[base_op]
         return int(r)
 
-    def cast(a: VV) -> VV:
+    def cast(a: VV, unsigned: bool) -> VV:
         if family is EvalType.INT:
             return _cast_vv_to_int(a)
         if family is EvalType.STRING:
             return _cast_vv_to_str(a)
-        return _cast_vv_to_real(a)
+        return _cast_vv_to_real(a, unsigned)
 
     def vec(args: List[VV], chk) -> VV:
-        (a, na), (b, nb) = cast(args[0]), cast(args[1])
+        (a, na), (b, nb) = cast(args[0], uns[0]), cast(args[1], uns[1])
         if family is EvalType.STRING:
-            n = len(a)
-            r = np.zeros(n, dtype=bool)
-            for i in range(n):
-                if not (na[i] or nb[i]):
-                    x, y = a[i], b[i]
-                    r[i] = {"=": x == y, "!=": x != y, "<": x < y,
-                            "<=": x <= y, ">": x > y, ">=": x >= y}[base_op]
+            # fixed-width numpy string arrays compare vectorized in C
+            # (the columnar replica stores <U dtype); object arrays of
+            # python strs also vectorize through numpy's richcompare
+            if a.dtype.kind != "U" and b.dtype.kind == "U":
+                a = a.astype(str)
+            if b.dtype.kind != "U" and a.dtype.kind == "U":
+                b = b.astype(str)
+            r = _CMP_NP[base_op](a, b)
+            if r.dtype != bool:  # object-array compare returns object
+                r = r.astype(bool)
+        elif family is EvalType.INT and (uns[0] or uns[1]):
+            lt, eq = _int_lt_eq(a, uns[0], b, uns[1])
+            r = {"=": eq, "!=": ~eq, "<": lt, "<=": lt | eq,
+                 ">": ~(lt | eq), ">=": ~lt}[base_op]
         else:
             with np.errstate(invalid="ignore"):
                 r = _CMP_NP[base_op](a, b)
@@ -466,8 +534,12 @@ def _make_like(escape: str):
     return scalar, vec
 
 
-def _make_in(family: EvalType):
-    eq_scalar, eq_vec = _make_compare("=", family)
+def _make_in(family: EvalType, uns: Sequence[bool] = ()):
+    eq_scalar, eq_default = _make_compare("=", family)
+    # per-item equality with the target's/item's own unsignedness
+    x_uns = uns[0] if uns else False
+    eq_vecs = [_make_compare("=", family, (x_uns, u))[1]
+               for u in (uns[1:] if uns else [])]
 
     def scalar(vals):
         x = vals[0]
@@ -487,8 +559,9 @@ def _make_in(family: EvalType):
         n = len(x[0])
         hit = np.zeros(n, dtype=bool)
         saw_null = np.zeros(n, dtype=bool)
-        for item in args[1:]:
-            r, rn = eq_vec([x, item], chk)
+        for k, item in enumerate(args[1:]):
+            ev = eq_vecs[k] if k < len(eq_vecs) else eq_default
+            r, rn = ev([x, item], chk)
             hit |= (r == 1) & ~rn
             saw_null |= rn
         v = hit.astype(_I64)
@@ -597,7 +670,7 @@ def new_function(name: str, args: List[Expression]) -> ScalarFunction:
         # independent of the result type (div always returns int)
         family = (EvalType.INT if all(a.eval_type is EvalType.INT for a in args)
                   and name != "/" else EvalType.REAL)
-        s, v = _make_arith(name, family)
+        s, v = _make_arith(name, family, _uns_flags(args))
         return ScalarFunction(name, args, rt, s, v)
     if name == "unaryminus":
         et = args[0].eval_type
@@ -606,7 +679,7 @@ def new_function(name: str, args: List[Expression]) -> ScalarFunction:
         return ScalarFunction(name, args, rt, s, v)
     if name in ("=", "!=", "<", "<=", ">", ">=", "<=>"):
         fam = _cmp_family(args)
-        s, v = _make_compare(name, fam)
+        s, v = _make_compare(name, fam, _uns_flags(args))
         return ScalarFunction(name, args, new_int_type(), s, v)
     if name == "and":
         return ScalarFunction(name, args, new_int_type(),
@@ -657,7 +730,7 @@ def new_function(name: str, args: List[Expression]) -> ScalarFunction:
         return ScalarFunction(name, args, new_int_type(), s, v)
     if name == "in":
         fam = _cmp_family(args)
-        s, v = _make_in(fam)
+        s, v = _make_in(fam, _uns_flags(args))
         return ScalarFunction(name, args, new_int_type(), s, v)
     if name in ("length", "octet_length"):
         return ScalarFunction(name, args, new_int_type(),

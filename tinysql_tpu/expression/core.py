@@ -130,11 +130,12 @@ class Constant(Expression):
                          else (np.float64 if et is EvalType.REAL else object))
             return z, np.ones(n, dtype=bool)
         if self.eval_type is EvalType.STRING:
-            v = np.empty(n, dtype=object)
-            v[:] = self.value
+            v = np.full(n, self.value)  # fixed-width <U dtype: vectorizes
+        elif self.eval_type is EvalType.INT:
+            from ..mytypes import wrap_i64
+            v = np.full(n, wrap_i64(int(self.value)), dtype=np.int64)
         else:
-            dt = np.int64 if self.eval_type is EvalType.INT else np.float64
-            v = np.full(n, self.value, dtype=dt)
+            v = np.full(n, self.value, dtype=np.float64)
         return v, np.zeros(n, dtype=bool)
 
     def key(self) -> str:

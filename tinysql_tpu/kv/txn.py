@@ -35,10 +35,12 @@ class LockResolver:
     """reference: lock_resolver.go — decide a blocking txn's fate via its
     primary lock, then resolve the encountered lock."""
 
-    def __init__(self, client: RPCClient, cache: RegionCache, oracle: Oracle):
+    def __init__(self, client: RPCClient, cache: RegionCache, oracle: Oracle,
+                 storage=None):
         self.client = client
         self.cache = cache
         self.oracle = oracle
+        self.storage = storage  # for columnar invalidation on resolve-commit
         self._resolved: Dict[int, int] = {}  # start_ts -> commit_ts (0=rolled back)
         self._mu = threading.Lock()
 
@@ -60,6 +62,18 @@ class LockResolver:
                 if len(self._resolved) > 4096:
                     self._resolved.pop(next(iter(self._resolved)))
         self._send_resolve(boer, lock.key, lock.lock_ts, known)
+        if known > 0 and self.storage is not None:
+            # resolving to COMMITTED makes a crashed writer's data visible:
+            # invalidate that table's columnar replica (the crashed
+            # committer never ran its own bump)
+            from ..columnar.store import bump_table_version
+            from ..codec.tablecodec import decode_table_id
+            for k in (lock.key, lock.primary):
+                if k[:1] == b"t" and len(k) >= 9:
+                    try:
+                        bump_table_version(self.storage, decode_table_id(k))
+                    except ValueError:
+                        pass
         return True
 
     def _check_txn_status(self, boer: Backoffer, primary: bytes,
@@ -319,8 +333,28 @@ class TwoPhaseCommitter:
             self.commit_keys()
             committed = True
         finally:
-            if not committed and not self.undetermined:
+            if committed or self.undetermined:
+                # undetermined: the primary may have committed (the resolver
+                # will finish the job) — invalidating is safe either way,
+                # NOT invalidating would leave a stale columnar replica
+                self._bump_columnar_versions()
+            else:
                 self.cleanup()
+
+    def _bump_columnar_versions(self) -> None:
+        """Invalidate columnar replicas of every table this txn wrote
+        (columnar/store.py data-version protocol)."""
+        from ..columnar.store import bump_table_version
+        tids = set()
+        for m in self.mutations:
+            if m.key[:1] == b"t" and len(m.key) >= 9:
+                try:
+                    from ..codec.tablecodec import decode_table_id
+                    tids.add(decode_table_id(m.key))
+                except ValueError:
+                    pass
+        for tid in tids:
+            bump_table_version(self.storage, tid)
 
 
 class Transaction:
@@ -434,7 +468,8 @@ class TiKVStorage:
         self.client = RPCClient(self.cluster, self.mvcc)
         self.cache = RegionCache(self.cluster)
         self.oracle = Oracle()
-        self.resolver = LockResolver(self.client, self.cache, self.oracle)
+        self.resolver = LockResolver(self.client, self.cache, self.oracle,
+                                     storage=self)
 
     def begin(self, start_ts: Optional[int] = None) -> Transaction:
         if start_ts is None:

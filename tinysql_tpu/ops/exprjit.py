@@ -1,0 +1,310 @@
+"""Expression tree -> JAX: the device-side vectorized evaluator.
+
+The TPU counterpart of the reference's VecEval* builtins
+(expression/builtin_*_vec.go): each numeric expression tree lowers to a
+jittable function over (values, null-mask) device-array pairs with MySQL
+3-valued null semantics.  XLA fuses the whole tree into a handful of
+elementwise kernels — the TPU-first replacement for the reference's
+per-builtin Go loops (SURVEY §2.5 note).
+
+Only INT/REAL expressions lower; the planner's device enforcer
+(planner/device.py) keeps strings on the CPU tier.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..expression import Column, Constant, Expression, ScalarFunction
+from ..mytypes import EvalType
+
+# lazy jax import so CPU-only paths never pay for it
+_jnp = None
+
+
+def jnp():
+    global _jnp
+    if _jnp is None:
+        import jax
+        jax.config.update("jax_enable_x64", True)
+        import jax.numpy as jnp_mod
+        _jnp = jnp_mod
+    return _jnp
+
+
+JITTABLE_FUNCS = {
+    "+", "-", "*", "/", "div", "%", "unaryminus", "abs",
+    "=", "!=", "<", "<=", ">", ">=", "<=>",
+    "and", "or", "xor", "not", "isnull", "istrue", "isfalse",
+    "if", "ifnull", "case", "in", "cast_int", "cast_real",
+}
+
+
+def is_jittable(e: Expression) -> bool:
+    """Can this tree run on device?  (numeric-only, known functions)"""
+    if e.eval_type is EvalType.STRING:
+        return False
+    if isinstance(e, Column):
+        return e.eval_type is not EvalType.STRING
+    if isinstance(e, Constant):
+        return not isinstance(e.value, str)
+    if isinstance(e, ScalarFunction):
+        if e.name not in JITTABLE_FUNCS:
+            return False
+        if (e.name in ("div", "%") and len(e.args) == 2
+                and all(a.eval_type is EvalType.INT for a in e.args)):
+            u = [getattr(a.ret_type, "is_unsigned", False) for a in e.args]
+            if u[0] != u[1]:  # mixed-signedness int div/mod: CPU tier only
+                return False
+        return all(is_jittable(a) for a in e.args)
+    return False
+
+
+VV = Tuple[object, object]  # (jnp values, jnp bool null-mask)
+
+
+def _truthy(a: VV):
+    v, nl = a
+    return v != 0, nl
+
+
+def compile_expr(e: Expression) -> Callable[[Sequence[VV]], VV]:
+    """Build a python closure evaluating `e` over device columns; the result
+    is jit-traceable (call it inside jax.jit)."""
+    j = jnp()
+    if isinstance(e, Column):
+        idx = e.index
+
+        def col_fn(cols):
+            return cols[idx]
+        return col_fn
+    if isinstance(e, Constant):
+        val = e.value
+        is_null = val is None
+        if e.eval_type is EvalType.INT:
+            from ..mytypes import wrap_i64
+            cval = wrap_i64(int(val)) if val is not None else 0
+            dt = j.int64
+        else:
+            cval = float(val) if val is not None else 0.0
+            dt = j.float64
+
+        def const_fn(cols):
+            n = cols[0][0].shape[0] if cols else 1
+            return (j.full((n,), cval, dtype=dt),
+                    j.full((n,), is_null, dtype=bool))
+        return const_fn
+    assert isinstance(e, ScalarFunction), e
+    args = [compile_expr(a) for a in e.args]
+    arg_types = [a.eval_type for a in e.args]
+    arg_uns = [a.eval_type is EvalType.INT
+               and getattr(a.ret_type, "is_unsigned", False) for a in e.args]
+    name = e.name
+    ret_int = e.eval_type is EvalType.INT
+
+    def fn(cols):
+        vals = [a(cols) for a in args]
+        return _apply(name, vals, arg_types, ret_int, arg_uns)
+    return fn
+
+
+def _to_real_u(v, unsigned: bool):
+    """int64 -> float64 honoring the wrapped-uint64 representation."""
+    j = jnp()
+    r = v.astype(j.float64)
+    if unsigned and v.dtype == j.int64:
+        r = j.where(v < 0, r + 2.0**64, r)
+    return r
+
+
+def _int_div_j(a, safe_b, uns):
+    """Truncating int64 div/mod on device.  Both-unsigned runs in uint64
+    via bitcast; mixed signedness is rejected by is_jittable (CPU tier)."""
+    j = jnp()
+    from jax import lax
+    if uns[0] and uns[1]:
+        ua = lax.bitcast_convert_type(a, j.uint64)
+        ub = lax.bitcast_convert_type(safe_b, j.uint64)
+        q = ua // ub
+        r = ua - ub * q
+        return (lax.bitcast_convert_type(q, j.int64),
+                lax.bitcast_convert_type(r, j.int64))
+    q = j.abs(a) // j.abs(safe_b)
+    q = j.where((a < 0) != (safe_b < 0), -q, q)
+    return q, a - safe_b * q
+
+
+def _int_lt_eq_j(a, ua: bool, b, ub: bool):
+    """(lt, eq) for int64 device arrays with per-side unsignedness —
+    mirrors expression/builtins._int_lt_eq."""
+    j = jnp()
+    if ua == ub:
+        if ua:
+            a = a ^ j.int64(-2**63)
+            b = b ^ j.int64(-2**63)
+        return a < b, a == b
+    if ua:
+        ok = (a >= 0) & (b >= 0)
+        return ok & (a < b), ok & (a == b)
+    ok = (a >= 0) & (b >= 0)
+    return (a < 0) | (b < 0) | (a < b), ok & (a == b)
+
+
+def _apply(name: str, vals: List[VV], arg_types, ret_int: bool,
+           arg_uns=None) -> VV:
+    j = jnp()
+    arg_uns = arg_uns or [False] * len(vals)
+    if name in ("+", "-", "*", "/", "div", "%"):
+        (a, na), (b, nb) = vals
+        null = na | nb
+        int_math = (arg_types[0] is EvalType.INT
+                    and arg_types[1] is EvalType.INT and name != "/")
+        if not int_math:
+            a = _to_real_u(a, arg_uns[0])
+            b = _to_real_u(b, arg_uns[1])
+        if name == "+":
+            return a + b, null  # int: wrap-correct mod 2^64 any signedness
+        if name == "-":
+            return a - b, null
+        if name == "*":
+            return a * b, null
+        safe_b = j.where(b == 0, 1, b)
+        null = null | (b == 0)
+        if name == "/":
+            return a / safe_b, null
+        if name == "div":
+            if int_math:
+                q = _int_div_j(a, safe_b, arg_uns)[0]
+            else:
+                q = j.trunc(a / safe_b).astype(j.int64)
+            return q, null
+        # %
+        if int_math:
+            return _int_div_j(a, safe_b, arg_uns)[1], null
+        return j.where(b == 0, 0.0, j.where(
+            j.sign(a) >= 0, j.abs(a) % j.abs(safe_b),
+            -(j.abs(a) % j.abs(safe_b)))), null
+    if name == "unaryminus":
+        v, nl = vals[0]
+        return -v, nl
+    if name == "abs":
+        v, nl = vals[0]
+        return j.abs(v), nl
+    if name in ("=", "!=", "<", "<=", ">", ">=", "<=>"):
+        (a, na), (b, nb) = vals
+        if arg_types[0] is not arg_types[1]:
+            a = _to_real_u(a, arg_uns[0])
+            b = _to_real_u(b, arg_uns[1])
+            r = {"=": a == b, "<=>": a == b, "!=": a != b, "<": a < b,
+                 "<=": a <= b, ">": a > b, ">=": a >= b}[name]
+        elif (arg_types[0] is EvalType.INT
+              and (arg_uns[0] or arg_uns[1])):
+            lt, eq = _int_lt_eq_j(a, arg_uns[0], b, arg_uns[1])
+            base = "=" if name == "<=>" else name
+            r = {"=": eq, "!=": ~eq, "<": lt, "<=": lt | eq,
+                 ">": ~(lt | eq), ">=": ~lt}[base]
+        else:
+            r = {"=": a == b, "<=>": a == b, "!=": a != b, "<": a < b,
+                 "<=": a <= b, ">": a > b, ">=": a >= b}[name]
+        if name == "<=>":
+            v = j.where(na | nb, na & nb, r)
+            return v.astype(j.int64), j.zeros_like(na)
+        return r.astype(j.int64), na | nb
+    if name == "and":
+        (a, na), (b, nb) = (_truthy(v) for v in vals)
+        fa, fb = (~a) & ~na, (~b) & ~nb
+        v = (a & b) & ~(na | nb)
+        null = (na | nb) & ~(fa | fb)
+        return v.astype(j.int64), null
+    if name == "or":
+        (a, na), (b, nb) = (_truthy(v) for v in vals)
+        ta, tb = a & ~na, b & ~nb
+        v = ta | tb
+        null = (na | nb) & ~v
+        return v.astype(j.int64), null
+    if name == "xor":
+        (a, na), (b, nb) = (_truthy(v) for v in vals)
+        return (a != b).astype(j.int64), na | nb
+    if name == "not":
+        a, na = _truthy(vals[0])
+        return (~a).astype(j.int64), na
+    if name == "isnull":
+        v, nl = vals[0]
+        return nl.astype(j.int64), j.zeros_like(nl)
+    if name in ("istrue", "isfalse"):
+        a, na = _truthy(vals[0])
+        want = name == "istrue"
+        v = j.where(na, False, a == want)
+        return v.astype(j.int64), j.zeros_like(na)
+    if name == "if":
+        c, nc = _truthy(vals[0])
+        take = c & ~nc
+        (a, na), (b, nb) = vals[1], vals[2]
+        return j.where(take, a, b), j.where(take, na, nb)
+    if name == "ifnull":
+        (a, na), (b, nb) = vals
+        return j.where(na, b, a), na & nb
+    if name == "case":
+        has_else = len(vals) % 2 == 1
+        pairs = len(vals) // 2
+        proto = vals[1][0]
+        v = j.zeros_like(proto)
+        null = j.ones(proto.shape, dtype=bool)
+        decided = j.zeros(proto.shape, dtype=bool)
+        for p in range(pairs):
+            c, nc = _truthy(vals[2 * p])
+            take = c & ~nc & ~decided
+            rv, rn = vals[2 * p + 1]
+            v = j.where(take, rv, v)
+            null = j.where(take, rn, null)
+            decided = decided | take
+        if has_else:
+            rv, rn = vals[-1]
+            v = j.where(decided, v, rv)
+            null = j.where(decided, null, rn)
+        return v, null
+    if name == "in":
+        x, xn = vals[0]
+        hit = j.zeros(x.shape, dtype=bool)
+        saw_null = j.zeros(x.shape, dtype=bool)
+        for k, (item, inull) in enumerate(vals[1:], start=1):
+            if x.dtype != item.dtype:
+                xi = _to_real_u(x, arg_uns[0])
+                it = _to_real_u(item, arg_uns[k])
+                eq = xi == it
+            elif x.dtype == j.int64 and (arg_uns[0] or arg_uns[k]):
+                eq = _int_lt_eq_j(x, arg_uns[0], item, arg_uns[k])[1]
+            else:
+                eq = x == item
+            hit = hit | (eq & ~inull & ~xn)
+            saw_null = saw_null | inull
+        return hit.astype(j.int64), ~hit & (saw_null | xn)
+    if name == "cast_int":
+        v, nl = vals[0]
+        if v.dtype == j.int64:
+            return v, nl
+        r = j.where(v >= 0, j.floor(v + 0.5), -j.floor(-v + 0.5))
+        r = j.clip(r, -2.0**63, 2.0**63 - 1)
+        return r.astype(j.int64), nl
+    if name == "cast_real":
+        v, nl = vals[0]
+        return _to_real_u(v, arg_uns[0]), nl
+    raise ValueError(f"not jittable: {name}")
+
+
+def compile_filter(conds: List[Expression]) -> Callable[[Sequence[VV]], object]:
+    """CNF list -> device boolean keep-mask (NULL = drop), mirroring
+    expression.vectorized_filter (reference VecEvalBool)."""
+    fns = [compile_expr(c) for c in conds]
+
+    def run(cols):
+        j = jnp()
+        n = cols[0][0].shape[0] if cols else 0
+        mask = j.ones((n,), dtype=bool)
+        for f in fns:
+            v, null = f(cols)
+            mask = mask & (v != 0) & ~null
+        return mask
+    return run

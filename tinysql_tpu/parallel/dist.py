@@ -1,0 +1,123 @@
+"""Multi-chip distributed query primitives: SPMD over a jax Mesh.
+
+The TPU-native replacement for the reference's distributed communication
+backend (SURVEY §2.6/§2.11): KV regions -> mesh shards; coprocessor
+scatter-gather (P2) -> data-parallel shard_map; the parallel hash agg's
+partial/final split (P5) -> per-shard segment reduce + psum over ICI;
+region-sharded join (P4) -> broadcast (all_gather) build side + local
+probe.  Collectives ride the mesh axis (ICI on real hardware, host rings on
+the CPU test mesh); no NCCL/MPI analogue exists or is needed — XLA inserts
+the collectives.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..ops import kernels
+
+
+def make_mesh(n_devices: Optional[int] = None):
+    """1-D device mesh over axis 'shard' (DP/region axis)."""
+    jax = kernels.jax()
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    from jax.sharding import Mesh
+    return Mesh(np.array(devs[:n]), ("shard",))
+
+
+# =========================================================================
+# distributed partial/final aggregation (SURVEY §2.11 P5)
+# =========================================================================
+
+def make_sharded_group_sum(mesh, n_buckets: int):
+    """Per-shard segment-sum into a fixed bucket table + psum merge: the
+    reference's partial workers -> shuffle -> final workers pipeline
+    (aggregate.go:55-93) collapsed into one SPMD program.
+
+    Inputs (host-side global shapes): bucket ids int32 [n_shards, rows],
+    values f64 [n_shards, rows], valid mask [n_shards, rows].
+    Output: per-bucket (sum, count) replicated on every shard.
+    """
+    jax = kernels.jax()
+    jnp = kernels.jnp()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P("shard", None), P("shard", None), P("shard", None)),
+             out_specs=(P(), P()))
+    def step(bucket_ids, vals, valid):
+        # each shard sees [1, rows]
+        b = bucket_ids[0]
+        v = jnp.where(valid[0], vals[0], 0.0)
+        c = valid[0].astype(jnp.int64)
+        partial_sum = jax.ops.segment_sum(v, b, num_segments=n_buckets)
+        partial_cnt = jax.ops.segment_sum(c, b, num_segments=n_buckets)
+        # ICI all-reduce of partial states (the reduce-scatter schema)
+        total = jax.lax.psum(partial_sum, "shard")
+        cnt = jax.lax.psum(partial_cnt, "shard")
+        return total, cnt
+
+    return jax.jit(step)
+
+
+# =========================================================================
+# distributed broadcast join (SURVEY §2.11 P4)
+# =========================================================================
+
+def make_broadcast_join_counts(mesh):
+    """Probe side sharded over the mesh; build side broadcast (all_gather)
+    to every shard; each shard counts its local matches; psum gives the
+    global match count.  The 'partition build side' variant (hash
+    re-sharding via all_to_all) lands with the distributed executor."""
+    jax = kernels.jax()
+    jnp = kernels.jnp()
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P("shard", None), P("shard", None), P(None)),
+             out_specs=(P("shard", None), P()))
+    def step(lkeys, lvalid, rkeys_sorted):
+        lk = lkeys[0]
+        lv = lvalid[0]
+        lo = jnp.searchsorted(rkeys_sorted, lk, side="left")
+        hi = jnp.searchsorted(rkeys_sorted, lk, side="right")
+        counts = jnp.where(lv, hi - lo, 0)
+        total = jax.lax.psum(jnp.sum(counts), "shard")
+        return counts[None, :], total
+
+    return jax.jit(step)
+
+
+# =========================================================================
+# full distributed step (the dryrun/"training step" entry)
+# =========================================================================
+
+def distributed_query_step(mesh, n_buckets: int = 64):
+    """One fused SPMD 'query step': filter + partial aggregate + psum +
+    broadcast-join counts — the whole distributed pipeline the engine's
+    multi-chip executor drives, jitted over the mesh."""
+    jax = kernels.jax()
+    jnp = kernels.jnp()
+    agg = make_sharded_group_sum(mesh, n_buckets)
+    join = make_broadcast_join_counts(mesh)
+
+    def step(bucket_ids, vals, valid, lkeys, lvalid, rkeys_sorted):
+        sums, cnts = agg(bucket_ids, vals, valid)
+        counts, total = join(lkeys, lvalid, rkeys_sorted)
+        return sums, cnts, counts, total
+
+    return step
+
+
+def shard_rows(arr: np.ndarray, n_shards: int, fill=0) -> np.ndarray:
+    """Host helper: pad + reshape a 1-D array to [n_shards, rows]."""
+    n = len(arr)
+    per = (n + n_shards - 1) // n_shards
+    out = np.full(n_shards * per, fill, dtype=arr.dtype)
+    out[:n] = arr
+    return out.reshape(n_shards, per)

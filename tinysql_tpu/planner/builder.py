@@ -43,7 +43,8 @@ def _lit_ft(v: Datum) -> FieldType:
     if v is None:
         return new_int_type()
     if isinstance(v, bool) or isinstance(v, int):
-        return new_int_type()
+        # decimal literals above the signed range are unsigned in MySQL
+        return new_int_type(unsigned=v > (1 << 63) - 1)
     if isinstance(v, float):
         return new_real_type()
     return new_string_type()

@@ -1,0 +1,78 @@
+"""Device enforcer: decide which physical operators run on TPU.
+
+The north-star planner capability (BASELINE.json): a device dimension on
+physical plans — the analogue of the reference's copTask/rootTask split
+(planner/core/task.go:42,364) where the question is "where does this subtree
+execute".  TPU operators are admitted when their hot loop is expressible as
+device kernels:
+
+- HashAgg: agg args numeric (device segment-reduce); group keys numeric OR
+  plain string Columns (order-preserving dictionary codes built host-side).
+- HashJoin: exactly one equi-key pair, numeric (sort+searchsorted kernel).
+- Sort/TopN: keys numeric or plain string Columns (dictionary codes).
+- Projection/Selection: every expression lowers through ops/exprjit.
+
+Everything else falls back to the CPU tier (numpy-vectorized volcano
+executors) — mirroring the reference's own Vectorized()==false fallback
+(projection.go:92-93).
+"""
+from __future__ import annotations
+
+from ..expression import Column, Expression
+from ..expression.aggregation import (AGG_AVG, AGG_COUNT, AGG_FIRST_ROW,
+                                      AGG_MAX, AGG_MIN, AGG_SUM)
+from ..mytypes import EvalType
+from ..ops.exprjit import is_jittable
+from .physical import (PhysicalHashAgg, PhysicalHashJoin, PhysicalPlan,
+                       PhysicalProjection, PhysicalSelection, PhysicalSort,
+                       PhysicalTopN)
+
+_TPU_AGGS = {AGG_COUNT, AGG_SUM, AGG_AVG, AGG_MAX, AGG_MIN, AGG_FIRST_ROW}
+
+
+def _key_ok(e: Expression) -> bool:
+    """Group/sort key: device-jittable numeric, or a bare string column
+    (dictionary-encoded host-side with order-preserving codes)."""
+    if is_jittable(e):
+        return True
+    return isinstance(e, Column) and e.eval_type is EvalType.STRING
+
+
+def _agg_ok(d) -> bool:
+    if d.name not in _TPU_AGGS or d.distinct:
+        return False
+    if d.name == AGG_FIRST_ROW:
+        return isinstance(d.args[0], Column)  # gathered host-side by row id
+    if d.name == AGG_COUNT:
+        from ..expression import Constant
+        a = d.args[0]
+        return isinstance(a, (Column, Constant)) or is_jittable(a)
+    return all(is_jittable(a) for a in d.args)
+
+
+def place_devices(p: PhysicalPlan, enabled: bool = True) -> PhysicalPlan:
+    for c in p.children:
+        place_devices(c, enabled)
+    if not enabled:
+        return p
+    if isinstance(p, PhysicalHashAgg):
+        p.use_tpu = (all(_key_ok(e) for e in p.group_by)
+                     and all(_agg_ok(d) for d in p.aggs))
+    elif isinstance(p, PhysicalHashJoin):
+        def _uns(e):
+            return (e.eval_type is EvalType.INT
+                    and getattr(e.ret_type, "is_unsigned", False))
+        p.use_tpu = (len(p.left_keys) == 1
+                     and is_jittable(p.left_keys[0])
+                     and is_jittable(p.right_keys[0])
+                     # mixed-signedness int keys need per-pair compare
+                     # semantics the sort+searchsorted kernel lacks: CPU tier
+                     and _uns(p.left_keys[0]) == _uns(p.right_keys[0])
+                     and p.tp in ("inner", "left"))
+    elif isinstance(p, (PhysicalSort, PhysicalTopN)):
+        p.use_tpu = all(_key_ok(e) for e, _ in p.by)
+    elif isinstance(p, PhysicalProjection):
+        p.use_tpu = all(is_jittable(e) for e in p.exprs)
+    elif isinstance(p, PhysicalSelection):
+        p.use_tpu = all(is_jittable(c) for c in p.conditions)
+    return p

@@ -302,12 +302,14 @@ def to_physical(p: LogicalPlan) -> PhysicalPlan:
     raise PlanError(f"no physical mapping for {type(p).__name__}")
 
 
-def optimize(logical: LogicalPlan) -> PhysicalPlan:
+def optimize(logical: LogicalPlan, tpu: bool = True) -> PhysicalPlan:
     """The System-R style pipeline (reference: planner/core/optimizer.go:77):
-    rule rewrites, then physical conversion."""
+    rule rewrites, physical conversion, then the device enforcer."""
     retained, logical = predicate_pushdown(logical, [])
     if retained:
         logical = LogicalSelection(retained, logical)
     column_pruning(logical, {c.unique_id for c in logical.schema.columns})
     logical = topn_pushdown(logical)
-    return to_physical(logical)
+    phys = to_physical(logical)
+    from .device import place_devices
+    return place_devices(phys, enabled=tpu)

@@ -64,12 +64,15 @@ class SessionError(Exception):
 class Session:
     """reference: session/session.go session struct."""
 
-    _GLOBAL_VARS: Dict[int, Dict[str, Datum]] = {}  # per-storage global scope
-
     def __init__(self, storage, current_db: str = ""):
         self.storage = storage
         self.current_db = current_db
+        # session scope initialized from defaults overlaid with globals
+        # (reference: session.go loadCommonGlobalVariablesIfNeeded); the
+        # global scope lives ON the storage object — id(storage) keys
+        # collide when CPython reuses a freed address
         self.sysvars: Dict[str, Datum] = dict(DEFAULT_SYSVARS)
+        self.sysvars.update(getattr(storage, "_global_vars", {}))
         self.uservars: Dict[str, Datum] = {}
         self._txn = None
         self._explicit_txn = False
@@ -86,7 +89,10 @@ class Session:
         return d
 
     def _globals(self) -> Dict[str, Datum]:
-        return Session._GLOBAL_VARS.setdefault(id(self.storage), {})
+        g = getattr(self.storage, "_global_vars", None)
+        if g is None:
+            g = self.storage._global_vars = {}
+        return g
 
     # ---- schema cache (reference: domain.Reload; lazy version check) ---
     def infoschema(self) -> InfoSchema:
@@ -214,8 +220,8 @@ class Session:
         builder = PlanBuilder(self)
         logical = builder.build_select(stmt)
         columns = [c.name for c in logical.schema.columns]
-        phys = optimize(logical)
         use_tpu = bool(self.get_sysvar("tidb_use_tpu"))
+        phys = optimize(logical, tpu=use_tpu)
         ex = build_executor(phys, use_tpu=use_tpu)
         ex.open(ExecContext(self.get_txn(), self.sysvars,
                             self.infoschema(), self.storage))
@@ -227,8 +233,9 @@ class Session:
 
     def _run_select_plan(self, stmt: ast.SelectStmt, txn) -> List[list]:
         builder = PlanBuilder(self)
-        phys = optimize(builder.build_select(stmt))
-        ex = build_executor(phys)
+        use_tpu = bool(self.get_sysvar("tidb_use_tpu"))
+        phys = optimize(builder.build_select(stmt), tpu=use_tpu)
+        ex = build_executor(phys, use_tpu=use_tpu)
         ex.open(ExecContext(txn, self.sysvars, self.infoschema(),
                             self.storage))
         try:
@@ -262,9 +269,10 @@ class Session:
         if stmt.where is not None:
             rw = ExprRewriter(plan.schema, builder)
             plan = LogicalSelection(split_cnf(rw.rewrite(stmt.where)), plan)
-        phys = optimize(plan)
+        use_tpu = bool(self.get_sysvar("tidb_use_tpu"))
+        phys = optimize(plan, tpu=use_tpu)
         txn = self.get_txn()
-        ex = build_executor(phys)
+        ex = build_executor(phys, use_tpu=use_tpu)
         ex.open(ExecContext(txn, self.sysvars, self.infoschema(),
                             self.storage))
         try:
@@ -313,9 +321,7 @@ class Session:
                 elif spec.tp == "add_index":
                     cons = spec.constraint
                     d.add_index(db, stmt.table.name, cons.name,
-                                [(c[0], c[1]) for c in
-                                 [(ic.name, ic.length) for ic in cons.columns]],
-                                cons.tp == "unique")
+                                list(cons.columns), cons.tp == "unique")
                 elif spec.tp == "drop_index":
                     d.drop_index(db, stmt.table.name, spec.name)
         self._is = None  # force schema cache reload
@@ -395,7 +401,8 @@ class Session:
         if not isinstance(stmt.stmt, ast.SelectStmt):
             raise SessionError("EXPLAIN supports SELECT only for now")
         builder = PlanBuilder(self)
-        phys = optimize(builder.build_select(stmt.stmt))
+        phys = optimize(builder.build_select(stmt.stmt),
+                        tpu=bool(self.get_sysvar("tidb_use_tpu")))
         from ..planner.explain import explain_text
         rows = explain_text(phys)
         return ResultSet(["id", "task", "operator info"], rows)

@@ -1,26 +1,120 @@
-"""ANALYZE TABLE collection — placeholder until the statistics phase lands
-(histograms + CMSketch + FMSketch per SURVEY §2.10).  Collects row counts so
-the planner's stats hooks have something real immediately."""
+"""ANALYZE TABLE: collect per-column histograms, CMSketch, FMSketch NDV.
+
+Capability parity with reference executor/analyze.go (:44-470 — column and
+index pushdown tasks, result merge) + statistics/builder.go, redesigned
+columnar-first: when the columnar replica is available the whole column is
+sampled vectorized; otherwise a row scan feeds reservoir samplers.  Results
+persist through statistics/table_stats.py (the mysql.stats_* analogue).
+"""
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List, Optional
+
+import numpy as np
 
 from ..catalog.model import TableInfo
 from ..catalog.table import Table
+from ..mytypes import EvalType
+from .histogram import Histogram
+from .sketches import CMSketch, ReservoirSampler
+from .table_stats import TableStats, load_stats, save_stats
 
-# per-storage, per-table basic stats (row counts) until the full Handle
-# (statistics/handle.py) replaces this
-_BASIC: Dict[int, Dict[int, int]] = {}
+SAMPLE_CAP = 100_000
+MAX_BUCKETS = 64
 
 
-def analyze_table(session, info: TableInfo) -> None:
-    txn = session.storage.begin()
+def analyze_table(session, info: TableInfo) -> TableStats:
+    storage = session.storage
+    from ..columnar.store import replica_for_read
+    txn = storage.begin()
     try:
-        n = sum(1 for _ in Table(info).iter_records(txn))
+        rep = replica_for_read(storage, txn, info.id)
+        if rep is not None:
+            stats = _analyze_columnar(info, rep)
+        else:
+            stats = _analyze_rows(info, txn)
     finally:
         txn.rollback()
-    _BASIC.setdefault(id(session.storage), {})[info.id] = n
+    save_stats(storage, stats)
+    return stats
+
+
+def _analyze_columnar(info: TableInfo, rep) -> TableStats:
+    stats = TableStats(info.id, row_count=rep.n_rows)
+    rng = np.random.default_rng(0)
+    for c in info.public_columns():
+        if c.id not in rep.columns:
+            continue
+        v, m = rep.columns[c.id]
+        n = len(v)
+        null_count = int(m.sum())
+        if n > SAMPLE_CAP:
+            idx = rng.choice(n, SAMPLE_CAP, replace=False)
+            sv, sm = v[idx], m[idx]
+            scale = n / SAMPLE_CAP
+        else:
+            sv, sm = v, m
+            scale = 1.0
+        uns = c.ft.eval_type is EvalType.INT and c.ft.is_unsigned
+        vals = []
+        for i in range(len(sv)):
+            if sm[i]:
+                continue
+            x = sv[i].item() if hasattr(sv[i], "item") else sv[i]
+            if uns and isinstance(x, int) and x < 0:
+                x += 1 << 64  # unwrap wrapped uint64: match the row path's
+                # decoded semantic values so both ANALYZE paths agree
+            vals.append(x)
+        if c.ft.eval_type is EvalType.STRING:
+            vals = [str(x) for x in vals]
+        h = Histogram.build(c.id, vals,
+                            null_count=int(null_count / max(scale, 1)),
+                            max_buckets=MAX_BUCKETS)
+        _scale_histogram(h, scale, n, null_count)
+        stats.columns[c.id] = h
+        cms = CMSketch()
+        for x in vals:
+            cms.insert(x)
+        if scale > 1:
+            cms.table = (cms.table.astype(np.float64) * scale).astype(np.uint32)
+            cms.count = int(cms.count * scale)
+        stats.cms[c.id] = cms
+    return stats
+
+
+def _analyze_rows(info: TableInfo, txn) -> TableStats:
+    cols = info.public_columns()
+    samplers = {c.id: ReservoirSampler(SAMPLE_CAP) for c in cols}
+    n = 0
+    for _, row in Table(info).iter_records(txn):
+        n += 1
+        for c in cols:
+            samplers[c.id].collect(row[c.offset])
+    stats = TableStats(info.id, row_count=n)
+    for c in cols:
+        s = samplers[c.id]
+        scale = max(1.0, s.seen / max(len(s.samples), 1))
+        h = Histogram.build(c.id, s.samples, null_count=s.null_count,
+                            max_buckets=MAX_BUCKETS)
+        _scale_histogram(h, scale, s.seen + s.null_count, s.null_count)
+        h.ndv = max(h.ndv, s.fm.ndv() if scale > 1 else h.ndv)
+        stats.columns[c.id] = h
+        stats.cms[c.id] = s.cms
+    return stats
+
+
+def _scale_histogram(h: Histogram, scale: float, total: int,
+                     null_count: int) -> None:
+    if scale <= 1.0:
+        return
+    for b in h.buckets:
+        b.count = int(b.count * scale)
+        b.repeat = max(1, int(b.repeat * scale))
+    h.ndv = min(int(h.ndv * scale), total)
+    h.total_count = total
+    h.null_count = null_count
 
 
 def table_row_count(storage, table_id: int) -> int:
-    return _BASIC.get(id(storage), {}).get(table_id, 0)
+    s = load_stats(storage, table_id)
+    return s.row_count if s else 0

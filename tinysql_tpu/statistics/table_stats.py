@@ -1,0 +1,179 @@
+"""Per-table statistics + selectivity estimation + persistence.
+
+Capability parity with reference statistics/table.go (HistColl),
+statistics/selectivity.go:129-306 (combine expressions -> estimates),
+statistics/handle.go (lifecycle: save after ANALYZE, cached load, feeds
+the planner's DeriveStats).  Persisted as JSON in the meta keyspace
+(reference persists in mysql.stats_* system tables; same contract —
+survives restarts, versioned by update ts).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..expression import Column as ExprColumn, Constant, Expression, ScalarFunction
+from ..kv.errors import KeyNotFound
+from .histogram import Histogram
+from .sketches import CMSketch
+
+_STATS_PREFIX = b"m:stats:"  # m:stats:{table_id:08d} -> json
+
+DEFAULT_SELECTIVITY = 0.8       # reference: selectionFactor
+EQ_DEFAULT = 1.0 / 1000         # pseudo eq selectivity (pseudo table)
+LT_DEFAULT = 1.0 / 3
+
+
+@dataclass
+class TableStats:
+    table_id: int
+    row_count: int = 0
+    modify_count: int = 0
+    version: int = 0
+    columns: Dict[int, Histogram] = field(default_factory=dict)   # col_id
+    cms: Dict[int, CMSketch] = field(default_factory=dict)
+    indices: Dict[int, Histogram] = field(default_factory=dict)   # index_id
+
+    @property
+    def pseudo(self) -> bool:
+        return self.row_count == 0 and not self.columns
+
+    # ---- per-expression selectivity ------------------------------------
+    def expr_selectivity(self, e: Expression) -> float:
+        """Selectivity of one conjunct (reference: selectivity.go — reduced
+        to per-conjunct independence; the disjoint-set cover over index
+        prefixes lands with the index-path chooser)."""
+        if self.row_count == 0:
+            return DEFAULT_SELECTIVITY
+        if isinstance(e, ScalarFunction):
+            name = e.name
+            if name in ("=", "<=>") and len(e.args) == 2:
+                col, const = _col_const(e.args)
+                if col is not None:
+                    h = self.columns.get(col)
+                    if h is not None and const is not None:
+                        cms = self.cms.get(col)
+                        cnt = (cms.query(const) if cms is not None
+                               else h.equal_row_count(const))
+                        return min(1.0, cnt / max(self.row_count, 1))
+                    return EQ_DEFAULT
+            if name in ("<", "<=", ">", ">=") and len(e.args) == 2:
+                col, const = _col_const(e.args)
+                if col is not None and const is not None:
+                    h = self.columns.get(col)
+                    if h is not None and h.total_count > 0:
+                        less = h.less_row_count(const)
+                        eq = h.equal_row_count(const)
+                        flipped = isinstance(e.args[0], Constant)
+                        op = name
+                        if flipped:
+                            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}[op]
+                        if op == "<":
+                            cnt = less
+                        elif op == "<=":
+                            cnt = less + eq
+                        elif op == ">":
+                            cnt = h.not_null_count() - less - eq
+                        else:
+                            cnt = h.not_null_count() - less
+                        return min(1.0, max(cnt, 0) / max(self.row_count, 1))
+                return LT_DEFAULT
+            if name == "and":
+                return (self.expr_selectivity(e.args[0])
+                        * self.expr_selectivity(e.args[1]))
+            if name == "or":
+                a = self.expr_selectivity(e.args[0])
+                b = self.expr_selectivity(e.args[1])
+                return min(1.0, a + b - a * b)
+            if name == "isnull" and isinstance(e.args[0], ExprColumn):
+                h = self.columns.get(_col_id(e.args[0]))
+                if h is not None and h.total_count > 0:
+                    return h.null_count / h.total_count
+                return EQ_DEFAULT
+            if name == "in":
+                col = _col_id(e.args[0])
+                consts = [a.value for a in e.args[1:]
+                          if isinstance(a, Constant)]
+                if col is not None and len(consts) == len(e.args) - 1:
+                    h = self.columns.get(col)
+                    if h is not None:
+                        cnt = sum(h.equal_row_count(c) for c in consts)
+                        return min(1.0, cnt / max(self.row_count, 1))
+                return min(1.0, EQ_DEFAULT * max(len(e.args) - 1, 1))
+        return DEFAULT_SELECTIVITY
+
+    def selectivity(self, conds: List[Expression]) -> float:
+        s = 1.0
+        for c in conds:
+            s *= self.expr_selectivity(c)
+        return s
+
+    # ---- persistence ----------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps({
+            "table_id": self.table_id, "row_count": self.row_count,
+            "modify_count": self.modify_count, "version": self.version,
+            "columns": {str(k): h.to_dict() for k, h in self.columns.items()},
+            "cms": {str(k): s.to_dict() for k, s in self.cms.items()},
+            "indices": {str(k): h.to_dict() for k, h in self.indices.items()},
+        })
+
+    @classmethod
+    def from_json(cls, s: str) -> "TableStats":
+        d = json.loads(s)
+        t = cls(d["table_id"], d["row_count"], d["modify_count"],
+                d["version"])
+        t.columns = {int(k): Histogram.from_dict(v)
+                     for k, v in d["columns"].items()}
+        t.cms = {int(k): CMSketch.from_dict(v) for k, v in d["cms"].items()}
+        t.indices = {int(k): Histogram.from_dict(v)
+                     for k, v in d["indices"].items()}
+        return t
+
+
+def _col_id(e: Expression) -> Optional[int]:
+    return getattr(e, "stats_col_id", None)
+
+
+def _col_const(args) -> tuple:
+    a, b = args
+    if isinstance(a, ExprColumn) and isinstance(b, Constant):
+        return _col_id(a), b.value
+    if isinstance(b, ExprColumn) and isinstance(a, Constant):
+        return _col_id(b), a.value
+    return None, None
+
+
+# ---- handle (per-storage cache; reference: statistics/handle.go) ----------
+
+def save_stats(storage, stats: TableStats) -> None:
+    stats.version = storage.current_version()
+    txn = storage.begin()
+    txn.set(_STATS_PREFIX + b"%08d" % stats.table_id, stats.to_json().encode())
+    txn.commit()
+    _cache_of(storage)[stats.table_id] = stats
+
+
+def load_stats(storage, table_id: int) -> Optional[TableStats]:
+    cache = _cache_of(storage)
+    hit = cache.get(table_id)
+    if hit is not None:
+        return hit
+    txn = storage.begin()
+    try:
+        raw = txn.get(_STATS_PREFIX + b"%08d" % table_id)
+    except KeyNotFound:
+        return None
+    finally:
+        txn.rollback()
+    stats = TableStats.from_json(raw.decode())
+    cache[table_id] = stats
+    return stats
+
+
+def _cache_of(storage) -> dict:
+    c = getattr(storage, "_stats_cache", None)
+    if c is None:
+        c = storage._stats_cache = {}
+    return c
