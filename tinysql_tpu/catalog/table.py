@@ -41,16 +41,28 @@ class Index:
             vals.append(v)
         return vals
 
+    def _unsigned_flags(self) -> List[bool]:
+        """Unsigned columns must encode with the UINT key flag or values
+        >= 2^63 sort before 0 in the index (and range seeks miss)."""
+        out = []
+        for ic in self.info.columns:
+            ci = self.table.info.find_column(ic.name)
+            out.append(bool(ci is not None and ci.ft.is_unsigned))
+        return out
+
     def key(self, row: List[Datum], handle: int) -> Tuple[bytes, bytes]:
         """Returns (key, value).  Unique index: handle in value (unless NULLs
         present); non-unique: handle in key (reference: index.go:103)."""
         vals = self._index_values(row)
         has_null = any(v is None for v in vals)
         tid = self.table.info.id
+        uns = self._unsigned_flags()
         if self.info.unique and not has_null:
-            k = tablecodec.encode_index_key(tid, self.info.id, vals)
+            k = tablecodec.encode_index_key(tid, self.info.id, vals,
+                                            unsigned_flags=uns)
             return k, b"%d" % handle
-        k = tablecodec.encode_index_key(tid, self.info.id, vals, handle=handle)
+        k = tablecodec.encode_index_key(tid, self.info.id, vals,
+                                        handle=handle, unsigned_flags=uns)
         return k, b"0"
 
     def create(self, txn, row: List[Datum], handle: int) -> None:
@@ -74,7 +86,9 @@ class Index:
         vals = self._index_values(row)
         if any(v is None for v in vals):
             return None
-        k = tablecodec.encode_index_key(self.table.info.id, self.info.id, vals)
+        k = tablecodec.encode_index_key(self.table.info.id, self.info.id,
+                                        vals,
+                                        unsigned_flags=self._unsigned_flags())
         try:
             return int(txn.get(k))
         except KeyNotFound:

@@ -22,10 +22,12 @@ from ..expression import Schema, vectorized_filter
 from ..mytypes import EvalType, sort_key
 from ..planner.builder import HANDLE_COL_NAME
 from ..planner.physical import (PhysicalHashAgg, PhysicalHashJoin,
-                                PhysicalLimit, PhysicalPlan,
-                                PhysicalProjection, PhysicalSelection,
-                                PhysicalSort, PhysicalTableDual,
-                                PhysicalTableReader, PhysicalTopN)
+                                PhysicalIndexLookUpReader,
+                                PhysicalIndexReader, PhysicalLimit,
+                                PhysicalPlan, PhysicalProjection,
+                                PhysicalSelection, PhysicalSort,
+                                PhysicalTableDual, PhysicalTableReader,
+                                PhysicalTopN)
 from .aggfuncs import new_state
 
 
@@ -98,10 +100,11 @@ class TableReaderExec(Executor):
                 assert ci is not None, f"column {c.name} missing in {info.name}"
                 self._decode_cols.append(ci)
         self._real_cols = [ci for ci in self._decode_cols if ci is not None]
-        # columnar replica fast path (columnar/store.py)
+        # columnar replica fast path (columnar/store.py) — full scans only;
+        # ranged scans seek the row store directly
         self._replica = None
         self._pos = 0
-        if ctx.storage is not None:
+        if ctx.storage is not None and self.scan.ranges is None:
             from ..columnar.store import replica_for_read
             rep = replica_for_read(ctx.storage, ctx.txn, info.id)
             if rep is not None and all(ci.id in rep.columns
@@ -110,10 +113,25 @@ class TableReaderExec(Executor):
         self._iter = None
         self._hydrate = None
         if self._replica is None:
-            self._iter = self._tbl.iter_records(ctx.txn, cols=self._real_cols)
-            if (ctx.storage is not None and self.scan.ranges is None
-                    and self._real_cols):
-                self._hydrate = {"handles": [], "rows": []}
+            if self.scan.ranges is not None:
+                self._iter = self._iter_ranges(ctx.txn)
+            else:
+                self._iter = self._tbl.iter_records(ctx.txn,
+                                                    cols=self._real_cols)
+                if ctx.storage is not None and self._real_cols:
+                    self._hydrate = {"handles": [], "rows": []}
+
+    def _iter_ranges(self, txn):
+        """Seek each [lo, hi] handle range directly (reference:
+        distsql/request_builder.go handle-range table reads)."""
+        from ..codec import tablecodec
+        for lo, hi in self.scan.ranges:
+            start = tablecodec.encode_row_key(self.scan.table_info.id, lo)
+            end = tablecodec.encode_row_key(self.scan.table_info.id, hi) + b"\x00"
+            for k, v in txn.iter_range(start, end):
+                _, handle = tablecodec.decode_record_key(k)
+                yield handle, self._tbl.decode_row(v, handle,
+                                                   self._real_cols)
 
     def next(self) -> Optional[Chunk]:
         if self._replica is not None:
@@ -204,6 +222,172 @@ class TableReaderExec(Executor):
     def close(self) -> None:
         self._iter = None
         self._hydrate = None
+        super().close()
+
+
+def _iter_index_entries(txn, iscan):
+    """Yield (index_values, handle) over the scan's ranges in index order
+    (reference: tables/index.go Seek + distsql index-range reads)."""
+    from ..codec import keycodec, tablecodec
+    from ..planner.ranger import MAX, MIN
+    info = iscan.table_info
+    idx = iscan.index
+    prefix = tablecodec.encode_index_prefix(info.id, idx.id)
+    uns = []
+    for ic in idx.columns:
+        ci = info.find_column(ic.name)
+        uns.append(bool(ci is not None and ci.ft.is_unsigned))
+    n_cols = len(idx.columns)
+
+    def enc(vals):
+        return keycodec.encode_key(list(vals), uns[:len(vals)])
+
+    for r in iscan.ranges:
+        low = list(r.low)
+        if low and low[-1] is MIN:
+            # open lower bound from a comparison: NULL never satisfies it,
+            # and NULL sorts first — start just past the null point
+            lo_key = prefix + enc(low[:-1]) + bytes([keycodec.NIL_FLAG + 1])
+        elif low:
+            lo_key = prefix + enc(low) + (b"" if r.low_incl else b"\xff")
+        else:
+            lo_key = prefix
+        high = list(r.high)
+        if high and high[-1] is MAX:
+            hi_key = prefix + enc(high[:-1]) + b"\xff"
+        elif high:
+            hi_key = prefix + enc(high) + (b"\xff" if r.high_incl else b"")
+        else:
+            hi_key = prefix + b"\xff"
+        for k, v in txn.iter_range(lo_key, hi_key):
+            vals = keycodec.decode_key(k[len(prefix):])
+            if len(vals) > n_cols:  # handle rides in the key (non-unique
+                handle = int(vals[n_cols])  # or unique-with-nulls)
+                vals = vals[:n_cols]
+            else:
+                handle = int(v)  # unique index: handle in the value
+            yield vals, handle
+
+
+class IndexReaderExec(Executor):
+    """Covering index scan: answers straight from index entries
+    (reference: executor/distsql.go IndexReaderExecutor :166)."""
+
+    def __init__(self, plan):
+        super().__init__(plan.schema, [])
+        self.iscan = plan.scan
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._iter = _iter_index_entries(ctx.txn, self.iscan)
+
+    def next(self) -> Optional[Chunk]:
+        if self._iter is None:
+            return None
+        limit = self.ctx.max_chunk_size
+        chk = Chunk(self.field_types(), cap=limit)
+        n = 0
+        for vals, handle in self._iter:
+            row = []
+            for src in self.iscan.output_sources:
+                row.append(handle if src[0] == "handle" else vals[src[1]])
+            chk.append_row(row)
+            n += 1
+            if n >= limit:
+                break
+        if n == 0:
+            self._iter = None
+            return None
+        if self.iscan.filters:
+            mask = vectorized_filter(self.iscan.filters, chk)
+            chk.set_sel(np.nonzero(mask)[0])
+            chk = chk.compact()
+        return chk
+
+    def close(self) -> None:
+        self._iter = None
+        super().close()
+
+
+class IndexLookUpExec(Executor):
+    """Double read: stage 1 walks the index collecting handles, stage 2
+    fetches rows by handle with `tidb_index_lookup_concurrency` workers,
+    preserving index order (reference: IndexLookUpExecutor's index worker ->
+    table workers pipeline, executor/distsql.go:237-370)."""
+
+    def __init__(self, plan):
+        super().__init__(plan.schema, [])
+        self.iscan = plan.index_scan
+        self.tscan = plan.table_scan
+
+    def open(self, ctx):
+        super().open(ctx)
+        info = self.tscan.table_info
+        self._tbl = Table(info)
+        self._decode_cols = []
+        for c in self.tscan.schema.columns:
+            if c.name == HANDLE_COL_NAME:
+                self._decode_cols.append(None)
+            else:
+                self._decode_cols.append(info.find_column(c.name))
+        self._real_cols = [ci for ci in self._decode_cols if ci is not None]
+        self._entries = _iter_index_entries(ctx.txn, self.iscan)
+        self._pool = None
+
+    def _fetch_batch(self, handles):
+        """Stage 2: point-read `handles` concurrently, results in index
+        order (reference table workers; 4 by default)."""
+        txn = self.ctx.txn
+        workers = int(self.ctx.session_vars.get(
+            "tidb_index_lookup_concurrency", 4))
+        rows: List[Optional[list]] = [None] * len(handles)
+
+        def fetch(span):
+            for j in range(*span):
+                rows[j] = self._tbl.row(txn, handles[j], self._real_cols)
+        if workers <= 1 or len(handles) < 64:
+            fetch((0, len(handles)))
+        else:
+            if self._pool is None:
+                import concurrent.futures as cf
+                self._pool = cf.ThreadPoolExecutor(max_workers=workers)
+            step = (len(handles) + workers - 1) // workers
+            spans = [(i, min(i + step, len(handles)))
+                     for i in range(0, len(handles), step)]
+            list(self._pool.map(fetch, spans))
+        return rows
+
+    def next(self) -> Optional[Chunk]:
+        if self._entries is None:
+            return None
+        limit = self.ctx.max_chunk_size
+        handles = []
+        for _, handle in self._entries:
+            handles.append(handle)
+            if len(handles) >= limit:
+                break
+        if not handles:
+            self._entries = None
+            return None
+        rows = self._fetch_batch(handles)
+        chk = Chunk(self.field_types(), cap=len(handles))
+        for h, row in zip(handles, rows):
+            vals = []
+            it = iter(row)
+            for ci in self._decode_cols:
+                vals.append(h if ci is None else next(it))
+            chk.append_row(vals)
+        if self.tscan.filters:
+            mask = vectorized_filter(self.tscan.filters, chk)
+            chk.set_sel(np.nonzero(mask)[0])
+            chk = chk.compact()
+        return chk
+
+    def close(self) -> None:
+        self._entries = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
         super().close()
 
 
@@ -630,6 +814,10 @@ def build_executor(plan: PhysicalPlan, use_tpu: bool = False) -> Executor:
             return ex
     if isinstance(plan, PhysicalTableReader):
         return TableReaderExec(plan)
+    if isinstance(plan, PhysicalIndexReader):
+        return IndexReaderExec(plan)
+    if isinstance(plan, PhysicalIndexLookUpReader):
+        return IndexLookUpExec(plan)
     if isinstance(plan, PhysicalSelection):
         return SelectionExec(plan, build_executor(plan.children[0], use_tpu))
     if isinstance(plan, PhysicalProjection):

@@ -72,13 +72,16 @@ class Column(Expression):
 
     def __init__(self, ret_type: FieldType, index: int = -1,
                  unique_id: Optional[int] = None, name: str = "",
-                 table: str = "", db: str = ""):
+                 table: str = "", db: str = "",
+                 stats_col_id: Optional[int] = None):
         self.ret_type = ret_type
         self.index = index
         self.unique_id = unique_id if unique_id is not None else next(_uid)
         self.name = name
         self.table = table
         self.db = db
+        # source ColumnInfo.id for histogram/CMS selectivity lookups
+        self.stats_col_id = stats_col_id
 
     def eval(self, row):
         return row[self.index]
@@ -97,18 +100,18 @@ class Column(Expression):
         idx = schema.column_index(self)
         if idx < 0:
             raise ValueError(f"column {self.name or self.unique_id} not in schema")
-        return Column(self.ret_type, idx, self.unique_id, self.name,
-                      self.table, self.db)
+        return self.clone_with_index(idx)
 
     def clone_with_index(self, index: int) -> "Column":
         return Column(self.ret_type, index, self.unique_id, self.name,
-                      self.table, self.db)
+                      self.table, self.db, self.stats_col_id)
 
     def renamed(self, name: str = None, table: str = None) -> "Column":
         """Same unique id, new qualifiers (derived-table aliasing)."""
         return Column(self.ret_type, self.index, self.unique_id,
                       name if name is not None else self.name,
-                      table if table is not None else self.table, self.db)
+                      table if table is not None else self.table, self.db,
+                      self.stats_col_id)
 
     def __repr__(self):  # pragma: no cover
         return f"{self.name or 'col'}#{self.unique_id}@{self.index}"

@@ -1,0 +1,209 @@
+"""Access-path selection: table scan vs index scan vs index lookup.
+
+Capability parity with reference planner/core/find_best_task.go (the
+DataSource task enumeration + skyline pruning stub :214 implemented for
+real) and planner/util/path.go AccessPath.  Ranges come from ranger.py;
+row-count estimates from statistics/table_stats.py (histograms + CMSketch
+when ANALYZE ran, heuristic defaults otherwise).
+
+Cost model (reference task.go GetCost, reduced): scanning N rows costs N;
+a covering index scan costs 0.9N (narrower rows); an index lookup pays a
+double-read penalty per matched row (task.go finishCopTask's network/seek
+factor analogue).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..catalog.model import IndexInfo
+from ..expression import Column, Expression
+from .logical import LogicalDataSource
+from .physical import (PhysicalIndexLookUpReader, PhysicalIndexReader,
+                       PhysicalIndexScan, PhysicalPlan, PhysicalTableReader,
+                       PhysicalTableScan)
+from . import ranger
+
+LOOKUP_FACTOR = 4.0     # random point-read penalty per matched row
+COVER_FACTOR = 0.9      # narrower index rows scan cheaper
+PSEUDO_ROWS = 10000.0   # row estimate when no stats exist
+
+
+@dataclass
+class AccessPath:
+    """reference: planner/util/path.go"""
+    index: Optional[IndexInfo]          # None = table (handle) path
+    ranges: list
+    access_conds: List[Expression]
+    remaining: List[Expression]
+    covering: bool
+    est_rows: float
+    cost: float = 0.0
+    index_cols: List[Column] = field(default_factory=list)
+
+
+def _schema_col(ds: LogicalDataSource, name: str) -> Optional[Column]:
+    for c in ds.schema.columns:
+        if c.name == name:
+            return c
+    return None
+
+
+def choose_path(ds: LogicalDataSource, stats) -> AccessPath:
+    """Enumerate paths, skyline-prune, pick min cost."""
+    conds = list(ds.pushed_conds)
+    total = float(stats.row_count) if stats and stats.row_count else PSEUDO_ROWS
+
+    paths: List[AccessPath] = []
+
+    # ---- table path (clustered int pk -> handle ranges) ----------------
+    pk = ds.table_info.get_pk_handle_col()
+    pk_col = _schema_col(ds, pk.name) if pk is not None else None
+    if pk_col is not None:
+        hranges, access, remaining = ranger.build_handle_ranges(conds, pk_col)
+    else:
+        hranges, access, remaining = None, [], conds
+    sel = _sel(stats, access, _handle_heuristic(hranges, total))
+    paths.append(AccessPath(None, hranges, access, remaining, True,
+                            total * (sel if access else 1.0)))
+
+    # ---- index paths ----------------------------------------------------
+    for idx in ds.possible_indices:
+        icols = []
+        for ic in idx.columns:
+            if ic.length >= 0:
+                break  # prefix-length column: truncated values can't seek
+            c = _schema_col(ds, ic.name)
+            if c is None:
+                break  # index column pruned out of scope
+            icols.append(c)
+        if not icols:
+            continue
+        ranges, access, remaining = ranger.detach_conditions(conds, icols)
+        if not access:
+            continue  # no seek advantage; skip full index scans
+        covering = _covers(ds, idx, pk)
+        est = total * _sel(stats, access, _heuristic_sel(ranges, icols))
+        paths.append(AccessPath(idx, ranges, access, remaining, covering,
+                                est, index_cols=icols))
+
+    paths = _skyline_prune(paths)
+
+    for p in paths:
+        if p.index is None:
+            p.cost = p.est_rows if p.access_conds else total
+        elif p.covering:
+            p.cost = p.est_rows * COVER_FACTOR
+        else:
+            p.cost = p.est_rows * (1.0 + LOOKUP_FACTOR)
+    return min(paths, key=lambda p: p.cost)
+
+
+def _sel(stats, access_conds: List[Expression], fallback: float) -> float:
+    if not access_conds:
+        return 1.0
+    if stats is not None and not stats.pseudo:
+        return stats.selectivity(access_conds)
+    return fallback
+
+
+def _handle_heuristic(hranges, total: float) -> float:
+    """No stats: a pk point range is ~1 row; narrow ranges scale by width,
+    unbounded ranges fall back to the range default 30%."""
+    if not hranges:
+        return 1.0
+    rows = 0.0
+    for lo, hi in hranges:
+        width = hi - lo + 1
+        rows += width if width < total else total * 0.3
+    return min(1.0, rows / max(total, 1.0))
+
+
+def _heuristic_sel(ranges: List[ranger.Range], icols) -> float:
+    """No stats: each eq column ~10%, a range column ~30% (reference
+    pseudo-stats fractions)."""
+    if not ranges:
+        return 0.0
+    r = ranges[0]
+    n_eq = len(r.low) - (0 if r.is_point() else 1)
+    s = (0.1 ** max(n_eq, 0))
+    if not r.is_point():
+        s *= 0.3
+    return min(1.0, s * max(len(ranges), 1) ** 0.5)
+
+
+def _covers(ds: LogicalDataSource, idx: IndexInfo, pk) -> bool:
+    """Index covers the query iff every needed schema column is an index
+    column (full-length prefix) or the clustered pk handle."""
+    idx_names = {ic.name for ic in idx.columns if ic.length < 0}
+    for c in ds.schema.columns:
+        if c.name in idx_names:
+            continue
+        if pk is not None and c.name == pk.name:
+            continue  # handle rides along in the index entry
+        return False
+    return True
+
+
+def _skyline_prune(paths: List[AccessPath]) -> List[AccessPath]:
+    """reference find_best_task.go:214 compareCandidates: drop a path whose
+    access-condition set is a subset of another's, which is not covering
+    while the other is, and which matches no more ranges."""
+    keep: List[AccessPath] = []
+    for a in paths:
+        dominated = False
+        a_set = {e.key() for e in a.access_conds}
+        for b in paths:
+            if a is b:
+                continue
+            b_set = {e.key() for e in b.access_conds}
+            if (a_set < b_set and b.covering >= a.covering) or \
+               (a_set == b_set and not a.covering and b.covering):
+                dominated = True
+                break
+        if not dominated:
+            keep.append(a)
+    return keep or paths
+
+
+# ===== physical construction ===============================================
+
+def build_reader(ds: LogicalDataSource, stats,
+                 with_handle: bool) -> PhysicalPlan:
+    from .optimizer import _bind  # late: avoid import cycle
+    path = choose_path(ds, stats)
+    if path.index is None:
+        scan = PhysicalTableScan(ds.table_info, ds.db_name, ds.alias,
+                                 ds.schema, with_handle)
+        scan.ranges = path.ranges  # None = full scan
+        scan.filters = _bind(path.remaining, ds.schema)
+        scan.stats_row_count = path.est_rows
+        reader = PhysicalTableReader(scan)
+        reader.stats_row_count = path.est_rows
+        return reader
+
+    iscan = PhysicalIndexScan(ds.table_info, path.index, ds.db_name,
+                              ds.alias, ds.schema, path.ranges)
+    iscan.stats_row_count = path.est_rows
+    if path.covering:
+        # output plan: ds.schema columns sourced from index values / handle
+        pk = ds.table_info.get_pk_handle_col()
+        sources = []
+        idx_pos = {ic.name: i for i, ic in enumerate(path.index.columns)}
+        for c in ds.schema.columns:
+            if pk is not None and c.name == pk.name:
+                sources.append(("handle",))
+            else:
+                sources.append(("idx", idx_pos[c.name]))
+        iscan.output_sources = sources
+        iscan.filters = _bind(path.remaining, ds.schema)
+        reader = PhysicalIndexReader(iscan)
+        reader.stats_row_count = path.est_rows
+        return reader
+
+    tscan = PhysicalTableScan(ds.table_info, ds.db_name, ds.alias,
+                              ds.schema, with_handle)
+    tscan.filters = _bind(path.remaining, ds.schema)
+    reader = PhysicalIndexLookUpReader(iscan, tscan)
+    reader.stats_row_count = path.est_rows
+    return reader

@@ -359,9 +359,14 @@ class PlanBuilder:
             raise PlanError("No database selected")
         tbl: TableInfo = self.ctx.infoschema().table_by_name(db, tn.name)
         alias = src.as_name or tn.name
-        cols = [Column(c.ft, name=c.name, table=alias, db=db)
-                for c in tbl.public_columns()]
-        return LogicalDataSource(db, tbl, alias, cols)
+        cols = []
+        for c in tbl.public_columns():
+            col = Column(c.ft, name=c.name, table=alias, db=db)
+            col.stats_col_id = c.id  # feeds histogram/CMS selectivity
+            cols.append(col)
+        ds = LogicalDataSource(db, tbl, alias, cols)
+        ds.storage = self.ctx.storage  # stats lookup at physical time
+        return ds
 
     # ---- aggregation ------------------------------------------------------
     def _build_aggregation(self, p: LogicalPlan, group_by: List[ast.ExprNode],

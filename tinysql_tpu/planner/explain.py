@@ -3,17 +3,36 @@ from __future__ import annotations
 
 from typing import List
 
-from .physical import (PhysicalHashAgg, PhysicalHashJoin, PhysicalLimit,
-                       PhysicalPlan, PhysicalProjection, PhysicalSelection,
-                       PhysicalSort, PhysicalTableDual, PhysicalTableReader,
-                       PhysicalTopN)
+from .physical import (PhysicalHashAgg, PhysicalHashJoin,
+                       PhysicalIndexLookUpReader, PhysicalIndexReader,
+                       PhysicalLimit, PhysicalPlan, PhysicalProjection,
+                       PhysicalSelection, PhysicalSort, PhysicalTableDual,
+                       PhysicalTableReader, PhysicalTopN)
+
+
+def _ranges_str(ranges) -> str:
+    if ranges is None:
+        return "full"
+    return f"{len(ranges)} range" + ("s" if len(ranges) != 1 else "")
 
 
 def _info(p: PhysicalPlan) -> str:
     if isinstance(p, PhysicalTableReader):
         s = p.scan
         filt = f", filters:{len(s.filters)}" if s.filters else ""
-        return f"table:{s.alias}, keep order:false{filt}"
+        return (f"table:{s.alias}, ranges:{_ranges_str(s.ranges)}, "
+                f"keep order:false{filt}")
+    if isinstance(p, PhysicalIndexReader):
+        s = p.scan
+        filt = f", filters:{len(s.filters)}" if s.filters else ""
+        return (f"table:{s.alias}, index:{s.index.name}, covering, "
+                f"ranges:{_ranges_str(s.ranges)}{filt}")
+    if isinstance(p, PhysicalIndexLookUpReader):
+        s = p.index_scan
+        filt = (f", filters:{len(p.table_scan.filters)}"
+                if p.table_scan.filters else "")
+        return (f"table:{s.alias}, index:{s.index.name}, "
+                f"ranges:{_ranges_str(s.ranges)}{filt}")
     if isinstance(p, PhysicalSelection):
         return ", ".join(c.key() for c in p.conditions)
     if isinstance(p, PhysicalProjection):

@@ -241,10 +241,13 @@ def _bind(exprs: List[Expression], schema: Schema) -> List[Expression]:
 def to_physical(p: LogicalPlan) -> PhysicalPlan:
     if isinstance(p, LogicalDataSource):
         with_handle = any(c.name == HANDLE_COL_NAME for c in p.schema.columns)
-        scan = PhysicalTableScan(p.table_info, p.db_name, p.alias, p.schema,
-                                 with_handle)
-        scan.filters = _bind(p.pushed_conds, p.schema)
-        return PhysicalTableReader(scan)
+        from .access import build_reader
+        stats = None
+        storage = getattr(p, "storage", None)
+        if storage is not None:
+            from ..statistics.table_stats import load_stats
+            stats = load_stats(storage, p.table_info.id)
+        return build_reader(p, stats, with_handle)
     if isinstance(p, LogicalSelection):
         child = to_physical(p.child(0))
         return PhysicalSelection(_bind(p.conditions, child.schema), child)

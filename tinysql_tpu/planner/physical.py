@@ -64,6 +64,8 @@ class PhysicalIndexScan(PhysicalPlan):
         self.ranges = ranges
         self.filters: List[Expression] = []
         self.desc = False
+        # covering reads: per-schema-column source ("idx", i) | ("handle",)
+        self.output_sources: List[tuple] = []
 
 
 class PhysicalTableReader(PhysicalPlan):
