@@ -1,0 +1,139 @@
+"""Storage-side coprocessor interpreter (reference:
+mocktikv/cop_handler_dag.go:49-160 + executor.go/aggregate.go/topn.go —
+the row-at-a-time reference interpreter, here chunk-vectorized: the scan
+decodes into a Chunk and the pushed chain runs the same numpy builtins the
+root executor uses).
+
+Installed on the RPC client as `cop_handler`; one call = one region's worth
+of one DAGRequest.  Lock conflicts surface as KeyIsLocked and are resolved
+by the client (store/tikv semantics).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..chunk import Chunk
+from ..codec import rowcodec, tablecodec
+from ..expression import vectorized_filter
+from ..expression.aggregation import AggFuncDesc, AggMode
+from ..mytypes import FieldType
+from .exprpb import _ft_from_pb, pb_to_expr
+from .request import DAGRequest
+
+
+def make_cop_handler(mvcc):
+    def handle(region, task) -> list:
+        req: DAGRequest = task["req"]
+        start, end = task["range"]
+        s = max(start, region.start)
+        e = min(end, region.end) if region.end else end
+        pairs = mvcc.scan(s, e, req.start_ts, 0, req.resolved)
+        return run_dag(req, _decode_chunk(req, pairs))
+    return handle
+
+
+def _decode_chunk(req: DAGRequest, pairs) -> Chunk:
+    scan = req.scan
+    fts = [_ft_from_pb(d) for d in scan.col_fts]
+    chk = Chunk(fts, cap=max(len(pairs), 1))
+    for k, v in pairs:
+        if not tablecodec.is_record_key(k):
+            continue
+        _, handle = tablecodec.decode_record_key(k)
+        row = rowcodec.decode_row_to_datums(
+            v, scan.col_ids, fts, defaults=scan.col_defaults)
+        for slot in scan.handle_slots:
+            row[slot] = handle
+        if scan.pk_id is not None:
+            for i, cid in enumerate(scan.col_ids):
+                if cid == scan.pk_id:
+                    row[i] = handle
+        chk.append_row(row)
+    return chk
+
+
+def run_dag(req: DAGRequest, chk: Chunk) -> list:
+    """Execute the pushed chain over decoded rows; returns output rows as
+    plain value lists (the 'tipb.SelectResponse chunk' analogue)."""
+    import numpy as np
+    if req.filters:
+        conds = [pb_to_expr(d) for d in req.filters]
+        if chk.num_rows():
+            mask = vectorized_filter(conds, chk)
+            chk.set_sel(np.nonzero(mask)[0])
+            chk = chk.compact()
+    if req.agg is not None:
+        return _partial_agg(req.agg, chk)
+    rows = [list(chk.get_row(i)) for i in range(chk.num_rows())]
+    if req.topn is not None:
+        rows = _topn(req.topn, chk, rows)
+    if req.limit is not None:
+        rows = rows[:req.limit]
+    return rows
+
+
+def _partial_agg(agg_pb: dict, chk: Chunk) -> list:
+    """Per-region PARTIAL1 aggregation (reference: mocktikv/aggregate.go);
+    output rows = [group key values..., flattened partial states...]."""
+    from ..executor.aggfuncs import new_state
+    gb = [pb_to_expr(d) for d in agg_pb["group_by"]]
+    descs = []
+    for a in agg_pb["aggs"]:
+        descs.append(AggFuncDesc(a["name"], [pb_to_expr(x) for x in a["args"]],
+                                 AggMode.PARTIAL1, a["distinct"],
+                                 _ft_from_pb(a["ret"]) if "ret" in a
+                                 else None))
+    n = chk.num_rows()
+    groups = {}
+    order = []
+    rows = [chk.get_row(i) for i in range(n)]
+    for i in range(n):
+        key = tuple(_sem(v) for v in (e.eval(rows[i]) for e in gb))
+        st = groups.get(key)
+        if st is None:
+            st = groups[key] = [new_state(d) for d in descs]
+            order.append(key)
+        for j, d in enumerate(descs):
+            st[j].update([a.eval(rows[i]) for a in d.args])
+    out = []
+    for key in order:
+        row = list(key)
+        for st in groups[key]:
+            row.extend(st.partial())
+        out.append(row)
+    return out
+
+
+def _sem(v):
+    return v.item() if hasattr(v, "item") else v
+
+
+def _topn(topn_pb: dict, chk: Chunk, rows: list) -> list:
+    from ..mytypes import sort_key
+    by = [(pb_to_expr(d), desc) for d, desc in topn_pb["by"]]
+
+    def key_fn(row):
+        ks = []
+        for e, desc in by:
+            v = e.eval(row)
+            if v is None:
+                ks.append((0 if not desc else 2, 0))
+            else:
+                sk = sort_key(v)
+                ks.append((1, _Rev(sk) if desc else sk))
+        return ks
+    rows = sorted(rows, key=key_fn)
+    return rows[:topn_pb["n"]]
+
+
+class _Rev:
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return other.v == self.v

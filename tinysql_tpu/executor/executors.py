@@ -100,26 +100,103 @@ class TableReaderExec(Executor):
                 assert ci is not None, f"column {c.name} missing in {info.name}"
                 self._decode_cols.append(ci)
         self._real_cols = [ci for ci in self._decode_cols if ci is not None]
-        # columnar replica fast path (columnar/store.py) — full scans only;
-        # ranged scans seek the row store directly
         self._replica = None
         self._pos = 0
+        self._iter = None
+        self._cop = None
+        self._local_agg = None
+        self._hydrate = None
+        dirty = (ctx.txn is not None and ctx.storage is not None
+                 and self._txn_dirty(ctx.txn, info.id))
+        # columnar replica fast path (columnar/store.py) — full scans only;
+        # ranged scans seek the row store directly
         if ctx.storage is not None and self.scan.ranges is None:
             from ..columnar.store import replica_for_read
             rep = replica_for_read(ctx.storage, ctx.txn, info.id)
             if rep is not None and all(ci.id in rep.columns
                                        for ci in self._real_cols):
                 self._replica = rep
-        self._iter = None
-        self._hydrate = None
-        if self._replica is None:
-            if self.scan.ranges is not None:
-                self._iter = self._iter_ranges(ctx.txn)
+                if self.scan.pushed_agg is not None:
+                    self._local_agg = True  # partial agg over replica chunks
+                return
+        if self.scan.pushed_agg is not None:
+            # partial-agg reads: coprocessor path; a dirty txn falls back to
+            # a local partial agg over the union-store scan (the UnionScan
+            # analogue — own buffered writes must stay visible)
+            if ctx.storage is not None and not dirty:
+                self._cop = self._cop_select()
             else:
-                self._iter = self._tbl.iter_records(ctx.txn,
-                                                    cols=self._real_cols)
-                if ctx.storage is not None and self._real_cols:
-                    self._hydrate = {"handles": [], "rows": []}
+                self._iter = self._scan_iter(ctx.txn)
+                self._local_agg = True
+            return
+        has_pushdown = (self.scan.filters or self.scan.ranges is not None
+                        or self.scan.pushed_topn is not None
+                        or self.scan.pushed_limit is not None)
+        if ctx.storage is not None and not dirty and has_pushdown:
+            # region scatter-gather with filter/topn/limit pushdown
+            self._cop = self._cop_select()
+            return
+        self._iter = self._scan_iter(ctx.txn)
+        if (ctx.storage is not None and not dirty
+                and self.scan.ranges is None and self._real_cols):
+            # pure full scan: hydrate the columnar replica as a side effect
+            self._hydrate = {"handles": [], "rows": []}
+
+    @staticmethod
+    def _txn_dirty(txn, table_id: int) -> bool:
+        from ..columnar.store import _txn_touches_table
+        return _txn_touches_table(txn, table_id)
+
+    def _scan_iter(self, txn):
+        if self.scan.ranges is not None:
+            return self._iter_ranges(txn)
+        return self._tbl.iter_records(txn, cols=self._real_cols)
+
+    def _cop_select(self):
+        """Build the DAG request + key ranges and start the scatter-gather
+        (reference: distsql.Select via RequestBuilder)."""
+        from ..codec import tablecodec
+        from ..distsql import DAGRequest, ScanInfo, select
+        from ..distsql.exprpb import _ft_to_pb, exprs_to_pb
+        info = self.scan.table_info
+        pk = info.get_pk_handle_col()
+        scan_info = ScanInfo(
+            table_id=info.id,
+            col_ids=[ci.id if ci is not None else -1
+                     for ci in self._decode_cols],
+            col_fts=[_ft_to_pb(c.ret_type)
+                     for c in self.scan.schema.columns],
+            col_defaults=[ci.default if ci is not None else None
+                          for ci in self._decode_cols],
+            handle_slots=[i for i, ci in enumerate(self._decode_cols)
+                          if ci is None],
+            pk_id=pk.id if pk is not None else None,
+        )
+        filters_pb = exprs_to_pb(self.scan.filters) if self.scan.filters \
+            else None
+        self._cop_filters_pushed = not self.scan.filters \
+            or filters_pb is not None
+        # topn/limit may only pre-cut AFTER all filters ran cop-side
+        pre_cut_ok = self._cop_filters_pushed
+        req = DAGRequest(
+            start_ts=self.ctx.txn.start_ts,
+            scan=scan_info,
+            filters=filters_pb,
+            agg=self.scan.pushed_agg,
+            topn=self.scan.pushed_topn if pre_cut_ok else None,
+            limit=self.scan.pushed_limit if pre_cut_ok else None,
+        )
+        if self.scan.ranges is not None:
+            ranges = []
+            for lo, hi in self.scan.ranges:
+                ranges.append((tablecodec.encode_row_key(info.id, lo),
+                               tablecodec.encode_row_key(info.id, hi)
+                               + b"\x00"))
+        else:
+            ranges = [tablecodec.record_range(info.id)]
+        conc = int(self.ctx.session_vars.get(
+            "tidb_distsql_scan_concurrency", 15))
+        return select(self.ctx.storage, req, ranges, conc)
 
     def _iter_ranges(self, txn):
         """Seek each [lo, hi] handle range directly (reference:
@@ -134,11 +211,69 @@ class TableReaderExec(Executor):
                                                    self._real_cols)
 
     def next(self) -> Optional[Chunk]:
+        if self._cop is not None:
+            return self._next_cop()
+        if self._local_agg:
+            return self._next_local_agg()
         if self._replica is not None:
-            return self._next_fast()
+            return self._apply_filters_or_none(self._next_fast_raw())
         return self._next_scan()
 
-    def _next_fast(self) -> Optional[Chunk]:
+    def _apply_filters_or_none(self, chk):
+        return None if chk is None else self._apply_filters(chk)
+
+    def _next_cop(self) -> Optional[Chunk]:
+        while True:
+            batch = next(self._cop, None)
+            if batch is None:
+                self._cop = iter(())
+                return None
+            if not batch:
+                continue
+            chk = Chunk(self.field_types(), cap=len(batch))
+            for row in batch:
+                chk.append_row(row)
+            if (not self._cop_filters_pushed
+                    and self.scan.pushed_agg is None):
+                chk = self._apply_filters(chk)
+                if chk.num_rows() == 0:
+                    continue
+            return chk
+
+    def _next_local_agg(self) -> Optional[Chunk]:
+        """Local partial aggregation over raw chunks — from the columnar
+        replica or (dirty txn) the union-store scan.  One output batch per
+        raw batch: partials merge at the root FINAL agg, so per-batch
+        groups are sound."""
+        from ..distsql.copr import _partial_agg
+        limit = max(self.ctx.max_chunk_size, 4096)
+        scan_fts = [c.ret_type for c in self.scan.schema.columns]
+        while True:
+            if self._replica is not None:
+                raw = self._next_fast_raw()
+                if raw is None:
+                    return None
+            else:
+                if self._iter is None:
+                    return None
+                raw = Chunk(scan_fts, cap=limit)
+                if self._fill_from_iter(raw, limit) == 0:
+                    self._iter = None
+                    return None
+            if self.scan.filters:
+                mask = vectorized_filter(self.scan.filters, raw)
+                raw.set_sel(np.nonzero(mask)[0])
+                raw = raw.compact()
+            rows = _partial_agg(self.scan.pushed_agg, raw)
+            if not rows:
+                continue
+            out = Chunk(self.field_types(), cap=len(rows))
+            for r in rows:
+                out.append_row(r)
+            return out
+
+    def _next_fast_raw(self) -> Optional[Chunk]:
+        """Next unfiltered slice of the columnar replica."""
         rep = self._replica
         if self._pos >= rep.n_rows:
             return None
@@ -152,14 +287,11 @@ class TableReaderExec(Executor):
             else:
                 v, m = rep.columns[ci.id]
                 cols.append(CCol.from_numpy(c.ret_type, v[lo:hi], m[lo:hi]))
-        chk = Chunk.from_columns(cols)
-        return self._apply_filters(chk)
+        return Chunk.from_columns(cols)
 
-    def _next_scan(self) -> Optional[Chunk]:
-        if self._iter is None:
-            return None
-        limit = self.ctx.max_chunk_size
-        chk = Chunk(self.field_types(), cap=limit)
+    def _fill_from_iter(self, chk: Chunk, limit: int) -> int:
+        """Drain up to `limit` (handle, row) pairs from the scan iterator
+        into `chk`, interleaving the handle into its schema slots."""
         n = 0
         for handle, row in self._iter:
             vals = []
@@ -173,7 +305,14 @@ class TableReaderExec(Executor):
             n += 1
             if n >= limit:
                 break
-        if n == 0:
+        return n
+
+    def _next_scan(self) -> Optional[Chunk]:
+        if self._iter is None:
+            return None
+        limit = self.ctx.max_chunk_size
+        chk = Chunk(self.field_types(), cap=limit)
+        if self._fill_from_iter(chk, limit) == 0:
             self._iter = None
             self._finish_hydrate()
             return None
@@ -221,6 +360,7 @@ class TableReaderExec(Executor):
 
     def close(self) -> None:
         self._iter = None
+        self._cop = None
         self._hydrate = None
         super().close()
 

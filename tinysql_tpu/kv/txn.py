@@ -470,6 +470,8 @@ class TiKVStorage:
         self.oracle = Oracle()
         self.resolver = LockResolver(self.client, self.cache, self.oracle,
                                      storage=self)
+        from ..distsql.copr import make_cop_handler
+        self.client.cop_handler = make_cop_handler(self.mvcc)
 
     def begin(self, start_ts: Optional[int] = None) -> Transaction:
         if start_ts is None:

@@ -163,6 +163,10 @@ def coerce_for_compare(a: Datum, b: Datum) -> tuple:
     numeric vs string compares numerically; string vs string binary collate."""
     if isinstance(a, str) and isinstance(b, str):
         return a, b
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        # python compares int/float pairs exactly — do NOT lift big ints
+        # to float64 (2^63+3 and 2^63+9 both round to the same double)
+        return a, b
     if isinstance(a, (int, float)) or isinstance(b, (int, float)):
         return to_real(a), to_real(b)
     return to_string(a), to_string(b)

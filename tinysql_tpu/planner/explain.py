@@ -20,8 +20,15 @@ def _info(p: PhysicalPlan) -> str:
     if isinstance(p, PhysicalTableReader):
         s = p.scan
         filt = f", filters:{len(s.filters)}" if s.filters else ""
+        push = ""
+        if s.pushed_agg is not None:
+            push = f", cop_agg:{len(s.pushed_agg['aggs'])}"
+        elif s.pushed_topn is not None:
+            push = f", cop_topn:{s.pushed_topn['n']}"
+        elif s.pushed_limit is not None:
+            push = f", cop_limit:{s.pushed_limit}"
         return (f"table:{s.alias}, ranges:{_ranges_str(s.ranges)}, "
-                f"keep order:false{filt}")
+                f"keep order:false{filt}{push}")
     if isinstance(p, PhysicalIndexReader):
         s = p.scan
         filt = f", filters:{len(s.filters)}" if s.filters else ""

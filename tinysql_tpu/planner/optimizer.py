@@ -315,4 +315,6 @@ def optimize(logical: LogicalPlan, tpu: bool = True) -> PhysicalPlan:
     logical = topn_pushdown(logical)
     phys = to_physical(logical)
     from .device import place_devices
-    return place_devices(phys, enabled=tpu)
+    phys = place_devices(phys, enabled=tpu)
+    from .cop import push_to_cop
+    return push_to_cop(phys)

@@ -50,6 +50,10 @@ class PhysicalTableScan(PhysicalPlan):
         self.with_handle = with_handle
         self.ranges: Optional[list] = None   # handle ranges; None = full
         self.filters: List[Expression] = []  # pushed-down, schema-bound
+        # coprocessor-side executor chain (planner/cop.py push_to_cop)
+        self.pushed_agg: Optional[dict] = None
+        self.pushed_topn: Optional[dict] = None
+        self.pushed_limit: Optional[int] = None
 
 
 class PhysicalIndexScan(PhysicalPlan):
