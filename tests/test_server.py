@@ -1,0 +1,225 @@
+"""MySQL wire protocol server tests: a minimal raw-socket client performs
+the real handshake and text-protocol queries against a live server on an
+ephemeral port (reference test pattern: server/tidb_test.go drives a real
+Go MySQL client against a listening server).
+"""
+import json
+import socket
+import struct
+import urllib.request
+
+import pytest
+
+from tinysql_tpu.kv import new_mock_storage
+from tinysql_tpu.server.http_status import StatusServer
+from tinysql_tpu.server.packetio import (PacketIO, lenenc_int,
+                                         read_lenenc_int)
+from tinysql_tpu.server.server import Server
+
+
+class MiniClient:
+    """Just enough of the client side of the protocol for tests."""
+
+    def __init__(self, port, db=""):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+        self.io = PacketIO(self.sock)
+        greeting = self.io.read_packet()
+        assert greeting[0] == 10, "expected protocol v10 greeting"
+        self.server_version = greeting[1:greeting.index(0, 1)].decode()
+        caps = 0x0200 | 0x8000 | 0x00008 if db else 0x0200 | 0x8000
+        payload = struct.pack("<IIB", caps, 1 << 24, 0x21) + b"\x00" * 23
+        payload += b"root\x00" + b"\x00"  # empty auth response (lenenc 0)
+        if db:
+            payload += db.encode() + b"\x00"
+        self.io.write_packet(payload)
+        resp = self.io.read_packet()
+        assert resp[0] == 0x00, f"auth failed: {resp!r}"
+
+    def query(self, sql):
+        """Returns (columns, rows) for resultsets, or affected count."""
+        self.io.reset_sequence()
+        self.io.write_packet(b"\x03" + sql.encode())
+        first = self.io.read_packet()
+        if first[0] == 0x00:  # OK
+            affected, _ = read_lenenc_int(first, 1)
+            return affected
+        if first[0] == 0xFF:
+            code = struct.unpack_from("<H", first, 1)[0]
+            raise RuntimeError(f"server error {code}: "
+                               f"{first[9:].decode(errors='replace')}")
+        ncols, _ = read_lenenc_int(first, 0)
+        cols = []
+        for _ in range(ncols):
+            d = self.io.read_packet()
+            pos = 0
+            vals = []
+            for _ in range(6):
+                ln, pos = read_lenenc_int(d, pos)
+                vals.append(d[pos:pos + ln])
+                pos += ln
+            cols.append(vals[4].decode())
+        assert self.io.read_packet()[0] == 0xFE  # EOF
+        rows = []
+        while True:
+            d = self.io.read_packet()
+            if d[0] == 0xFE and len(d) < 9:
+                break
+            pos = 0
+            row = []
+            for _ in range(ncols):
+                if d[pos] == 0xFB:
+                    row.append(None)
+                    pos += 1
+                else:
+                    ln, pos = read_lenenc_int(d, pos)
+                    row.append(d[pos:pos + ln].decode())
+                    pos += ln
+            rows.append(row)
+        return cols, rows
+
+    def close(self):
+        try:
+            self.io.write_packet(b"\x01")
+        except OSError:
+            pass
+        self.sock.close()
+
+
+@pytest.fixture(scope="module")
+def server():
+    storage = new_mock_storage()
+    srv = Server(storage, port=0)  # ephemeral
+    srv.start()
+    yield srv
+    srv.close()
+
+
+def test_handshake_and_version(server):
+    c = MiniClient(server.port)
+    assert "tinysql-tpu" in c.server_version
+    c.close()
+
+
+def test_query_roundtrip(server):
+    c = MiniClient(server.port)
+    c.query("create database if not exists wiretest")
+    c.query("use wiretest")
+    c.query("create table t (a int primary key, b double, c varchar(20))")
+    affected = c.query("insert into t values (1, 1.5, 'x'), (2, null, null)")
+    assert affected == 2
+    cols, rows = c.query("select a, b, c from t order by a")
+    assert cols == ["a", "b", "c"]
+    assert rows == [["1", "1.5", "x"], ["2", None, None]]
+    c.close()
+
+
+def test_error_packet(server):
+    c = MiniClient(server.port)
+    with pytest.raises(RuntimeError, match="server error"):
+        c.query("select * from wiretest.does_not_exist")
+    c.close()
+
+
+def test_two_connections_share_storage(server):
+    c1 = MiniClient(server.port)
+    c2 = MiniClient(server.port)
+    c1.query("create database if not exists shared")
+    c1.query("use shared")
+    c1.query("create table s (a int primary key)")
+    c1.query("insert into s values (42)")
+    _, rows = c2.query("select a from shared.s")
+    assert rows == [["42"]]
+    c1.close()
+    c2.close()
+
+
+def test_txn_isolation_across_connections(server):
+    c1 = MiniClient(server.port)
+    c2 = MiniClient(server.port)
+    c1.query("use shared")
+    c2.query("use shared")
+    c1.query("begin")
+    c1.query("insert into s values (99)")
+    _, rows = c2.query("select a from s order by a")
+    assert ["99"] not in rows  # uncommitted: invisible
+    c1.query("commit")
+    _, rows = c2.query("select a from s order by a")
+    assert ["99"] in rows
+    c1.close()
+    c2.close()
+
+
+def test_connect_with_db(server):
+    c = MiniClient(server.port, db="shared")
+    _, rows = c.query("select count(*) from s")
+    assert rows[0][0] == "2"
+    c.close()
+
+
+def test_multi_statement_query(server):
+    c = MiniClient(server.port)
+    c.query("create database if not exists multi")
+    c.query("use multi")
+    c.query("create table m (a int primary key)")
+    # two resultsets + trailing DML in ONE COM_QUERY; each response chained
+    # with SERVER_MORE_RESULTS_EXISTS, read back-to-back
+    c.io.reset_sequence()
+    c.io.write_packet(b"\x03" + b"select 1; select 2; insert into m values (7)")
+    # resultset 1
+    for want in ("1", "2"):
+        first = c.io.read_packet()
+        ncols, _ = read_lenenc_int(first, 0)
+        for _ in range(ncols):
+            c.io.read_packet()
+        eof1 = c.io.read_packet()
+        assert eof1[0] == 0xFE
+        row = c.io.read_packet()
+        assert want.encode() in row
+        eof2 = c.io.read_packet()
+        assert eof2[0] == 0xFE
+        status = struct.unpack_from("<H", eof2, 3)[0]
+        assert status & 0x0008, "SERVER_MORE_RESULTS_EXISTS missing"
+    ok = c.io.read_packet()
+    assert ok[0] == 0x00
+    affected, _ = read_lenenc_int(ok, 1)
+    assert affected == 1
+    # connection still in sync
+    _, rows = c.query("select a from m")
+    assert rows == [["7"]]
+    c.close()
+
+
+def test_affected_rows_reset_after_ddl(server):
+    c = MiniClient(server.port)
+    c.query("create database if not exists ar")
+    c.query("use ar")
+    c.query("create table r (a int primary key)")
+    assert c.query("insert into r values (1), (2)") == 2
+    assert c.query("create table r2 (a int primary key)") == 0
+    assert c.query("begin") == 0
+    assert c.query("commit") == 0
+    c.close()
+
+
+def test_status_endpoint(server):
+    st = StatusServer(server, port=0)
+    st.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{st.port}/status", timeout=5) as r:
+            data = json.loads(r.read())
+        assert "tinysql-tpu" in data["version"]
+    finally:
+        st.close()
+
+
+def test_config_strict_load(tmp_path):
+    from tinysql_tpu import config as cfgmod
+    f = tmp_path / "ok.toml"
+    f.write_text('port = 4001\n[log]\nlevel = "debug"\n')
+    cfg = cfgmod.load(str(f))
+    assert cfg.port == 4001 and cfg.log.level == "debug"
+    bad = tmp_path / "bad.toml"
+    bad.write_text("nonexistent-key = 1\n")
+    with pytest.raises(cfgmod.ConfigError, match="unknown configuration"):
+        cfgmod.load(str(bad))

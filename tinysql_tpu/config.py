@@ -1,0 +1,86 @@
+"""Layered configuration (reference: config/config.go — defaults struct
+:155, strict TOML Load :118-140 with unknown-key detection, CLI flag
+overrides in tidb-server/main.go:176-234, atomic global :108)."""
+from __future__ import annotations
+
+import threading
+import tomllib
+from dataclasses import dataclass, field, fields, is_dataclass
+
+
+class ConfigError(Exception):
+    pass
+
+
+@dataclass
+class Log:
+    level: str = "info"
+    file: str = ""          # empty = stderr
+    slow_threshold_ms: int = 300
+
+
+@dataclass
+class Status:
+    report_status: bool = True
+    status_host: str = "127.0.0.1"
+    status_port: int = 10080
+
+
+@dataclass
+class Config:
+    host: str = "127.0.0.1"
+    port: int = 4000
+    store: str = "mocktikv"          # mocktikv | tikv
+    path: str = "/tmp/tinysql_tpu"
+    lease: str = "45s"
+    num_stores: int = 1
+    use_tpu: bool = True
+    log: Log = field(default_factory=Log)
+    status: Status = field(default_factory=Status)
+
+
+def _apply(obj, data: dict, prefix: str = "") -> None:
+    known = {f.name: f for f in fields(obj)}
+    for k, v in data.items():
+        key = k.replace("-", "_")
+        if key not in known:
+            raise ConfigError(
+                f"unknown configuration option {prefix}{k!r}")
+        cur = getattr(obj, key)
+        if isinstance(v, dict):
+            if not is_dataclass(cur):
+                raise ConfigError(
+                    f"{prefix}{k} is a scalar option, not a section")
+            _apply(cur, v, prefix=f"{prefix}{k}.")
+        else:
+            if not isinstance(v, type(cur)) and not (
+                    isinstance(cur, bool) is isinstance(v, bool)
+                    and isinstance(v, int) and isinstance(cur, int)):
+                raise ConfigError(
+                    f"bad type for {prefix}{k}: {type(v).__name__}")
+            setattr(obj, key, v)
+
+
+def load(path: str = "") -> Config:
+    """TOML file -> Config with strict unknown-key detection
+    (reference: ErrConfigValidationFailed)."""
+    cfg = Config()
+    if path:
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        _apply(cfg, data)
+    return cfg
+
+
+_global = Config()
+_mu = threading.Lock()
+
+
+def get_global_config() -> Config:
+    return _global
+
+
+def store_global_config(cfg: Config) -> None:
+    global _global
+    with _mu:
+        _global = cfg

@@ -1,0 +1,70 @@
+"""Status HTTP endpoint (reference: server/http_status.go:32-99 — index
+page, /status JSON, pprof routes; pprof is Go-specific, the analogue here
+is /debug/threads).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+def _make_handler(server_ref):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, body: bytes,
+                  ctype: str = "application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            srv = server_ref()
+            if self.path == "/status":
+                from ..server.protocol import SERVER_VERSION
+                body = json.dumps({
+                    "version": SERVER_VERSION,
+                    "connections": len(srv.conns) if srv else 0,
+                }).encode()
+                self._send(200, body)
+            elif self.path == "/debug/threads":
+                out = []
+                for tid, frame in sys._current_frames().items():
+                    out.append(f"--- thread {tid} ---")
+                    out.extend(traceback.format_stack(frame))
+                self._send(200, "\n".join(out).encode(),
+                           "text/plain; charset=utf-8")
+            elif self.path == "/":
+                self._send(200, b"<h1>tinysql-tpu status</h1>"
+                           b'<a href="/status">status</a> '
+                           b'<a href="/debug/threads">threads</a>',
+                           "text/html")
+            else:
+                self._send(404, b"{}")
+    return Handler
+
+
+class StatusServer:
+    def __init__(self, mysql_server, host: str = "127.0.0.1", port: int = 0):
+        import weakref
+        ref = weakref.ref(mysql_server) if mysql_server is not None \
+            else (lambda: None)
+        self.httpd = ThreadingHTTPServer((host, port), _make_handler(ref))
+        self.port = self.httpd.server_address[1]
+
+    def start(self) -> int:
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True,
+                             name="status-http")
+        t.start()
+        return self.port
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

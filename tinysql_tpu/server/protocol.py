@@ -1,0 +1,151 @@
+"""MySQL protocol payloads: handshake, OK/ERR/EOF, column definitions,
+text-protocol resultset rows (reference: server/conn.go writeInitialHandshake
+:117, readOptionalSSLRequestAndHandshakeResponse :418, writeOK/writeError,
+writeResultset :931-1050).
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Optional
+
+from ..mytypes import EvalType, FieldType
+from .packetio import lenenc_int, lenenc_str, read_lenenc_int, read_nul_str
+
+SERVER_VERSION = "5.7.25-tinysql-tpu-1.0"
+
+# capability flags (subset)
+CLIENT_LONG_PASSWORD = 1 << 0
+CLIENT_FOUND_ROWS = 1 << 1
+CLIENT_LONG_FLAG = 1 << 2
+CLIENT_CONNECT_WITH_DB = 1 << 3
+CLIENT_PROTOCOL_41 = 1 << 9
+CLIENT_TRANSACTIONS = 1 << 13
+CLIENT_SECURE_CONNECTION = 1 << 15
+CLIENT_MULTI_STATEMENTS = 1 << 16
+CLIENT_MULTI_RESULTS = 1 << 17
+CLIENT_PLUGIN_AUTH = 1 << 19
+
+SERVER_CAPS = (CLIENT_LONG_PASSWORD | CLIENT_FOUND_ROWS | CLIENT_LONG_FLAG
+               | CLIENT_CONNECT_WITH_DB | CLIENT_PROTOCOL_41
+               | CLIENT_TRANSACTIONS | CLIENT_SECURE_CONNECTION
+               | CLIENT_MULTI_STATEMENTS | CLIENT_MULTI_RESULTS
+               | CLIENT_PLUGIN_AUTH)
+
+SERVER_STATUS_AUTOCOMMIT = 0x0002
+SERVER_MORE_RESULTS_EXISTS = 0x0008
+
+# MySQL column types
+TYPE_LONGLONG = 0x08
+TYPE_DOUBLE = 0x05
+TYPE_VAR_STRING = 0xFD
+
+# commands
+COM_QUIT = 0x01
+COM_INIT_DB = 0x02
+COM_QUERY = 0x03
+COM_PING = 0x0E
+
+
+def handshake_v10(conn_id: int, salt: bytes) -> bytes:
+    out = bytearray()
+    out.append(10)  # protocol version
+    out += SERVER_VERSION.encode() + b"\x00"
+    out += struct.pack("<I", conn_id)
+    out += salt[:8] + b"\x00"
+    out += struct.pack("<H", SERVER_CAPS & 0xFFFF)
+    out.append(0x21)  # charset utf8
+    out += struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
+    out += struct.pack("<H", (SERVER_CAPS >> 16) & 0xFFFF)
+    out.append(21)  # auth plugin data len
+    out += b"\x00" * 10
+    out += salt[8:20] + b"\x00"
+    out += b"mysql_native_password\x00"
+    return bytes(out)
+
+
+def parse_handshake_response(payload: bytes) -> dict:
+    caps = struct.unpack_from("<I", payload, 0)[0]
+    pos = 4 + 4 + 1 + 23  # caps, max packet, charset, reserved
+    user, pos = read_nul_str(payload, pos)
+    if caps & CLIENT_SECURE_CONNECTION:
+        alen = payload[pos]
+        pos += 1 + alen
+    else:
+        _, pos = read_nul_str(payload, pos)
+    db = b""
+    if caps & CLIENT_CONNECT_WITH_DB and pos < len(payload):
+        db, pos = read_nul_str(payload, pos)
+    return {"caps": caps, "user": user.decode(), "db": db.decode()}
+
+
+def ok_packet(affected: int = 0, last_insert_id: int = 0,
+              warnings: int = 0, more_results: bool = False) -> bytes:
+    status = SERVER_STATUS_AUTOCOMMIT | (
+        SERVER_MORE_RESULTS_EXISTS if more_results else 0)
+    return (b"\x00" + lenenc_int(affected) + lenenc_int(last_insert_id)
+            + struct.pack("<H", status)
+            + struct.pack("<H", warnings))
+
+
+def err_packet(code: int, message: str, state: str = "HY000") -> bytes:
+    return (b"\xff" + struct.pack("<H", code) + b"#" + state.encode()[:5]
+            + message.encode("utf-8", "replace"))
+
+
+def eof_packet(warnings: int = 0, more_results: bool = False) -> bytes:
+    status = SERVER_STATUS_AUTOCOMMIT | (
+        SERVER_MORE_RESULTS_EXISTS if more_results else 0)
+    return (b"\xfe" + struct.pack("<H", warnings)
+            + struct.pack("<H", status))
+
+
+def _mysql_type(ft: Optional[FieldType]):
+    if ft is None:
+        return TYPE_VAR_STRING, 0x21
+    et = ft.eval_type
+    if et is EvalType.INT:
+        return TYPE_LONGLONG, 0x3F  # binary charset for numerics
+    if et is EvalType.REAL:
+        return TYPE_DOUBLE, 0x3F
+    return TYPE_VAR_STRING, 0x21
+
+
+def column_def(name: str, ft: Optional[FieldType]) -> bytes:
+    tp, charset = _mysql_type(ft)
+    flags = ft.flag if ft is not None else 0
+    out = bytearray()
+    out += lenenc_str(b"def")          # catalog
+    out += lenenc_str(b"")             # schema
+    out += lenenc_str(b"")             # table
+    out += lenenc_str(b"")             # org_table
+    out += lenenc_str(name.encode())   # name
+    out += lenenc_str(name.encode())   # org_name
+    out.append(0x0C)                   # fixed-length fields marker
+    out += struct.pack("<H", charset)
+    out += struct.pack("<I", (ft.flen if ft is not None and ft.flen > 0
+                              else 255))
+    out.append(tp)
+    out += struct.pack("<H", flags & 0xFFFF)
+    out.append(0)                      # decimals
+    out += b"\x00\x00"
+    return bytes(out)
+
+
+def text_row(values: List[object]) -> bytes:
+    out = bytearray()
+    for v in values:
+        if v is None:
+            out += b"\xfb"
+        else:
+            if isinstance(v, float):
+                s = repr(v)
+            else:
+                s = str(v)
+            out += lenenc_str(s.encode("utf-8", "surrogateescape"))
+    return bytes(out)
+
+
+def new_salt() -> bytes:
+    # printable, non-zero bytes per protocol convention
+    return bytes((b % 93) + 33 for b in os.urandom(20))

@@ -1,0 +1,192 @@
+"""TCP server speaking the MySQL protocol (reference: server/server.go
+NewServer :121 / Run :155 accept loop / per-conn goroutine :225, and
+server/conn.go clientConn.Run :541, dispatch :667, handleQuery :821,
+writeResultset :931).
+
+One thread per connection (the per-connection-goroutine analogue, SURVEY
+§2.11 P1); each connection owns a Session over the shared storage.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+from typing import Dict, Optional
+
+from ..session.session import ResultSet, Session
+from . import protocol as p
+from .packetio import PacketIO
+
+log = logging.getLogger("tinysql_tpu.server")
+
+
+class ClientConn:
+    def __init__(self, server: "Server", conn: socket.socket, conn_id: int):
+        self.server = server
+        self.sock = conn
+        self.conn_id = conn_id
+        self.io = PacketIO(conn)
+        self.session = Session(server.storage)
+        self.alive = True
+
+    # ---- handshake (reference: conn.go:117,418) -------------------------
+    def handshake(self) -> bool:
+        import struct
+        salt = p.new_salt()
+        self.io.write_packet(p.handshake_v10(self.conn_id, salt))
+        try:
+            resp = p.parse_handshake_response(self.io.read_packet())
+        except (ConnectionError, IndexError, ValueError, struct.error):
+            return False  # not a MySQL client; close quietly
+        if resp["db"]:
+            try:
+                self.session.execute(f"use `{resp['db']}`")
+            except Exception as e:
+                self.io.write_packet(p.err_packet(1049, str(e), "42000"))
+                return False
+        self.user = resp["user"]
+        self.io.write_packet(p.ok_packet())
+        return True
+
+    # ---- command loop (reference: conn.go:541,667) ----------------------
+    def run(self) -> None:
+        try:
+            if not self.handshake():
+                return
+            while self.alive:
+                self.io.reset_sequence()
+                try:
+                    data = self.io.read_packet()
+                except ConnectionError:
+                    return
+                if not data:
+                    continue
+                cmd, payload = data[0], data[1:]
+                if cmd == p.COM_QUIT:
+                    return
+                try:
+                    if cmd == p.COM_PING:
+                        self.io.write_packet(p.ok_packet())
+                    elif cmd == p.COM_INIT_DB:
+                        db = payload.decode("utf-8", "replace")
+                        self._run_sql(f"use `{db}`")
+                    elif cmd == p.COM_QUERY:
+                        self._run_sql(payload.decode("utf-8", "replace"))
+                    else:
+                        self.io.write_packet(
+                            p.err_packet(1047, f"unknown command {cmd}"))
+                except ConnectionError:
+                    return
+                except Exception as e:  # one bad command != dead conn
+                    log.warning("conn-%d command error: %s",
+                                self.conn_id, e)
+                    try:
+                        self.io.write_packet(p.err_packet(1105, str(e)))
+                    except OSError:
+                        return
+        finally:
+            try:
+                self.session.rollback_txn()
+            except Exception:
+                pass
+            self.sock.close()
+            self.server.remove_conn(self.conn_id)
+
+    def _run_sql(self, sql: str) -> None:
+        """Execute statement-by-statement so each gets its own response,
+        chained with SERVER_MORE_RESULTS_EXISTS (reference: conn.go
+        handleQuery's multi-statement loop)."""
+        from ..parser import parse
+        try:
+            stmts = parse(sql)
+        except Exception as e:
+            self.io.write_packet(p.err_packet(1064, str(e), "42000"))
+            return
+        for i, stmt in enumerate(stmts):
+            more = i + 1 < len(stmts)
+            try:
+                rs = self.session._execute_stmt(stmt)
+            except Exception as e:
+                log.debug("query error: %s", e)
+                self.io.write_packet(p.err_packet(1105, str(e)))
+                return  # error aborts the remaining statements
+            if isinstance(rs, ResultSet):
+                self._write_resultset(rs, more)
+            else:
+                self.io.write_packet(p.ok_packet(
+                    affected=self.session.last_affected,
+                    more_results=more))
+
+    def _write_resultset(self, rs: ResultSet, more: bool = False) -> None:
+        from .packetio import lenenc_int
+        self.io.begin_buffer()  # whole resultset -> one sendall
+        try:
+            self.io.write_packet(lenenc_int(len(rs.columns)))
+            fields = rs.fields or [None] * len(rs.columns)
+            for name, ft in zip(rs.columns, fields):
+                self.io.write_packet(p.column_def(name, ft))
+            self.io.write_packet(p.eof_packet())
+            for row in rs.rows:
+                self.io.write_packet(p.text_row(row))
+            self.io.write_packet(p.eof_packet(more_results=more))
+        finally:
+            self.io.flush()
+
+
+class Server:
+    def __init__(self, storage, host: str = "127.0.0.1", port: int = 4000):
+        self.storage = storage
+        self.host = host
+        self.port = port
+        self.sock: Optional[socket.socket] = None
+        self.conns: Dict[int, ClientConn] = {}
+        self._next_id = 0
+        self._mu = threading.Lock()
+        self._closed = threading.Event()
+
+    def start(self) -> int:
+        """Bind + accept loop in a background thread; returns bound port."""
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((self.host, self.port))
+        self.port = self.sock.getsockname()[1]
+        self.sock.listen(128)
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="mysql-accept")
+        t.start()
+        log.info("listening on %s:%d", self.host, self.port)
+        return self.port
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, addr = self.sock.accept()
+            except OSError:
+                return
+            with self._mu:
+                self._next_id += 1
+                cid = self._next_id
+                cc = ClientConn(self, conn, cid)
+                self.conns[cid] = cc
+            threading.Thread(target=cc.run, daemon=True,
+                             name=f"conn-{cid}").start()
+
+    def remove_conn(self, cid: int) -> None:
+        with self._mu:
+            self.conns.pop(cid, None)
+
+    def close(self) -> None:
+        """Graceful drain (reference: server.go:155-283)."""
+        self._closed.set()
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+        with self._mu:
+            for cc in list(self.conns.values()):
+                cc.alive = False
+                try:
+                    cc.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass

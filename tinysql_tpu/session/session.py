@@ -49,6 +49,7 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
 class ResultSet:
     columns: List[str]
     rows: List[list]
+    fields: Optional[list] = None  # FieldType per column (wire protocol)
 
     def __iter__(self):
         return iter(self.rows)
@@ -161,6 +162,7 @@ class Session:
         # session/txn.go StmtRollback): a failed statement undoes only its
         # own buffered writes, the transaction stays open
         cp = self._txn.checkpoint() if (self._explicit_txn and self._txn) else None
+        self.last_affected = 0  # per-statement affected-rows counter
         try:
             rs = self._dispatch(stmt)
             self._finish_stmt(ok=True)
@@ -229,7 +231,8 @@ class Session:
             rows = ex.drain()
         finally:
             ex.close()
-        return ResultSet(columns, rows)
+        return ResultSet(columns, rows,
+                         [c.ret_type for c in logical.schema.columns])
 
     def _run_select_plan(self, stmt: ast.SelectStmt, txn) -> List[list]:
         builder = PlanBuilder(self)
