@@ -1,0 +1,273 @@
+"""Cascades transformation rules (reference:
+planner/cascades/transformation_rules.go — the Transformation interface
+with pattern + Match + OnTransform; the rule set mirrors the course's:
+PushSelDownTableScan/Join/Projection/Aggregation, MergeAdjacentSelection,
+PushTopNDownProjection, PushLimitDownProjection.
+
+The reference's :497 stub (PushAggDownGather — partial aggregation through
+the storage-gather boundary) and :800 stub (TopN onto index source) are
+realized in this engine at the shared physical tail: planner/cop.py
+push_to_cop splits aggregates into cop PARTIAL1 + root FINAL and pre-cuts
+TopN per region, and planner/access.py compiles TopN-compatible index
+ranges — both run on cascades output exactly as on the System-R path.
+"""
+from __future__ import annotations
+
+import copy
+from typing import List
+
+from ...expression import Column, Expression
+from ..logical import (JOIN_INNER, LogicalAggregation, LogicalDataSource,
+                       LogicalJoin, LogicalLimit, LogicalPlan,
+                       LogicalProjection, LogicalSelection, LogicalSort,
+                       LogicalTopN)
+from ..optimizer import substitute_column
+from .memo import ANY, Group, GroupExpr, Memo, Pattern
+
+
+def _mk_sel(conds, schema):
+    s = LogicalSelection.__new__(LogicalSelection)
+    LogicalPlan.__init__(s)
+    s.conditions = conds
+    s.schema = schema
+    return s
+
+
+def _mk_proj(exprs, schema):
+    pr = LogicalProjection.__new__(LogicalProjection)
+    LogicalPlan.__init__(pr)
+    pr.exprs = exprs
+    pr.schema = schema
+    return pr
+
+
+def _mk_topn(by, offset, count, schema):
+    t = LogicalTopN.__new__(LogicalTopN)
+    LogicalPlan.__init__(t)
+    t.by = by
+    t.offset = offset
+    t.count = count
+    t.schema = schema
+    return t
+
+
+def _mk_limit(offset, count, schema):
+    t = LogicalLimit.__new__(LogicalLimit)
+    LogicalPlan.__init__(t)
+    t.offset = offset
+    t.count = count
+    t.schema = schema
+    return t
+
+
+class Transformation:
+    pattern: Pattern = None
+
+    def on_transform(self, memo: Memo, group: Group, binding) -> bool:
+        """Insert equivalent expression(s) into `group`; returns True if
+        the memo changed."""
+        raise NotImplementedError
+
+
+def _clone_ds(ds: LogicalDataSource) -> LogicalDataSource:
+    c = LogicalDataSource(ds.db_name, ds.table_info, ds.alias,
+                          list(ds.schema.columns))
+    c.schema = ds.schema
+    c.pushed_conds = list(ds.pushed_conds)
+    c.all_conds = list(ds.all_conds)
+    c.possible_indices = list(ds.possible_indices)
+    if hasattr(ds, "storage"):
+        c.storage = ds.storage
+    return c
+
+
+class PushSelDownDataSource(Transformation):
+    """Selection(DataSource) => DataSource with merged pushed conds
+    (reference: PushSelDownTableScan/TiKVSingleGather)."""
+    pattern = Pattern(LogicalSelection, [Pattern(LogicalDataSource)])
+
+    def on_transform(self, memo, group, binding):
+        sel_ge, ds_ge = binding[0], binding[1][0]
+        ds = _clone_ds(ds_ge.op)
+        ds.pushed_conds.extend(sel_ge.op.conditions)
+        ds.all_conds = list(ds.pushed_conds)
+        return memo.insert_equivalent(group, ds, [])
+
+
+class MergeAdjacentSelection(Transformation):
+    """Selection(Selection(x)) => Selection(x) with merged CNF."""
+    pattern = Pattern(LogicalSelection, [Pattern(LogicalSelection)])
+
+    def on_transform(self, memo, group, binding):
+        outer, inner = binding[0], binding[1][0]
+        merged = _mk_sel(
+            list(outer.op.conditions) + list(inner.op.conditions),
+            group.schema)
+        return memo.insert_equivalent(group, merged, list(inner.children))
+
+
+class PushSelDownProjection(Transformation):
+    """Selection(Projection(x)) => Projection(Selection(x)) for conditions
+    expressible over the projection input."""
+    pattern = Pattern(LogicalSelection, [Pattern(LogicalProjection)])
+
+    def on_transform(self, memo, group, binding):
+        sel_ge, proj_ge = binding[0], binding[1][0]
+        proj = proj_ge.op
+        pushable, retained = [], []
+        for c in sel_ge.op.conditions:
+            cols = c.collect_columns()
+            if all(proj.schema.column_index(x) >= 0 for x in cols):
+                pushable.append(substitute_column(c, proj.schema, proj.exprs))
+            else:
+                retained.append(c)
+        if not pushable:
+            return False
+        child_group = proj_ge.children[0]
+        new_sel = _mk_sel(pushable, child_group.schema)
+        sel_group = Group(child_group.schema)
+        sel_group.insert(GroupExpr(new_sel, [child_group]))
+        new_proj = _mk_proj(list(proj.exprs), proj.schema)
+        if retained:
+            inner_proj_group = Group(proj.schema)
+            inner_proj_group.insert(GroupExpr(new_proj, [sel_group]))
+            top = _mk_sel(retained, group.schema)
+            return memo.insert_equivalent(group, top, [inner_proj_group])
+        return memo.insert_equivalent(group, new_proj, [sel_group])
+
+
+class PushSelDownJoin(Transformation):
+    """Selection(Join(l, r)) => Join' with side conditions pushed into new
+    child selections (reference: PushSelDownJoin)."""
+    pattern = Pattern(LogicalSelection, [Pattern(LogicalJoin)])
+
+    def on_transform(self, memo, group, binding):
+        from ..joinconds import classify_conjuncts
+        sel_ge, join_ge = binding[0], binding[1][0]
+        join: LogicalJoin = join_ge.op
+        lgroup, rgroup = join_ge.children
+        lsch, rsch = lgroup.schema, rgroup.schema
+        new_eq, lp, rp, other, retained = classify_conjuncts(
+            sel_ge.op.conditions, lsch, rsch, join.tp)
+        new_join = copy.copy(join)
+        new_join.eq_conditions = list(join.eq_conditions) + new_eq
+        new_join.other_conditions = list(join.other_conditions) + other
+        # the join's own one-side ON conditions push down WITH the
+        # selection's (seeding them is what keeps semantics — they must
+        # not be dropped from the transformed join)
+        left_push = list(join.left_conditions) + lp
+        right_push = list(join.right_conditions) + rp
+        new_join.left_conditions = []
+        new_join.right_conditions = []
+        if not (left_push or right_push or new_eq):
+            return False
+
+        def wrap(child_group, conds):
+            if not conds:
+                return child_group
+            s = _mk_sel(conds, child_group.schema)
+            g = Group(child_group.schema)
+            g.insert(GroupExpr(s, [child_group]))
+            return g
+        children = [wrap(lgroup, left_push), wrap(rgroup, right_push)]
+        if retained:
+            jg = Group(group.schema)
+            jg.insert(GroupExpr(new_join, children))
+            top = _mk_sel(retained, group.schema)
+            return memo.insert_equivalent(group, top, [jg])
+        return memo.insert_equivalent(group, new_join, children)
+
+
+class PushSelDownAggregation(Transformation):
+    """Selection(Agg(x)) => Agg(Selection(x)) for conditions over plain
+    group-by columns (reference: PushSelDownAggregation)."""
+    pattern = Pattern(LogicalSelection, [Pattern(LogicalAggregation)])
+
+    def on_transform(self, memo, group, binding):
+        sel_ge, agg_ge = binding[0], binding[1][0]
+        agg: LogicalAggregation = agg_ge.op
+        gb_uids = {c.unique_id for e in agg.group_by
+                   for c in ([e] if isinstance(e, Column) else [])}
+        push, retained = [], []
+        for c in sel_ge.op.conditions:
+            cols = c.collect_columns()
+            if cols and all(x.unique_id in gb_uids for x in cols):
+                push.append(c)
+            else:
+                retained.append(c)
+        if not push:
+            return False
+        child_group = agg_ge.children[0]
+        s = _mk_sel(push, child_group.schema)
+        sg = Group(child_group.schema)
+        sg.insert(GroupExpr(s, [child_group]))
+        new_agg = copy.copy(agg)
+        if retained:
+            ag = Group(agg.schema)
+            ag.insert(GroupExpr(new_agg, [sg]))
+            top = _mk_sel(retained, group.schema)
+            return memo.insert_equivalent(group, top, [ag])
+        return memo.insert_equivalent(group, new_agg, [sg])
+
+
+class PushTopNDownProjection(Transformation):
+    """TopN(Projection(x)) => Projection(TopN(x)) when sort keys resolve
+    below the projection (reference: PushTopNDownProjection)."""
+    pattern = Pattern(LogicalTopN, [Pattern(LogicalProjection)])
+
+    def on_transform(self, memo, group, binding):
+        topn_ge, proj_ge = binding[0], binding[1][0]
+        topn: LogicalTopN = topn_ge.op
+        proj = proj_ge.op
+        try:
+            by = [(substitute_column(e, proj.schema, proj.exprs), d)
+                  for e, d in topn.by]
+        except Exception:
+            return False
+        child_group = proj_ge.children[0]
+        inner = _mk_topn(by, topn.offset, topn.count, child_group.schema)
+        tg = Group(child_group.schema)
+        tg.insert(GroupExpr(inner, [child_group]))
+        new_proj = _mk_proj(list(proj.exprs), proj.schema)
+        return memo.insert_equivalent(group, new_proj, [tg])
+
+
+class MergeLimitSortToTopN(Transformation):
+    """Limit(Sort(x)) => TopN(x) (the System-R topn_pushdown analogue;
+    makes per-region TopN pre-cut reachable from cascades plans)."""
+    pattern = Pattern(LogicalLimit, [Pattern(LogicalSort)])
+
+    def on_transform(self, memo, group, binding):
+        lim_ge, sort_ge = binding[0], binding[1][0]
+        lim: LogicalLimit = lim_ge.op
+        topn = _mk_topn(list(sort_ge.op.by), lim.offset, lim.count,
+                        group.schema)
+        return memo.insert_equivalent(group, topn, list(sort_ge.children))
+
+
+class PushLimitDownProjection(Transformation):
+    """Limit(Projection(x)) => Projection(Limit(x))."""
+    pattern = Pattern(LogicalLimit, [Pattern(LogicalProjection)])
+
+    def on_transform(self, memo, group, binding):
+        lim_ge, proj_ge = binding[0], binding[1][0]
+        lim: LogicalLimit = lim_ge.op
+        proj = proj_ge.op
+        child_group = proj_ge.children[0]
+        inner = _mk_limit(lim.offset, lim.count, child_group.schema)
+        lg = Group(child_group.schema)
+        lg.insert(GroupExpr(inner, [child_group]))
+        new_proj = _mk_proj(list(proj.exprs), proj.schema)
+        return memo.insert_equivalent(group, new_proj, [lg])
+
+
+DEFAULT_RULES = [
+    MergeLimitSortToTopN(),
+    MergeAdjacentSelection(),
+    PushSelDownDataSource(),
+    PushSelDownProjection(),
+    PushSelDownJoin(),
+    PushSelDownAggregation(),
+    PushTopNDownProjection(),
+    PushLimitDownProjection(),
+]

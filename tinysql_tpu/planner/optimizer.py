@@ -56,42 +56,14 @@ def predicate_pushdown(p: LogicalPlan,
         return retained, p
 
     if isinstance(p, LogicalJoin):
+        from .joinconds import classify_conjuncts
         lsch, rsch = p.children[0].schema, p.children[1].schema
-        left_push = list(p.left_conditions)
-        right_push = list(p.right_conditions)
-        retained: List[Expression] = []
-        for c in conds:
-            cols = c.collect_columns()
-            on_left = all(lsch.contains(x) for x in cols)
-            on_right = all(rsch.contains(x) for x in cols)
-            if p.tp == JOIN_INNER:
-                if isinstance(c, type(c)) and getattr(c, "name", "") == "=":
-                    a, b = c.children()
-                    ac, bc = a.collect_columns(), b.collect_columns()
-                    if (ac and bc and all(lsch.contains(x) for x in ac)
-                            and all(rsch.contains(x) for x in bc)):
-                        p.eq_conditions.append((a, b))
-                        continue
-                    if (ac and bc and all(rsch.contains(x) for x in ac)
-                            and all(lsch.contains(x) for x in bc)):
-                        p.eq_conditions.append((b, a))
-                        continue
-                if on_left:
-                    left_push.append(c)
-                elif on_right:
-                    right_push.append(c)
-                else:
-                    p.other_conditions.append(c)
-            else:  # left outer join
-                if on_left:
-                    left_push.append(c)
-                elif on_right:
-                    # WHERE cond on right side of LEFT JOIN: NULL rows fail
-                    # the filter anyway, but pushing below the join would
-                    # change which rows get NULL-extended; keep above.
-                    retained.append(c)
-                else:
-                    retained.append(c)
+        new_eq, lp, rp, other, retained = classify_conjuncts(
+            conds, lsch, rsch, p.tp)
+        p.eq_conditions.extend(new_eq)
+        p.other_conditions.extend(other)
+        left_push = list(p.left_conditions) + lp
+        right_push = list(p.right_conditions) + rp
         p.left_conditions, p.right_conditions = [], []
         r1, lc = predicate_pushdown(p.children[0], left_push)
         r2, rc = predicate_pushdown(p.children[1], right_push)

@@ -40,6 +40,7 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     "tidb_distsql_scan_concurrency": 15,
     "tidb_index_lookup_concurrency": 4,
     "tidb_use_tpu": 1,           # device enforcer master switch
+    "tidb_enable_cascades_planner": 0,
     "sql_mode": "STRICT_TRANS_TABLES",
     "max_execution_time": 0,
 }
@@ -223,7 +224,7 @@ class Session:
         logical = builder.build_select(stmt)
         columns = [c.name for c in logical.schema.columns]
         use_tpu = bool(self.get_sysvar("tidb_use_tpu"))
-        phys = optimize(logical, tpu=use_tpu)
+        phys = self._optimize(logical, use_tpu)
         ex = build_executor(phys, use_tpu=use_tpu)
         ex.open(ExecContext(self.get_txn(), self.sysvars,
                             self.infoschema(), self.storage))
@@ -234,10 +235,18 @@ class Session:
         return ResultSet(columns, rows,
                          [c.ret_type for c in logical.schema.columns])
 
+    def _optimize(self, logical, use_tpu: bool):
+        """Route between the two optimizer frameworks (reference:
+        planner/optimize.go:29-56 EnableCascadesPlanner switch)."""
+        if bool(self.get_sysvar("tidb_enable_cascades_planner")):
+            from ..planner.cascades import find_best_plan
+            return find_best_plan(logical, tpu=use_tpu)
+        return optimize(logical, tpu=use_tpu)
+
     def _run_select_plan(self, stmt: ast.SelectStmt, txn) -> List[list]:
         builder = PlanBuilder(self)
         use_tpu = bool(self.get_sysvar("tidb_use_tpu"))
-        phys = optimize(builder.build_select(stmt), tpu=use_tpu)
+        phys = self._optimize(builder.build_select(stmt), use_tpu)
         ex = build_executor(phys, use_tpu=use_tpu)
         ex.open(ExecContext(txn, self.sysvars, self.infoschema(),
                             self.storage))
@@ -404,8 +413,8 @@ class Session:
         if not isinstance(stmt.stmt, ast.SelectStmt):
             raise SessionError("EXPLAIN supports SELECT only for now")
         builder = PlanBuilder(self)
-        phys = optimize(builder.build_select(stmt.stmt),
-                        tpu=bool(self.get_sysvar("tidb_use_tpu")))
+        phys = self._optimize(builder.build_select(stmt.stmt),
+                              bool(self.get_sysvar("tidb_use_tpu")))
         from ..planner.explain import explain_text
         rows = explain_text(phys)
         return ResultSet(["id", "task", "operator info"], rows)
