@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Build libtinysql_native.so (g++ -O3).  Invoked on demand by
+tinysql_tpu/native.py when the library is missing; safe to run directly."""
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "tinysql_native.cpp")
+OUT = os.path.join(HERE, "libtinysql_native.so")
+
+
+def build() -> str:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           SRC, "-o", OUT]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return OUT
+
+
+if __name__ == "__main__":
+    print(build())

@@ -1,0 +1,189 @@
+// Native runtime kernels for the CPU tier (the reference engine's runtime
+// is all Go; this library plays the role its hottest Go loops play —
+// util/codec's memcomparable scalar codec and util/mvmap's join hash
+// table — as C++ compiled to a shared library bound via ctypes).
+//
+// Build: see native/build.py (g++ -O3 -shared -fPIC).
+//
+// Exposed C ABI:
+//   mc_encode_batch  — memcomparable-encode a column of int64/uint64/f64
+//   mc_encode_bytes  — escape-encode one byte string (8-byte groups)
+//   mc_decode_bytes  — reverse of mc_encode_bytes
+//   i64ht_build / i64ht_probe / i64ht_free — open-addressing hash table
+//       over int64 keys -> row-id chains (HashJoin build/probe)
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <vector>
+
+extern "C" {
+
+// ---- memcomparable scalar codec -------------------------------------------
+// Layout per tinysql_tpu/codec/keycodec.py: flag byte + 8-byte big-endian
+// payload; ints XOR the sign bit, floats XOR sign or complement.
+
+static inline void put_u64_be(uint8_t *dst, uint64_t v) {
+    for (int i = 7; i >= 0; --i) { dst[i] = (uint8_t)(v & 0xff); v >>= 8; }
+}
+
+// kind: 0=int64 (flag 0x03), 1=uint64 (flag 0x04), 2=float64 (flag 0x05)
+// src: n 8-byte little-endian values; dst: n*9 bytes out.
+int mc_encode_batch(const uint8_t *src, int64_t n, int kind, uint8_t *dst) {
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t raw;
+        std::memcpy(&raw, src + i * 8, 8);
+        uint8_t *out = dst + i * 9;
+        if (kind == 0) {
+            out[0] = 0x03;
+            put_u64_be(out + 1, raw ^ 0x8000000000000000ULL);
+        } else if (kind == 1) {
+            out[0] = 0x04;
+            put_u64_be(out + 1, raw);
+        } else if (kind == 2) {
+            out[0] = 0x05;
+            // -0.0 normalizes to +0.0 (one key per SQL-equal value)
+            double d;
+            std::memcpy(&d, &raw, 8);
+            if (d == 0.0) raw = 0;
+            uint64_t u = raw;
+            if (u & 0x8000000000000000ULL) u = ~u;
+            else u |= 0x8000000000000000ULL;
+            put_u64_be(out + 1, u);
+        } else {
+            return -1;
+        }
+    }
+    return 0;
+}
+
+// escape-encode: 8-byte groups, pad 0x00, marker = 0xFF - pad_count
+// (reference: util/codec/bytes.go EncodeBytes).  dst must hold
+// ((len/8)+1)*9 bytes; returns bytes written.
+int64_t mc_encode_bytes(const uint8_t *src, int64_t len, uint8_t *dst) {
+    int64_t di = 0;
+    for (int64_t off = 0; off <= len; off += 8) {
+        int64_t remain = len - off;
+        int64_t pad = remain >= 8 ? 0 : 8 - remain;
+        int64_t take = 8 - pad;
+        std::memcpy(dst + di, src + off, (size_t)take);
+        std::memset(dst + di + take, 0, (size_t)pad);
+        dst[di + 8] = (uint8_t)(0xFF - pad);
+        di += 9;
+        if (remain < 8) break;
+    }
+    return di;
+}
+
+// returns decoded length, or -1 on malformed input; consumed gets the
+// number of source bytes read.
+int64_t mc_decode_bytes(const uint8_t *src, int64_t len, uint8_t *dst,
+                        int64_t *consumed) {
+    int64_t si = 0, di = 0;
+    for (;;) {
+        if (si + 9 > len) return -1;
+        uint8_t marker = src[si + 8];
+        int64_t pad = 0xFF - marker;
+        if (pad < 0 || pad > 8) return -1;
+        int64_t take = 8 - pad;
+        for (int64_t j = take; j < 8; ++j)        // pad bytes must be zero
+            if (src[si + j] != 0) return -1;      // (python decoder parity)
+        std::memcpy(dst + di, src + si, (size_t)take);
+        di += take;
+        si += 9;
+        if (pad > 0) break;
+    }
+    *consumed = si;
+    return di;
+}
+
+// ---- int64 -> row-id hash table (join build/probe) ------------------------
+// Open addressing with linear probing; chains duplicate keys through a
+// next[] array (arena-style, like util/mvmap's entry chains).
+
+struct I64HT {
+    std::vector<int64_t> slot_key;
+    std::vector<int64_t> slot_head;   // -1 empty, else first row id
+    std::vector<int64_t> next;        // chain over build row ids
+    uint64_t mask;
+};
+
+static inline uint64_t mix64(uint64_t x) {
+    x ^= x >> 33; x *= 0xff51afd7ed558ccdULL;
+    x ^= x >> 33; x *= 0xc4ceb9fe1a85ec53ULL;
+    x ^= x >> 33; return x;
+}
+
+void *i64ht_build(const int64_t *keys, const uint8_t *valid, int64_t n) {
+    uint64_t cap = 16;
+    while (cap < (uint64_t)(n * 2 + 1)) cap <<= 1;
+    I64HT *ht = new I64HT();
+    ht->mask = cap - 1;
+    ht->slot_key.assign(cap, 0);
+    ht->slot_head.assign(cap, -1);
+    ht->next.assign((size_t)n, -1);
+    for (int64_t i = 0; i < n; ++i) {
+        if (valid && !valid[i]) continue;
+        uint64_t h = mix64((uint64_t)keys[i]) & ht->mask;
+        for (;;) {
+            if (ht->slot_head[h] == -1) {
+                ht->slot_key[h] = keys[i];
+                ht->slot_head[h] = i;
+                break;
+            }
+            if (ht->slot_key[h] == keys[i]) {
+                ht->next[i] = ht->slot_head[h];
+                ht->slot_head[h] = i;
+                break;
+            }
+            h = (h + 1) & ht->mask;
+        }
+    }
+    // chains were built by prepending (LIFO); reverse each so probes
+    // return build row ids in insertion order, matching the python
+    // fallback's dict-append semantics
+    for (size_t s = 0; s < ht->slot_head.size(); ++s) {
+        int64_t cur = ht->slot_head[s], prev = -1;
+        while (cur != -1) {
+            int64_t nxt = ht->next[cur];
+            ht->next[cur] = prev;
+            prev = cur;
+            cur = nxt;
+        }
+        ht->slot_head[s] = prev;
+    }
+    return ht;
+}
+
+// For each probe key: write matched build row ids into out (cap out_cap),
+// and per-probe match counts into counts.  Returns total matches (may
+// exceed out_cap — caller re-calls with a bigger buffer).
+int64_t i64ht_probe(void *htp, const int64_t *keys, const uint8_t *valid,
+                    int64_t n, int64_t *out, int64_t out_cap,
+                    int32_t *counts) {
+    I64HT *ht = (I64HT *)htp;
+    int64_t total = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t c = 0;
+        if (!valid || valid[i]) {
+            uint64_t h = mix64((uint64_t)keys[i]) & ht->mask;
+            for (;;) {
+                int64_t head = ht->slot_head[h];
+                if (head == -1) break;
+                if (ht->slot_key[h] == keys[i]) {
+                    for (int64_t r = head; r != -1; r = ht->next[r]) {
+                        if (total < out_cap) out[total] = r;
+                        ++total; ++c;
+                    }
+                    break;
+                }
+                h = (h + 1) & ht->mask;
+            }
+        }
+        counts[i] = c;
+    }
+    return total;
+}
+
+void i64ht_free(void *htp) { delete (I64HT *)htp; }
+
+}  // extern "C"

@@ -476,15 +476,21 @@ class IndexLookUpExec(Executor):
 
     def _fetch_batch(self, handles):
         """Stage 2: point-read `handles` concurrently, results in index
-        order (reference table workers; 4 by default)."""
+        order (reference table workers; 4 by default).  Row keys are
+        batch-encoded (native memcomparable codec when available)."""
+        from ..codec import tablecodec
         txn = self.ctx.txn
         workers = int(self.ctx.session_vars.get(
             "tidb_index_lookup_concurrency", 4))
         rows: List[Optional[list]] = [None] * len(handles)
+        keys = tablecodec.encode_row_keys_batch(
+            self.tscan.table_info.id, handles)
 
         def fetch(span):
             for j in range(*span):
-                rows[j] = self._tbl.row(txn, handles[j], self._real_cols)
+                v = txn.get(keys[j])
+                rows[j] = self._tbl.decode_row(v, handles[j],
+                                               self._real_cols)
         if workers <= 1 or len(handles) < 64:
             fetch((0, len(handles)))
         else:
@@ -651,11 +657,27 @@ class HashJoinExec(Executor):
         self._built = False
         self._probe_buf = None
 
+    def _native_fast_ok(self) -> bool:
+        """Single int64 equi-key with matching signedness: the native
+        open-addressing table (util/mvmap analogue) builds and probes on
+        raw key buffers."""
+        plan = self.plan
+        if len(plan.left_keys) != 1:
+            return False
+        lk, rk = plan.left_keys[0], plan.right_keys[0]
+        if lk.eval_type is not EvalType.INT or rk.eval_type is not EvalType.INT:
+            return False
+        return _uns_of(lk) == _uns_of(rk)
+
     def _build(self) -> None:
+        from .. import native
         plan = self.plan
         build = self.children[1]
         self._build_rows: List[list] = []
         self._table: Dict[tuple, List[int]] = {}
+        self._ht = None
+        use_native = self._native_fast_ok() and native.lib() is not None
+        nat_keys: List[np.ndarray] = []
         while True:
             chk = build.next()
             if chk is None:
@@ -665,6 +687,13 @@ class HashJoinExec(Executor):
                 mask = vectorized_filter(plan.right_conditions, chk)
                 chk.set_sel(np.nonzero(mask)[0])
                 chk = chk.compact()
+            if use_native:
+                v, null = plan.right_keys[0].vec_eval(chk)
+                keep = np.nonzero(~null)[0]  # NULL keys never equi-match
+                nat_keys.append(np.asarray(v, dtype=np.int64)[keep])
+                for i in keep:
+                    self._build_rows.append(chk.get_row(int(i)))
+                continue
             keys = [(*e.vec_eval(chk), _uns_of(e)) for e in plan.right_keys]
             for i in range(chk.num_rows()):
                 row = chk.get_row(i)
@@ -674,6 +703,10 @@ class HashJoinExec(Executor):
                 idx = len(self._build_rows)
                 self._build_rows.append(row)
                 self._table.setdefault(key, []).append(idx)
+        if use_native:
+            bk = (np.concatenate(nat_keys) if nat_keys
+                  else np.empty(0, dtype=np.int64))
+            self._ht = native.I64HashTable(bk)
         self._n_right = len(self.children[1].schema.columns)
         self._built = True
 
@@ -693,12 +726,23 @@ class HashJoinExec(Executor):
                 mask = vectorized_filter(plan.left_conditions, chk)
                 chk.set_sel(np.nonzero(mask)[0])
                 chk = chk.compact()
-            keys = [(*e.vec_eval(chk), _uns_of(e)) for e in plan.left_keys]
+            if self._ht is not None:
+                v, null = plan.left_keys[0].vec_eval(chk)
+                ids, counts = self._ht.probe(
+                    np.asarray(v, dtype=np.int64), ~null)
+                offsets = np.concatenate(([0], np.cumsum(counts)))
+            else:
+                keys = [(*e.vec_eval(chk), _uns_of(e))
+                        for e in plan.left_keys]
             for i in range(chk.num_rows()):
                 lrow = chk.get_row(i)
-                key = tuple(_semantic(v, null, i, u) for v, null, u in keys)
-                matches = [] if any(k is None for k in key) \
-                    else self._table.get(key, [])
+                if self._ht is not None:
+                    matches = ids[offsets[i]:offsets[i + 1]]
+                else:
+                    key = tuple(_semantic(v, null, i, u)
+                                for v, null, u in keys)
+                    matches = [] if any(k is None for k in key) \
+                        else self._table.get(key, [])
                 matched = False
                 for bi in matches:
                     joined = lrow + self._build_rows[bi]
