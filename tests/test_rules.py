@@ -1,0 +1,126 @@
+"""Logical rewrite rules (rules_extra.py): projection elimination, max/min
+elimination, aggregation elimination over unique keys, outer-join
+elimination, greedy join reorder.  Each rule is checked twice: plan shape
+via EXPLAIN, and result correctness against an unoptimized-equivalent
+query formulation.
+"""
+import pytest
+
+from tinysql_tpu.session.session import new_session
+
+
+@pytest.fixture
+def tk():
+    s = new_session()
+    s.execute("create database test")
+    s.execute("use test")
+    s.execute("set @@tidb_use_tpu = 0")
+    s.execute("create table t (a int primary key, b int, c varchar(10), "
+              "key ib (b))")
+    s.execute("insert into t values " + ", ".join(
+        f"({i}, {i % 7}, 'x{i % 3}')" for i in range(1, 101)))
+    s.execute("insert into t values (200, null, null)")
+    s.execute("create table u (k int primary key, v varchar(5))")
+    s.execute("insert into u values " + ", ".join(
+        f"({i}, 'u{i}')" for i in range(0, 7)))
+    s.execute("create table w (k int, v int)")  # no unique key on k
+    s.execute("insert into w values (1, 10), (1, 11), (2, 20)")
+    return s
+
+
+def _ops(tk, sql):
+    return [r[0].strip() for r in tk.query("explain " + sql).rows]
+
+
+def test_max_min_becomes_topn(tk):
+    ops = _ops(tk, "select max(a) from t")
+    assert any(o.startswith("TopN") for o in ops), ops
+    assert tk.query("select max(a) from t").rows == [[200]]
+    assert tk.query("select min(a) from t").rows == [[1]]
+    # NULLs must not win MIN after the rewrite
+    assert tk.query("select min(b) from t").rows == [[0]]
+    assert tk.query("select max(b) from t").rows == [[6]]
+    # empty input still yields NULL
+    assert tk.query("select max(a) from t where a > 9999").rows == [[None]]
+
+
+def test_max_min_not_applied_with_group_by(tk):
+    ops = _ops(tk, "select b, max(a) from t group by b")
+    assert not any(o.startswith("TopN") for o in ops), ops
+
+
+def test_agg_elimination_on_pk_group(tk):
+    # grouping by the pk: every group is one row -> no HashAgg in the plan
+    ops = _ops(tk, "select a, count(*), sum(b), max(c) from t group by a")
+    assert not any("HashAgg" in o for o in ops), ops
+    rows = tk.query("select a, count(*), sum(b), max(c) from t "
+                    "where a <= 3 group by a order by a").rows
+    assert rows == [[1, 1, 1, "x1"], [2, 1, 2, "x2"], [3, 1, 3, "x0"]]
+    # count over a NULL column cell is 0
+    rows = tk.query("select a, count(b) from t where a = 200 "
+                    "group by a").rows
+    assert rows == [[200, 0]]
+
+
+def test_agg_not_eliminated_on_non_unique(tk):
+    ops = _ops(tk, "select b, count(*) from t group by b")
+    assert any("HashAgg" in o for o in ops), ops
+
+
+def test_outer_join_elimination(tk):
+    # right side unused + unique pk join key: join disappears
+    ops = _ops(tk, "select t.a from t left join u on t.b = u.k "
+               "order by t.a limit 3")
+    assert not any("Join" in o for o in ops), ops
+    got = tk.query("select t.a from t left join u on t.b = u.k "
+                   "order by t.a limit 3").rows
+    assert got == [[1], [2], [3]]
+
+
+def test_outer_join_kept_when_right_duplicates(tk):
+    # w.k is not unique: dropping the join would change multiplicity
+    ops = _ops(tk, "select t.a from t left join w on t.b = w.k")
+    assert any("Join" in o for o in ops), ops
+    # rows with b=1 match twice in w (one extra output row each);
+    # b=2 matches once (no extra); everything else NULL-extends
+    got = tk.query("select count(*) from t left join w on t.b = w.k").rows
+    n_b1 = len([i for i in range(1, 101) if i % 7 == 1])
+    assert got == [[101 + n_b1]]
+
+
+def test_outer_join_kept_when_right_used(tk):
+    ops = _ops(tk, "select t.a, u.v from t left join u on t.b = u.k "
+               "where t.a <= 2 order by t.a")
+    assert any("Join" in o for o in ops), ops
+
+
+def test_merge_join_on_pk_keys(tk):
+    tk.execute("create table p (id int primary key, v int)")
+    tk.execute("create table q (id int primary key, w varchar(5))")
+    tk.execute("insert into p values " + ", ".join(
+        f"({i}, {i * 10})" for i in range(1, 31)))
+    tk.execute("insert into q values " + ", ".join(
+        f"({i}, 'q{i}')" for i in range(10, 41)))
+    ops = _ops(tk, "select p.id, q.w from p join q on p.id = q.id")
+    assert any("MergeJoin" in o for o in ops), ops
+    got = tk.query("select p.id, q.w from p join q on p.id = q.id "
+                   "order by p.id").rows
+    assert got == [[i, f"q{i}"] for i in range(10, 31)]
+    # non-pk keys keep hash join
+    ops = _ops(tk, "select t.a from t join u on t.b = u.k")
+    assert any("HashJoin" in o for o in ops), ops
+
+
+def test_join_reorder_three_tables(tk):
+    # chain of inner joins reorders smallest-first but stays correct
+    tk.execute("analyze table t")
+    tk.execute("analyze table u")
+    tk.execute("analyze table w")
+    q = ("select count(*) from t join u on t.b = u.k "
+         "join w on u.k = w.k")
+    got = tk.query(q).rows
+    want = 0
+    for i in range(1, 101):
+        b = i % 7
+        want += sum(1 for wk, _ in [(1, 10), (1, 11), (2, 20)] if wk == b)
+    assert got == [[want]]

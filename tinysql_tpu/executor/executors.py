@@ -24,10 +24,10 @@ from ..planner.builder import HANDLE_COL_NAME
 from ..planner.physical import (PhysicalHashAgg, PhysicalHashJoin,
                                 PhysicalIndexLookUpReader,
                                 PhysicalIndexReader, PhysicalLimit,
-                                PhysicalPlan, PhysicalProjection,
-                                PhysicalSelection, PhysicalSort,
-                                PhysicalTableDual, PhysicalTableReader,
-                                PhysicalTopN)
+                                PhysicalMergeJoin, PhysicalPlan,
+                                PhysicalProjection, PhysicalSelection,
+                                PhysicalSort, PhysicalTableDual,
+                                PhysicalTableReader, PhysicalTopN)
 from .aggfuncs import new_state
 
 
@@ -757,8 +757,7 @@ class HashJoinExec(Executor):
         return out if out.num_rows() else None
 
     def _others_ok(self, joined_row) -> bool:
-        from ..expression import eval_bool_scalar
-        return eval_bool_scalar(self.plan.other_conditions, joined_row)
+        return _eval_other_conds(self.plan.other_conditions, joined_row)
 
 
 def _uns_of(e) -> bool:
@@ -775,6 +774,137 @@ def _semantic(v, null, i: int, uns: bool):
     if uns and isinstance(x, int) and x < 0:
         x += 1 << 64
     return x
+
+
+def _semantic_keys(expr, chk: Chunk) -> list:
+    """Join-key column of `chk` as semantic python values (shared by the
+    hash and merge join key paths)."""
+    v, null = expr.vec_eval(chk)
+    uns = _uns_of(expr)
+    return [_semantic(v, null, i, uns) for i in range(chk.num_rows())]
+
+
+def _eval_other_conds(conds, joined_row) -> bool:
+    from ..expression import eval_bool_scalar
+    return eval_bool_scalar(conds, joined_row)
+
+
+class _RowCursor:
+    """Row-at-a-time cursor over an executor's chunk stream, exposing the
+    join key's semantic value per row; `side_conds` filter each chunk
+    before it is exposed (the join's one-side conditions)."""
+
+    def __init__(self, ex: Executor, key_expr, side_conds=None):
+        self.ex = ex
+        self.key_expr = key_expr
+        self.side_conds = side_conds or []
+        self._chk = None
+        self._keys = None
+        self._i = 0
+        self.done = False
+        self._advance_chunk()
+
+    def _advance_chunk(self) -> None:
+        while True:
+            chk = self.ex.next()
+            if chk is None:
+                self.done = True
+                return
+            chk = chk.compact()
+            if self.side_conds and chk.num_rows():
+                mask = vectorized_filter(self.side_conds, chk)
+                chk.set_sel(np.nonzero(mask)[0])
+                chk = chk.compact()
+            if chk.num_rows() == 0:
+                continue
+            self._chk = chk
+            self._keys = _semantic_keys(self.key_expr, chk)
+            self._i = 0
+            return
+
+    def key(self):
+        return self._keys[self._i]
+
+    def row(self):
+        return self._chk.get_row(self._i)
+
+    def advance(self) -> None:
+        self._i += 1
+        if self._i >= self._chk.num_rows():
+            self._advance_chunk()
+
+
+class MergeJoinExec(Executor):
+    """Sorted-input merge join with inner-group buffering (reference:
+    executor/merge_join.go:31 — both inputs arrive in join-key order; the
+    planner only picks this operator for clustered-pk-ordered scans)."""
+
+    def __init__(self, plan, left: Executor, right: Executor):
+        super().__init__(plan.schema, [left, right])
+        self.plan = plan
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._lcur = None
+        self._done = False
+
+    def _others_ok(self, joined_row) -> bool:
+        return _eval_other_conds(self.plan.other_conditions, joined_row)
+
+    def next(self) -> Optional[Chunk]:
+        if self._done:
+            return None
+        plan = self.plan
+        if self._lcur is None:
+            self._lcur = _RowCursor(self.children[0], plan.left_keys[0],
+                                    plan.left_conditions)
+            self._rcur = _RowCursor(self.children[1], plan.right_keys[0],
+                                    plan.right_conditions)
+            self._n_right = len(self.children[1].schema.columns)
+            self._rgroup_key = object()
+            self._rgroup: List[list] = []
+        out_limit = self.ctx.max_chunk_size
+        out = Chunk(self.field_types(), cap=out_limit)
+        lcur, rcur = self._lcur, self._rcur
+        while not lcur.done and out.num_rows() < out_limit:
+            lk = lcur.key()
+            if lk is None:  # NULL keys never equi-match
+                if plan.tp == "left":
+                    out.append_row(lcur.row() + [None] * self._n_right)
+                lcur.advance()
+                continue
+            # advance the buffered right group to lk
+            if self._rgroup_key != lk:
+                while not rcur.done and _key_lt(rcur.key(), lk):
+                    rcur.advance()
+                self._rgroup = []
+                self._rgroup_key = lk
+                while not rcur.done and rcur.key() == lk:
+                    self._rgroup.append(rcur.row())
+                    rcur.advance()
+            matched = False
+            for rrow in self._rgroup:
+                joined = lcur.row() + rrow
+                if plan.other_conditions and not self._others_ok(joined):
+                    continue
+                matched = True
+                out.append_row(joined)
+            if not matched and plan.tp == "left":
+                out.append_row(lcur.row() + [None] * self._n_right)
+            lcur.advance()
+        if out.num_rows() == 0:
+            self._done = True
+            return None
+        return out
+
+
+def _key_lt(a, b) -> bool:
+    """NULL sorts first (mirrors the key codec's ordering)."""
+    if a is None:
+        return b is not None
+    if b is None:
+        return False
+    return a < b
 
 
 def _sort_keys_for_rows(by, chk: Chunk):
@@ -1008,6 +1138,9 @@ def build_executor(plan: PhysicalPlan, use_tpu: bool = False) -> Executor:
         return ProjectionExec(plan, build_executor(plan.children[0], use_tpu))
     if isinstance(plan, PhysicalHashAgg):
         return HashAggExec(plan, build_executor(plan.children[0], use_tpu))
+    if isinstance(plan, PhysicalMergeJoin):
+        return MergeJoinExec(plan, build_executor(plan.children[0], use_tpu),
+                             build_executor(plan.children[1], use_tpu))
     if isinstance(plan, PhysicalHashJoin):
         return HashJoinExec(plan, build_executor(plan.children[0], use_tpu),
                             build_executor(plan.children[1], use_tpu))

@@ -23,9 +23,9 @@ from ..expression.aggregation import (AGG_AVG, AGG_COUNT, AGG_FIRST_ROW,
                                       AGG_MAX, AGG_MIN, AGG_SUM)
 from ..mytypes import EvalType
 from ..ops.exprjit import is_jittable
-from .physical import (PhysicalHashAgg, PhysicalHashJoin, PhysicalPlan,
-                       PhysicalProjection, PhysicalSelection, PhysicalSort,
-                       PhysicalTopN)
+from .physical import (PhysicalHashAgg, PhysicalHashJoin,
+                       PhysicalMergeJoin, PhysicalPlan, PhysicalProjection,
+                       PhysicalSelection, PhysicalSort, PhysicalTopN)
 
 _TPU_AGGS = {AGG_COUNT, AGG_SUM, AGG_AVG, AGG_MAX, AGG_MIN, AGG_FIRST_ROW}
 
@@ -58,6 +58,8 @@ def place_devices(p: PhysicalPlan, enabled: bool = True) -> PhysicalPlan:
     if isinstance(p, PhysicalHashAgg):
         p.use_tpu = (all(_key_ok(e) for e in p.group_by)
                      and all(_agg_ok(d) for d in p.aggs))
+    elif isinstance(p, PhysicalMergeJoin):
+        p.use_tpu = False  # sorted-stream operator stays on the CPU tier
     elif isinstance(p, PhysicalHashJoin):
         def _uns(e):
             return (e.eval_type is EvalType.INT

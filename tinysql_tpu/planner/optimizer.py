@@ -20,9 +20,9 @@ from .logical import (JOIN_INNER, JOIN_LEFT, LogicalAggregation,
                       LogicalPlan, LogicalProjection, LogicalSelection,
                       LogicalSort, LogicalTableDual, LogicalTopN)
 from .physical import (PhysicalHashAgg, PhysicalHashJoin, PhysicalLimit,
-                       PhysicalPlan, PhysicalProjection, PhysicalSelection,
-                       PhysicalSort, PhysicalTableDual, PhysicalTableReader,
-                       PhysicalTableScan, PhysicalTopN)
+                       PhysicalMergeJoin, PhysicalPlan, PhysicalProjection,
+                       PhysicalSelection, PhysicalSort, PhysicalTableDual,
+                       PhysicalTableReader, PhysicalTableScan, PhysicalTopN)
 
 
 # ===== predicate pushdown ===================================================
@@ -210,6 +210,35 @@ def _bind(exprs: List[Expression], schema: Schema) -> List[Expression]:
     return [e.resolve_indices(schema) for e in exprs]
 
 
+def _merge_join_ok(p: LogicalJoin, left_phys: PhysicalPlan,
+                   right_phys: PhysicalPlan) -> bool:
+    """Merge join needs key-ordered inputs: the single equi key must be
+    each side's clustered pk AND the chosen physical access path must be a
+    handle-ordered table read — an index path emits index-key order, so
+    the decision is made on the BUILT readers (reference:
+    exhaust_physical_plans.go's merge-join candidate requires matching
+    sort properties of the child task)."""
+    if p.tp not in (JOIN_INNER, JOIN_LEFT) or len(p.eq_conditions) != 1:
+        return False
+    a, b = p.eq_conditions[0]
+    if not (isinstance(a, Column) and isinstance(b, Column)):
+        return False
+    for side, phys, col in ((p.children[0], left_phys, a),
+                            (p.children[1], right_phys, b)):
+        if not isinstance(side, LogicalDataSource):
+            return False
+        if not isinstance(phys, PhysicalTableReader):
+            return False  # index readers emit index-key order
+        pk = side.table_info.get_pk_handle_col()
+        if pk is None:
+            return False
+        sc = next((c for c in side.schema.columns if c.name == pk.name),
+                  None)
+        if sc is None or sc.unique_id != col.unique_id:
+            return False
+    return True
+
+
 def to_physical(p: LogicalPlan) -> PhysicalPlan:
     if isinstance(p, LogicalDataSource):
         with_handle = any(c.name == HANDLE_COL_NAME for c in p.schema.columns)
@@ -254,7 +283,9 @@ def to_physical(p: LogicalPlan) -> PhysicalPlan:
     if isinstance(p, LogicalJoin):
         left = to_physical(p.children[0])
         right = to_physical(p.children[1])
-        join = PhysicalHashJoin(p.tp, left, right, p.schema)
+        cls = (PhysicalMergeJoin if _merge_join_ok(p, left, right)
+               else PhysicalHashJoin)
+        join = cls(p.tp, left, right, p.schema)
         join.left_keys = _bind([a for a, _ in p.eq_conditions], left.schema)
         join.right_keys = _bind([b for _, b in p.eq_conditions], right.schema)
         join.other_conditions = _bind(p.other_conditions, p.schema)
@@ -277,13 +308,32 @@ def to_physical(p: LogicalPlan) -> PhysicalPlan:
     raise PlanError(f"no physical mapping for {type(p).__name__}")
 
 
+def _ds_row_count(ds) -> float:
+    storage = getattr(ds, "storage", None)
+    if storage is None:
+        return 0.0
+    from ..statistics.table_stats import load_stats
+    s = load_stats(storage, ds.table_info.id)
+    return float(s.row_count) if s else 0.0
+
+
 def optimize(logical: LogicalPlan, tpu: bool = True) -> PhysicalPlan:
-    """The System-R style pipeline (reference: planner/core/optimizer.go:77):
-    rule rewrites, physical conversion, then the device enforcer."""
+    """The System-R style pipeline (reference: planner/core/optimizer.go:77
+    — the fixed-order rewrite list of optimizer.go:44-55), physical
+    conversion, then the device enforcer + coprocessor pushdown."""
+    from .rules_extra import (eliminate_aggregation, eliminate_max_min,
+                              eliminate_outer_joins, eliminate_projections,
+                              join_reorder)
+    root_needed = {c.unique_id for c in logical.schema.columns}
+    logical = eliminate_outer_joins(logical, root_needed)
     retained, logical = predicate_pushdown(logical, [])
     if retained:
         logical = LogicalSelection(retained, logical)
-    column_pruning(logical, {c.unique_id for c in logical.schema.columns})
+    column_pruning(logical, root_needed)
+    logical = eliminate_aggregation(logical)
+    logical = eliminate_max_min(logical)
+    logical = eliminate_projections(logical)
+    logical = join_reorder(logical, stats_of=_ds_row_count)
     logical = topn_pushdown(logical)
     phys = to_physical(logical)
     from .device import place_devices

@@ -1,0 +1,304 @@
+"""Additional logical rewrite rules (reference: planner/core's fixed-order
+rule list, optimizer.go:44-55): projection elimination
+(rule_eliminate_projection.go), max/min elimination
+(rule_max_min_eliminate.go), aggregation elimination
+(rule_aggregation_elimination.go), outer-join elimination
+(rule_join_elimination.go), greedy join reorder (rule_join_reorder.go).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Set
+
+from ..expression import (AggFuncDesc, Column, Constant, Expression,
+                          Schema, new_function)
+from ..expression.aggregation import (AGG_AVG, AGG_COUNT, AGG_FIRST_ROW,
+                                      AGG_MAX, AGG_MIN, AGG_SUM)
+from ..mytypes import new_int_type
+from .logical import (JOIN_INNER, JOIN_LEFT, LogicalAggregation,
+                      LogicalDataSource, LogicalJoin, LogicalPlan,
+                      LogicalProjection, LogicalSelection, LogicalSort,
+                      LogicalTopN)
+
+
+# ===== projection elimination ==============================================
+
+def eliminate_projections(p: LogicalPlan) -> LogicalPlan:
+    """Drop identity projections: exprs are exactly the child's schema
+    columns, in order, same names exposed (reference:
+    rule_eliminate_projection.go canProjectionBeEliminatedLoose)."""
+    p.children = [eliminate_projections(c) for c in p.children]
+    if isinstance(p, LogicalProjection) and p.children:
+        child = p.child(0)
+        if (len(p.exprs) == len(child.schema.columns)
+                and all(isinstance(e, Column)
+                        and e.unique_id == c.unique_id
+                        for e, c in zip(p.exprs, child.schema.columns))
+                and len(p.schema.columns) == len(child.schema.columns)
+                and all(a.unique_id == b.unique_id for a, b in
+                        zip(p.schema.columns, child.schema.columns))):
+            return child
+    return p
+
+
+# ===== max/min elimination =================================================
+
+def eliminate_max_min(p: LogicalPlan) -> LogicalPlan:
+    """A lone MAX(col)/MIN(col) with no GROUP BY only needs one row:
+    insert NOT NULL filter + TopN(1) below the aggregation (reference:
+    rule_max_min_eliminate.go)."""
+    p.children = [eliminate_max_min(c) for c in p.children]
+    if not isinstance(p, LogicalAggregation) or p.group_by:
+        return p
+    if len(p.agg_funcs) != 1:
+        return p
+    d = p.agg_funcs[0]
+    if d.name not in (AGG_MAX, AGG_MIN) or d.distinct:
+        return p
+    arg = d.args[0]
+    if not isinstance(arg, Column):
+        return p
+    child = p.child(0)
+    # NULLs never win max/min; filtering them keeps TopN(1) correct for
+    # MIN (NULL sorts first ascending)
+    not_null = new_function("not", [new_function("isnull", [arg])])
+    sel = LogicalSelection([not_null], child)
+    topn = LogicalTopN([(arg, d.name == AGG_MAX)], 0, 1, sel)
+    topn.schema = child.schema
+    p.children = [topn]
+    return p
+
+
+# ===== aggregation elimination =============================================
+
+def eliminate_aggregation(p: LogicalPlan) -> LogicalPlan:
+    """GROUP BY over a unique key produces one row per group: rewrite the
+    aggregation into a projection of per-row equivalents (reference:
+    rule_aggregation_elimination.go)."""
+    p.children = [eliminate_aggregation(c) for c in p.children]
+    if not isinstance(p, LogicalAggregation) or not p.group_by:
+        return p
+    child = p.child(0)
+    gb_uids = {e.unique_id for e in p.group_by if isinstance(e, Column)}
+    if len(gb_uids) != len(p.group_by):
+        return p  # non-column group keys
+    if not _covers_unique_key(child, gb_uids):
+        return p
+    exprs: List[Expression] = []
+    out_cols: List[Column] = []
+    for c in p.schema.columns:
+        src = _agg_output_source(p, c)
+        if src is None:
+            return p
+        per_row = _per_row_equivalent(src)
+        if per_row is None:
+            return p
+        exprs.append(per_row)
+        out_cols.append(c)
+    proj = LogicalProjection(exprs, Schema(out_cols), child)
+    return proj
+
+
+def _covers_unique_key(child: LogicalPlan, gb_uids: Set[int]) -> bool:
+    """Does some unique key of `child` sit inside the group-by columns?
+    (single-datasource case: the clustered pk)."""
+    ds = child
+    while ds.children and not isinstance(ds, LogicalDataSource):
+        if isinstance(ds, (LogicalJoin,)):
+            return False
+        ds = ds.child(0)
+    if not isinstance(ds, LogicalDataSource):
+        return False
+    pk = ds.table_info.get_pk_handle_col()
+    if pk is None:
+        return False
+    for c in ds.schema.columns:
+        if c.name == pk.name and c.unique_id in gb_uids:
+            return True
+    return False
+
+
+def _agg_output_source(agg: LogicalAggregation, col: Column):
+    for out_c, d in zip(agg.output_cols, agg.agg_funcs):
+        if out_c.unique_id == col.unique_id:
+            return d
+    for out_c, e in zip(getattr(agg, "gb_out_cols", []), agg.group_by):
+        if out_c.unique_id == col.unique_id:
+            return e
+    return None
+
+
+def _per_row_equivalent(src) -> Optional[Expression]:
+    """One-row-group equivalents (reference: rewriteExpr in
+    rule_aggregation_elimination.go)."""
+    if isinstance(src, Expression):
+        return src  # group-by column passes through
+    d: AggFuncDesc = src
+    arg = d.args[0]
+    if d.name in (AGG_MAX, AGG_MIN, AGG_FIRST_ROW, AGG_SUM, AGG_AVG):
+        if d.distinct and d.name in (AGG_SUM, AGG_AVG):
+            pass  # distinct over one row is the row itself
+        e = arg
+        if d.ret_type.eval_type is not e.ret_type.eval_type:
+            e = new_function("cast_real", [e]) \
+                if d.ret_type.eval_type.name == "REAL" else e
+        return e
+    if d.name == AGG_COUNT:
+        if isinstance(arg, Constant) and arg.value is not None:
+            return Constant(1, new_int_type())  # COUNT(*)
+        isn = new_function("isnull", [arg])
+        return new_function("if", [isn, Constant(0, new_int_type()),
+                                   Constant(1, new_int_type())])
+    return None
+
+
+# ===== outer join elimination ==============================================
+
+def eliminate_outer_joins(p: LogicalPlan, needed: Set[int]) -> LogicalPlan:
+    """LEFT JOIN whose right side contributes no needed columns and whose
+    join keys hit a unique key on the right (no row duplication) reduces
+    to its left child (reference: rule_join_elimination.go)."""
+    if isinstance(p, LogicalJoin) and p.tp == JOIN_LEFT:
+        right = p.children[1]
+        right_uids = {c.unique_id for c in right.schema.columns}
+        if not (needed & right_uids) and _right_keys_unique(p):
+            return eliminate_outer_joins(p.children[0], needed)
+    for i, c in enumerate(p.children):
+        child_needed = _needed_below(p, needed)
+        p.children[i] = eliminate_outer_joins(c, child_needed)
+    return p
+
+
+def _right_keys_unique(join: LogicalJoin) -> bool:
+    right = join.children[1]
+    if not isinstance(right, LogicalDataSource) or join.other_conditions:
+        return False
+    pk = right.table_info.get_pk_handle_col()
+    pk_uid = None
+    for c in right.schema.columns:
+        if pk is not None and c.name == pk.name:
+            pk_uid = c.unique_id
+    r_keys = {b.unique_id for _, b in join.eq_conditions
+              if isinstance(b, Column)}
+    if pk_uid is not None and pk_uid in r_keys:
+        return True
+    # single-column unique index fully matched
+    for idx in right.table_info.public_indices():
+        if idx.unique and len(idx.columns) == 1:
+            name = idx.columns[0].name
+            for c in right.schema.columns:
+                if c.name == name and c.unique_id in r_keys:
+                    return True
+    return False
+
+
+def _needed_below(p: LogicalPlan, needed: Set[int]) -> Set[int]:
+    out = set(needed)
+    if isinstance(p, LogicalProjection):
+        out = set()
+        for e in p.exprs:
+            out |= {c.unique_id for c in e.collect_columns()}
+    elif isinstance(p, LogicalSelection):
+        for e in p.conditions:
+            out |= {c.unique_id for c in e.collect_columns()}
+    elif isinstance(p, LogicalAggregation):
+        out = set()
+        for d in p.agg_funcs:
+            for a in d.args:
+                out |= {c.unique_id for c in a.collect_columns()}
+        for e in p.group_by:
+            out |= {c.unique_id for c in e.collect_columns()}
+    elif isinstance(p, LogicalJoin):
+        for a, b in p.eq_conditions:
+            out |= {c.unique_id for c in a.collect_columns()}
+            out |= {c.unique_id for c in b.collect_columns()}
+        for e in (p.other_conditions + p.left_conditions
+                  + p.right_conditions):
+            out |= {c.unique_id for c in e.collect_columns()}
+    elif isinstance(p, (LogicalSort, LogicalTopN)):
+        for e, _ in p.by:
+            out |= {c.unique_id for c in e.collect_columns()}
+    return out
+
+
+# ===== greedy join reorder =================================================
+
+def join_reorder(p: LogicalPlan, stats_of=None) -> LogicalPlan:
+    """Flatten chains of inner equi-joins and rebuild left-deep, smallest
+    estimated source first, preferring connected (equi-cond) pairs
+    (reference: rule_join_reorder.go greedy solver)."""
+    p.children = [join_reorder(c, stats_of) for c in p.children]
+    if not (isinstance(p, LogicalJoin) and p.tp == JOIN_INNER):
+        return p
+    nodes: List[LogicalPlan] = []
+    eqs: List[tuple] = []
+    others: List[Expression] = []
+
+    def flatten(j: LogicalPlan):
+        if (isinstance(j, LogicalJoin) and j.tp == JOIN_INNER
+                and not j.left_conditions and not j.right_conditions):
+            flatten(j.children[0])
+            flatten(j.children[1])
+            eqs.extend(j.eq_conditions)
+            others.extend(j.other_conditions)
+        else:
+            nodes.append(j)
+    flatten(p)
+    if len(nodes) <= 2:
+        return p
+
+    def est(n: LogicalPlan) -> float:
+        if isinstance(n, LogicalDataSource) and stats_of is not None:
+            s = stats_of(n)
+            if s:
+                return float(s)
+        return 1e4
+
+    remaining = sorted(nodes, key=est)
+    cur = remaining.pop(0)
+    cur_uids = {c.unique_id for c in cur.schema.columns}
+    pending_eqs = list(eqs)
+    while remaining:
+        # prefer a node connected to the current tree by an equi cond
+        pick = None
+        for cand in remaining:
+            cand_uids = {c.unique_id for c in cand.schema.columns}
+            for a, b in pending_eqs:
+                au = {c.unique_id for c in a.collect_columns()}
+                bu = {c.unique_id for c in b.collect_columns()}
+                if ((au <= cur_uids and bu <= cand_uids)
+                        or (bu <= cur_uids and au <= cand_uids)):
+                    pick = cand
+                    break
+            if pick is not None:
+                break
+        if pick is None:
+            pick = remaining[0]
+        remaining.remove(pick)
+        j = LogicalJoin(JOIN_INNER, cur, pick)
+        new_uids = cur_uids | {c.unique_id for c in pick.schema.columns}
+        still = []
+        for a, b in pending_eqs:
+            au = {c.unique_id for c in a.collect_columns()}
+            bu = {c.unique_id for c in b.collect_columns()}
+            if au <= new_uids and bu <= new_uids:
+                # orient: left side of the pair must come from j's left
+                left_uids = cur_uids
+                if au <= left_uids:
+                    j.eq_conditions.append((a, b))
+                else:
+                    j.eq_conditions.append((b, a))
+            else:
+                still.append((a, b))
+        pending_eqs = still
+        cur = j
+        cur_uids = new_uids
+    if others:
+        cur_join = cur
+        assert isinstance(cur_join, LogicalJoin)
+        cur_join.other_conditions.extend(others)
+    # any unplaced equi conds (degenerate) become other conditions
+    for a, b in pending_eqs:
+        eq = new_function("=", [a, b])
+        if isinstance(cur, LogicalJoin):
+            cur.other_conditions.append(eq)
+    return cur
