@@ -53,6 +53,18 @@ class Column:
         return c
 
     @classmethod
+    def wrap_raw(cls, ft: FieldType, data: np.ndarray,
+                 null: Optional[np.ndarray] = None) -> "Column":
+        """Zero-copy wrap: `data` is used as-is (any dtype, incl. <U string
+        arrays) — the columnar-replica fast path's view constructor."""
+        c = cls(ft, cap=1)
+        c._data = data
+        c._null = (null if null is not None
+                   else np.zeros(len(data), dtype=bool))
+        c._len = len(data)
+        return c
+
+    @classmethod
     def from_datums(cls, ft: FieldType, values: Iterable[Datum]) -> "Column":
         c = cls(ft)
         for v in values:

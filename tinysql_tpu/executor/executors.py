@@ -272,6 +272,25 @@ class TableReaderExec(Executor):
                 out.append_row(r)
             return out
 
+    def take_raw_replica(self):
+        """Hand the WHOLE replica to the caller as a zero-copy chunk view
+        plus this scan's filters, consuming the reader (fused device
+        pipelines own the replica contract through this single method).
+        Returns (chunk, filters) or (None, None)."""
+        rep = self._replica
+        if rep is None or self.scan.pushed_agg is not None:
+            return None, None
+        from ..chunk import Column as CCol
+        cols = []
+        for c, ci in zip(self.scan.schema.columns, self._decode_cols):
+            if ci is None:
+                cols.append(CCol.wrap_raw(c.ret_type, rep.handles))
+            else:
+                v, m = rep.columns[ci.id]
+                cols.append(CCol.wrap_raw(c.ret_type, v, m))
+        self._replica = None  # consumed: this reader must not re-serve
+        return Chunk.from_columns(cols), list(self.scan.filters)
+
     def _next_fast_raw(self) -> Optional[Chunk]:
         """Next unfiltered slice of the columnar replica."""
         rep = self._replica

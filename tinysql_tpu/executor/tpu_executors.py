@@ -67,14 +67,83 @@ class TPUHashAggExec(Executor):
         super().open(ctx)
         self._done = False
 
+    def _raw_replica_input(self):
+        """Fused fast path: the child is a TableReader serving from the
+        columnar replica — take the FULL table as a zero-copy chunk view
+        and turn the scan filters into a device-side valid mask, skipping
+        chunk slicing, host compaction, and append copies entirely (the
+        filter+aggregate fusion XLA is built for)."""
+        child = self.children[0]
+        from .executors import TableReaderExec
+        if not isinstance(child, TableReaderExec):
+            return None, None
+        chk, filters = child.take_raw_replica()
+        if chk is None:
+            return None, None
+        mask = vectorized_filter(filters, chk) if filters else None
+        # low-selectivity GROUPED aggregates sort faster over a compacted
+        # input than over the full table with a mask; scalar aggregates
+        # never sort, so they always keep the fused mask
+        if (mask is not None and self.plan.group_by
+                and mask.mean() < 0.3):
+            chk.set_sel(np.nonzero(mask)[0])
+            chk = chk.compact()
+            mask = None
+        return chk, mask
+
+    @staticmethod
+    def _try_segment_layout(keys, n: int):
+        """If every group key has known small cardinality (dictionary codes
+        for strings; narrow value range for ints), lay the keys out as one
+        composite segment id.  Returns (gid, cards, bases) or None.  Each
+        key gets one extra bin for NULL."""
+        if n == 0:
+            return None
+        cards = []
+        bases = []
+        effs = []
+        total = 1  # final value = the composite segment count
+        for v, null, decode in keys:
+            if decode is not None:
+                card = len(decode)
+                eff = np.where(null, card, v)
+                base = 0
+            elif v.dtype == np.int64:
+                nn = v[~null]
+                if len(nn) == 0:
+                    card, base = 0, 0
+                    eff = np.full(n, 0, dtype=np.int64)
+                else:
+                    vmin, vmax = int(nn.min()), int(nn.max())
+                    card = vmax - vmin + 1
+                    if card > kernels.MAX_SEGMENTS:
+                        return None
+                    base = vmin
+                    eff = np.where(null, card, v - vmin)
+            else:
+                return None  # float keys: sort-based path
+            total *= card + 1
+            if total > kernels.MAX_SEGMENTS:
+                return None
+            cards.append(card)
+            bases.append(base)
+            effs.append(eff.astype(np.int64))
+        gid = np.zeros(n, dtype=np.int64)
+        for eff, card in zip(effs, cards):
+            gid = gid * (card + 1) + eff
+        return gid, cards, bases, total
+
     def next(self) -> Optional[Chunk]:
         if self._done:
             return None
         self._done = True
         plan = self.plan
-        chk = _drain_chunk(self.children[0], self.children[0].field_types())
-        chk = chk.compact()
-        n = chk.num_rows()
+        chk, filter_mask = self._raw_replica_input()
+        if chk is None:
+            chk = _drain_chunk(self.children[0],
+                               self.children[0].field_types())
+            chk = chk.compact()
+        n = chk.full_rows()
 
         # ---- keys (dictionary-encode strings) -------------------------
         keys = [_encode_key(e, chk) for e in plan.group_by]
@@ -87,10 +156,15 @@ class TPUHashAggExec(Executor):
         arg_cols: List[Tuple[np.ndarray, np.ndarray]] = []
         slots: List[tuple] = []  # how to produce each desc's result
 
-        def add_arg(e, cast_real=False, order_map=False) -> bool:
+        def add_arg(e, cast_real=False, order_map=False,
+                    null_only=False) -> bool:
             """Returns True when the arg was XOR-sign-bit mapped (unsigned
             min/max ordering) so the caller can un-map the result."""
             v, m = e.vec_eval(chk)
+            if null_only or v.dtype == object or v.dtype.kind == "U":
+                # COUNT only consumes the null mask; string values (and any
+                # non-numeric dtype) must not reach the device
+                v = np.zeros(len(m), dtype=np.int64)
             uns = (e.eval_type is EvalType.INT
                    and getattr(e.ret_type, "is_unsigned", False))
             was_mapped = False
@@ -117,7 +191,7 @@ class TPUHashAggExec(Executor):
                     slots.append(("dev", len(specs) - 1))
                 else:
                     specs.append(("count", True))
-                    add_arg(a)
+                    add_arg(a, null_only=True)
                     slots.append(("dev", len(specs) - 1))
             elif d.name == AGG_SUM:
                 specs.append(("sum", True))
@@ -128,7 +202,7 @@ class TPUHashAggExec(Executor):
                 specs.append(("sum", True))
                 add_arg(d.args[0], cast_real=True)
                 specs.append(("count", True))
-                add_arg(d.args[0])
+                add_arg(d.args[0], null_only=True)
                 slots.append(("avg", len(specs) - 2, len(specs) - 1))
             elif d.name in (AGG_MAX, AGG_MIN):
                 specs.append((("max" if d.name == AGG_MAX else "min"), True))
@@ -139,8 +213,35 @@ class TPUHashAggExec(Executor):
             else:  # pragma: no cover — enforcer gates
                 raise ValueError(d.name)
 
-        out_keys, out_aggs, first_orig = kernels.group_aggregate(
-            key_cols, specs, arg_cols, n)
+        if not plan.group_by:
+            # global aggregate: sort-free masked reductions
+            out_keys = []
+            out_aggs, first_orig = kernels.scalar_aggregate(
+                specs, arg_cols, n, filter_mask=filter_mask)
+        else:
+            seg = self._try_segment_layout(keys, n)
+            if seg is not None:
+                # known small cardinality: sort-free segment reductions
+                gid, cards, bases, n_segments = seg
+                present, out_aggs, first_orig = \
+                    kernels.segment_group_aggregate(
+                        gid, n_segments, specs, arg_cols, n,
+                        filter_mask=filter_mask)
+                out_keys = []
+                strides = []
+                s = 1
+                for c in reversed(cards):
+                    strides.append(s)
+                    s *= c + 1
+                strides.reverse()
+                for i, (c, base) in enumerate(zip(cards, bases)):
+                    code = (present // strides[i]) % (c + 1)
+                    is_null = code == c
+                    vals = np.where(is_null, 0, code + base)
+                    out_keys.append((vals.astype(np.int64), is_null))
+            else:
+                out_keys, out_aggs, first_orig = kernels.group_aggregate(
+                    key_cols, specs, arg_cols, n, filter_mask=filter_mask)
         ng = len(first_orig)
 
         # empty input + no GROUP BY: single default row (COUNT=0, SUM=NULL)

@@ -162,16 +162,21 @@ def _group_agg_kernel(n_keys: int, specs: tuple):
 def group_aggregate(key_cols: List[Tuple[np.ndarray, np.ndarray]],
                     agg_specs: List[Tuple[str, bool]],
                     arg_cols: List[Tuple[np.ndarray, np.ndarray]],
-                    n_rows: int):
+                    n_rows: int, filter_mask: np.ndarray = None):
     """Host wrapper: pad, run kernel, slice to n_groups.
 
     key_cols/arg_cols: (values, null) numpy pairs of length n_rows.
+    `filter_mask` folds a selection into the kernel's valid mask — the
+    fused filter+aggregate path skips host-side compaction entirely.
     Returns (group_key_cols, agg_out_cols) as numpy (values, null) pairs.
     """
     jn = jnp()
     nb = bucket(max(n_rows, 1))
     valid = np.zeros(nb, dtype=bool)
-    valid[:n_rows] = True
+    if filter_mask is not None:
+        valid[:n_rows] = filter_mask
+    else:
+        valid[:n_rows] = True
     kv = [jn.asarray(pad1(v, nb)) for v, _ in key_cols]
     kn = [jn.asarray(pad1(m, nb, True)) for _, m in key_cols]
     av = [jn.asarray(pad1(v, nb)) for v, _ in arg_cols]
@@ -187,6 +192,176 @@ def group_aggregate(key_cols: List[Tuple[np.ndarray, np.ndarray]],
     out_keys = [(np.asarray(v)[:ng], np.asarray(m)[:ng]) for v, m in gkeys]
     out_aggs = [(np.asarray(v)[:ng], np.asarray(m)[:ng]) for v, m in outs]
     return out_keys, out_aggs, np.asarray(first_orig)[:ng]
+
+
+_SEGMENT_AGG_CACHE: Dict[tuple, Callable] = {}
+
+
+def _segment_agg_kernel(specs: tuple, n_segments: int):
+    """Known-cardinality group aggregate: direct segment reductions over
+    composite group ids — NO sort (the shape dist.make_sharded_group_sum
+    uses per shard; here single-chip).  Invalid rows route to an overflow
+    segment that is sliced away."""
+    j = jax()
+    jn = jnp()
+
+    def kernel(gid, valid, arg_vals, arg_nulls):
+        ns = n_segments + 1  # +1 overflow bin for invalid rows
+        g = jn.where(valid, gid, n_segments)
+        presence = j.ops.segment_sum(valid.astype(jn.int64), g,
+                                     num_segments=ns)[:n_segments]
+        n = gid.shape[0]
+        first_orig = j.ops.segment_min(jn.arange(n), g,
+                                       num_segments=ns)[:n_segments]
+        first_orig = jn.minimum(first_orig, n - 1)
+        outs = []
+        ai = 0
+        for func, has_arg in specs:
+            if has_arg:
+                av = arg_vals[ai]
+                an = arg_nulls[ai]
+                ai += 1
+            if func == "count_star":
+                outs.append((presence, jn.zeros(n_segments, dtype=bool)))
+                continue
+            live = valid & ~an
+            gl = jn.where(live, gid, n_segments)
+            if func == "count":
+                outs.append((j.ops.segment_sum(
+                    live.astype(jn.int64), gl,
+                    num_segments=ns)[:n_segments],
+                    jn.zeros(n_segments, dtype=bool)))
+            elif func in ("sum", "sum_int"):
+                total = j.ops.segment_sum(jn.where(live, av, 0), gl,
+                                          num_segments=ns)[:n_segments]
+                cnt = j.ops.segment_sum(live.astype(jn.int64), gl,
+                                        num_segments=ns)[:n_segments]
+                outs.append((total, cnt == 0))
+            elif func in ("min", "max"):
+                op = j.ops.segment_min if func == "min" else j.ops.segment_max
+                if av.dtype == jn.int64:
+                    fill = (jn.iinfo(jn.int64).max if func == "min"
+                            else jn.iinfo(jn.int64).min)
+                else:
+                    fill = jn.inf if func == "min" else -jn.inf
+                r = op(jn.where(live, av, fill), gl,
+                       num_segments=ns)[:n_segments]
+                cnt = j.ops.segment_sum(live.astype(jn.int64), gl,
+                                        num_segments=ns)[:n_segments]
+                outs.append((r, cnt == 0))
+            else:  # pragma: no cover
+                raise ValueError(func)
+        return presence, first_orig, outs
+
+    return j.jit(kernel)
+
+
+MAX_SEGMENTS = 1 << 16
+
+
+def segment_group_aggregate(gids: np.ndarray, n_segments: int,
+                            agg_specs, arg_cols, n_rows: int,
+                            filter_mask: np.ndarray = None):
+    """Host wrapper: composite small-cardinality group ids -> per-present-
+    segment aggregates.  Returns (present_segment_ids, out_aggs,
+    first_orig) with empty segments compressed away."""
+    jn = jnp()
+    nb = bucket(max(n_rows, 1))
+    valid = np.zeros(nb, dtype=bool)
+    if filter_mask is not None:
+        valid[:n_rows] = filter_mask
+    else:
+        valid[:n_rows] = True
+    g = jn.asarray(pad1(gids.astype(np.int64), nb))
+    av = [jn.asarray(pad1(v, nb)) for v, _ in arg_cols]
+    an = [jn.asarray(pad1(m, nb, True)) for _, m in arg_cols]
+    # bucket the segment count too: one compiled kernel serves every
+    # cardinality in the bucket (gids above the true count never occur,
+    # their segments simply stay empty and are compressed away)
+    ns = bucket(max(n_segments, 1))
+    key = (tuple(agg_specs), ns, nb, tuple(str(v.dtype) for v in av))
+    fn = _SEGMENT_AGG_CACHE.get(key)
+    if fn is None:
+        fn = _SEGMENT_AGG_CACHE[key] = _segment_agg_kernel(
+            tuple(agg_specs), ns)
+    presence, first_orig, outs = fn(g, jn.asarray(valid), av, an)
+    present = np.nonzero(np.asarray(presence) > 0)[0]
+    out_aggs = [(np.asarray(v)[present], np.asarray(m)[present])
+                for v, m in outs]
+    return present, out_aggs, np.asarray(first_orig)[present]
+
+
+_SCALAR_AGG_CACHE: Dict[tuple, Callable] = {}
+
+
+def _scalar_agg_kernel(specs: tuple):
+    """No-GROUP-BY aggregation: pure masked reductions — no sort at all
+    (the reference's stream-agg analogue for a single global group)."""
+    j = jax()
+    jn = jnp()
+
+    def kernel(valid, arg_vals, arg_nulls):
+        outs = []
+        ai = 0
+        for func, has_arg in specs:
+            if has_arg:
+                av = arg_vals[ai]
+                an = arg_nulls[ai]
+                ai += 1
+            if func == "count_star":
+                outs.append((jn.sum(valid.astype(jn.int64))[None],
+                             jn.zeros(1, dtype=bool)))
+            elif func == "count":
+                live = valid & ~an
+                outs.append((jn.sum(live.astype(jn.int64))[None],
+                             jn.zeros(1, dtype=bool)))
+            elif func in ("sum", "sum_int"):
+                live = valid & ~an
+                total = jn.sum(jn.where(live, av, 0))[None]
+                cnt = jn.sum(live.astype(jn.int64))
+                outs.append((total, (cnt == 0)[None]))
+            elif func in ("min", "max"):
+                live = valid & ~an
+                if av.dtype == jn.int64:
+                    fill = (jn.iinfo(jn.int64).max if func == "min"
+                            else jn.iinfo(jn.int64).min)
+                else:
+                    fill = jn.inf if func == "min" else -jn.inf
+                red = jn.min if func == "min" else jn.max
+                r = red(jn.where(live, av, fill))[None]
+                cnt = jn.sum(live.astype(jn.int64))
+                outs.append((r, (cnt == 0)[None]))
+            else:  # pragma: no cover
+                raise ValueError(func)
+        n_valid = jn.sum(valid.astype(jn.int64))
+        first_orig = jn.argmax(valid)[None]  # first valid original row
+        return n_valid, first_orig, outs
+
+    return j.jit(kernel)
+
+
+def scalar_aggregate(agg_specs, arg_cols, n_rows: int,
+                     filter_mask: np.ndarray = None):
+    """Host wrapper for the global-group aggregate.  Returns
+    (out_aggs, first_orig) with one output row when any row survives the
+    mask, zero otherwise — same contract slice as group_aggregate."""
+    jn = jnp()
+    nb = bucket(max(n_rows, 1))
+    valid = np.zeros(nb, dtype=bool)
+    if filter_mask is not None:
+        valid[:n_rows] = filter_mask
+    else:
+        valid[:n_rows] = True
+    av = [jn.asarray(pad1(v, nb)) for v, _ in arg_cols]
+    an = [jn.asarray(pad1(m, nb, True)) for _, m in arg_cols]
+    key = (tuple(agg_specs), nb, tuple(str(v.dtype) for v in av))
+    fn = _SCALAR_AGG_CACHE.get(key)
+    if fn is None:
+        fn = _SCALAR_AGG_CACHE[key] = _scalar_agg_kernel(tuple(agg_specs))
+    n_valid, first_orig, outs = fn(jn.asarray(valid), av, an)
+    ng = 1 if int(n_valid) > 0 else 0
+    out_aggs = [(np.asarray(v)[:ng], np.asarray(m)[:ng]) for v, m in outs]
+    return out_aggs, np.asarray(first_orig)[:ng]
 
 
 # =========================================================================
