@@ -1,0 +1,78 @@
+"""Virtual INFORMATION_SCHEMA mem-tables (reference: infoschema/tables.go —
+schema-backed tables computed on read, no storage).
+
+Supported: SCHEMATA, TABLES, COLUMNS, STATISTICS (index metadata).
+Rows are produced from the live InfoSchema at query time.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..mytypes import FieldType, new_int_type, new_string_type
+
+DB_NAME = "information_schema"
+
+# table name -> (column name, field type factory)
+_TABLES = {
+    "schemata": [("catalog_name", new_string_type),
+                 ("schema_name", new_string_type)],
+    "tables": [("table_schema", new_string_type),
+               ("table_name", new_string_type),
+               ("tidb_table_id", new_int_type)],
+    "columns": [("table_schema", new_string_type),
+                ("table_name", new_string_type),
+                ("column_name", new_string_type),
+                ("ordinal_position", new_int_type),
+                ("data_type", new_string_type),
+                ("is_nullable", new_string_type),
+                ("column_key", new_string_type)],
+    "statistics": [("table_schema", new_string_type),
+                   ("table_name", new_string_type),
+                   ("non_unique", new_int_type),
+                   ("index_name", new_string_type),
+                   ("seq_in_index", new_int_type),
+                   ("column_name", new_string_type)],
+}
+
+
+def is_memtable(db: str, table: str) -> bool:
+    return db.lower() == DB_NAME and table.lower() in _TABLES
+
+
+def memtable_columns(table: str) -> List[Tuple[str, FieldType]]:
+    return [(n, f()) for n, f in _TABLES[table.lower()]]
+
+
+def memtable_rows(infoschema, table: str) -> List[list]:
+    t = table.lower()
+    out: List[list] = []
+    if t == "schemata":
+        for db in infoschema.all_schemas():
+            out.append(["def", db.name])
+        return out
+    for db in infoschema.all_schemas():
+        for ti in infoschema.schema_tables(db.name):
+            if t == "tables":
+                out.append([db.name, ti.name, ti.id])
+            elif t == "columns":
+                for i, c in enumerate(ti.public_columns()):
+                    key = "PRI" if (c.ft.flag & 0x2) else ""
+                    out.append([db.name, ti.name, c.name, i + 1,
+                                _type_name(c.ft),
+                                "NO" if c.ft.not_null else "YES", key])
+            elif t == "statistics":
+                for idx in ti.public_indices():
+                    for seq, ic in enumerate(idx.columns):
+                        out.append([db.name, ti.name,
+                                    0 if idx.unique else 1,
+                                    idx.name, seq + 1, ic.name])
+    return out
+
+
+def _type_name(ft: FieldType) -> str:
+    et = ft.eval_type.name
+    if et == "INT":
+        return "bigint unsigned" if ft.is_unsigned else "bigint"
+    if et == "REAL":
+        return "double"
+    return "varchar"

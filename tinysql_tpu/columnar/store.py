@@ -35,6 +35,16 @@ class ColumnarTable:
     # col_id -> (values ndarray, null ndarray); handles as int64 array
     columns: Dict[int, Tuple[np.ndarray, np.ndarray]] = field(default_factory=dict)
     handles: Optional[np.ndarray] = None
+    # derived-state memo (device-resident padded uploads, string dictionary
+    # codes) — lives and dies with this replica version, so invalidation is
+    # free: a bump drops the whole ColumnarTable
+    cache: Dict[object, object] = field(default_factory=dict)
+
+    def memo(self, key, build):
+        v = self.cache.get(key)
+        if v is None:
+            v = self.cache[key] = build()
+        return v
 
 
 class ColumnarStore:

@@ -73,9 +73,11 @@ def run_dag(req: DAGRequest, chk: Chunk) -> list:
 
 
 def _partial_agg(agg_pb: dict, chk: Chunk) -> list:
-    """Per-region PARTIAL1 aggregation (reference: mocktikv/aggregate.go);
-    output rows = [group key values..., flattened partial states...]."""
-    from ..executor.aggfuncs import new_state
+    """Per-region PARTIAL1 aggregation (reference: mocktikv/aggregate.go),
+    numpy-vectorized: factorize group keys, then bincount/reduce-at per
+    aggregate; row-at-a-time only for shapes numpy cannot reduce
+    (DISTINCT, string-valued min/max)."""
+    import numpy as np
     gb = [pb_to_expr(d) for d in agg_pb["group_by"]]
     descs = []
     for a in agg_pb["aggs"]:
@@ -83,6 +85,136 @@ def _partial_agg(agg_pb: dict, chk: Chunk) -> list:
                                  AggMode.PARTIAL1, a["distinct"],
                                  _ft_from_pb(a["ret"]) if "ret" in a
                                  else None))
+    n = chk.num_rows()
+    if n == 0:
+        return []
+    if any(d.distinct for d in descs):
+        return _partial_agg_rows(gb, descs, chk)
+
+    # ---- factorize the group keys -------------------------------------
+    codes = np.zeros(n, dtype=np.int64)
+    key_cols = []
+    total = 1
+    for e in gb:
+        v, null = e.vec_eval(chk)
+        if v.dtype == object:
+            v = np.where(null, "", v).astype(str)
+        kc, inv = np.unique(v, return_inverse=True)
+        # null gets its own code (one extra bin)
+        inv = np.where(null, len(kc), inv)
+        total *= len(kc) + 1
+        if total > (1 << 62):  # composite code would overflow int64
+            return _partial_agg_rows(gb, descs, chk)
+        codes = codes * (len(kc) + 1) + inv
+        key_cols.append((v, null))
+    uniq, gid, counts = np.unique(codes, return_inverse=True,
+                                  return_counts=True)
+    ng = len(uniq)
+    first_idx = np.full(ng, n, dtype=np.int64)
+    np.minimum.at(first_idx, gid, np.arange(n))
+
+    out_cols = []  # one list per output column, each length ng
+    for v, null in key_cols:
+        vals = v[first_idx]
+        out_cols.append([None if null[first_idx[g]] else _sem(vals[g])
+                         for g in range(ng)])
+
+    for d in descs:
+        cols = _vector_partial(d, chk, gid, ng, first_idx)
+        if cols is None:
+            return _partial_agg_rows(gb, descs, chk)
+        out_cols.extend(cols)
+    return [[c[g] for c in out_cols] for g in range(ng)]
+
+
+def _vector_partial(d: AggFuncDesc, chk: Chunk, gid, ng, first_idx):
+    """Vectorized partial state columns for one descriptor, or None when
+    the shape needs the row fallback."""
+    import numpy as np
+    from ..expression import Constant
+    name = d.name
+    if name == "count":
+        a = d.args[0]
+        if isinstance(a, Constant):
+            live = np.ones(len(gid), dtype=bool) if a.value is not None \
+                else np.zeros(len(gid), dtype=bool)
+        else:
+            v, null = a.vec_eval(chk)
+            live = ~null
+        cnt = np.bincount(gid, weights=live.astype(np.float64),
+                          minlength=ng).astype(np.int64)
+        return [list(cnt)]
+    if name == "sum":
+        v, null = d.args[0].vec_eval(chk)
+        if v.dtype == object or v.dtype.kind == "U":
+            return None
+        uns = d.args[0].ret_type.is_unsigned and v.dtype == np.int64
+        is_real = d.ret_type.eval_type.name == "REAL"
+        live = ~null
+        cnt = np.bincount(gid, weights=live.astype(np.float64),
+                          minlength=ng).astype(np.int64)
+        if is_real:
+            w = np.where(live, v.astype(np.float64), 0.0)
+            if uns:
+                w = np.where(live & (v < 0), w + 2.0**64, w)
+            s = np.bincount(gid, weights=w, minlength=ng)
+            return [[None if cnt[g] == 0 else float(s[g])
+                     for g in range(ng)]]
+        # int sums: exact mod-2^64 accumulation via int64 reduce-at
+        s = np.zeros(ng, dtype=np.int64)
+        with np.errstate(over="ignore"):
+            np.add.at(s, gid[live], v[live])
+        out = []
+        for g in range(ng):
+            if cnt[g] == 0:
+                out.append(None)
+            else:
+                x = int(s[g])
+                if uns and x < 0:
+                    x += 1 << 64
+                out.append(x)
+        return [out]
+    if name in ("max", "min"):
+        v, null = d.args[0].vec_eval(chk)
+        if v.dtype == object or v.dtype.kind == "U":
+            return None  # string min/max: row fallback
+        uns = d.args[0].ret_type.is_unsigned and v.dtype == np.int64
+        work = v ^ np.int64(-2**63) if uns else v
+        live = ~null
+        if v.dtype == np.int64:
+            fill = np.iinfo(np.int64).max if name == "min" \
+                else np.iinfo(np.int64).min
+        else:
+            fill = np.inf if name == "min" else -np.inf
+        acc = np.full(ng, fill, dtype=work.dtype)
+        op = np.minimum if name == "min" else np.maximum
+        op.at(acc, gid[live], work[live])
+        cnt = np.bincount(gid, weights=live.astype(np.float64),
+                          minlength=ng).astype(np.int64)
+        out = []
+        for g in range(ng):
+            if cnt[g] == 0:
+                out.append(None)
+            else:
+                x = acc[g]
+                if uns:
+                    x = int(x) ^ -(2**63)
+                    if x < 0:
+                        x += 1 << 64
+                    out.append(x)
+                else:
+                    out.append(_sem(x))
+        return [out]
+    if name == "first_row":
+        v, null = d.args[0].vec_eval(chk)
+        return [[None if null[first_idx[g]] else _sem(v[first_idx[g]])
+                 for g in range(ng)]]
+    return None  # avg never appears: split() emits sum+count partials
+
+
+def _partial_agg_rows(gb, descs, chk: Chunk) -> list:
+    """Row-at-a-time fallback (the mocktikv-style interpreter)."""
+    from ..executor.aggfuncs import new_state
     n = chk.num_rows()
     groups = {}
     order = []

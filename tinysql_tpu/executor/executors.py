@@ -274,12 +274,13 @@ class TableReaderExec(Executor):
 
     def take_raw_replica(self):
         """Hand the WHOLE replica to the caller as a zero-copy chunk view
-        plus this scan's filters, consuming the reader (fused device
-        pipelines own the replica contract through this single method).
-        Returns (chunk, filters) or (None, None)."""
+        plus this scan's filters and the replica object (for device-side
+        memoization), consuming the reader (fused device pipelines own the
+        replica contract through this single method).
+        Returns (chunk, filters, replica) or (None, None, None)."""
         rep = self._replica
         if rep is None or self.scan.pushed_agg is not None:
-            return None, None
+            return None, None, None
         from ..chunk import Column as CCol
         cols = []
         for c, ci in zip(self.scan.schema.columns, self._decode_cols):
@@ -289,7 +290,7 @@ class TableReaderExec(Executor):
                 v, m = rep.columns[ci.id]
                 cols.append(CCol.wrap_raw(c.ret_type, v, m))
         self._replica = None  # consumed: this reader must not re-serve
-        return Chunk.from_columns(cols), list(self.scan.filters)
+        return Chunk.from_columns(cols), list(self.scan.filters), rep
 
     def _next_fast_raw(self) -> Optional[Chunk]:
         """Next unfiltered slice of the columnar replica."""
@@ -554,6 +555,31 @@ class IndexLookUpExec(Executor):
             self._pool.shutdown(wait=False)
             self._pool = None
         super().close()
+
+
+class MemTableExec(Executor):
+    """INFORMATION_SCHEMA virtual tables computed from the live schema
+    (reference: infoschema/tables.go)."""
+
+    def __init__(self, plan):
+        super().__init__(plan.schema, [])
+        self.table = plan.table
+        self._done = False
+
+    def open(self, ctx):
+        super().open(ctx)
+        self._done = False
+
+    def next(self) -> Optional[Chunk]:
+        if self._done:
+            return None
+        self._done = True
+        from ..catalog.memtables import memtable_rows
+        rows = memtable_rows(self.ctx.infoschema, self.table)
+        chk = Chunk(self.field_types(), cap=max(len(rows), 1))
+        for r in rows:
+            chk.append_row(r)
+        return chk
 
 
 class SelectionExec(Executor):
@@ -1151,6 +1177,9 @@ def build_executor(plan: PhysicalPlan, use_tpu: bool = False) -> Executor:
         return IndexReaderExec(plan)
     if isinstance(plan, PhysicalIndexLookUpReader):
         return IndexLookUpExec(plan)
+    from ..planner.physical import PhysicalMemTable
+    if isinstance(plan, PhysicalMemTable):
+        return MemTableExec(plan)
     if isinstance(plan, PhysicalSelection):
         return SelectionExec(plan, build_executor(plan.children[0], use_tpu))
     if isinstance(plan, PhysicalProjection):

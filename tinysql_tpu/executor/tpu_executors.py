@@ -38,6 +38,15 @@ def _drain_chunk(ex: Executor, fields) -> Chunk:
     return out
 
 
+def _count_mask_program(slot: int):
+    """COUNT(col) consumes only the column's null mask; the value half of
+    the device pair may be absent (string columns upload masks only)."""
+    def fn(cols):
+        null = cols[slot][1]
+        return null, null
+    return fn
+
+
 def _encode_key(e, chk: Chunk) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
     """Evaluate a group/sort key over the chunk -> (codes, null, decode).
     Strings become order-preserving dictionary codes; decode maps code ->
@@ -77,7 +86,7 @@ class TPUHashAggExec(Executor):
         from .executors import TableReaderExec
         if not isinstance(child, TableReaderExec):
             return None, None
-        chk, filters = child.take_raw_replica()
+        chk, filters, _rep = child.take_raw_replica()
         if chk is None:
             return None, None
         mask = vectorized_filter(filters, chk) if filters else None
@@ -133,11 +142,251 @@ class TPUHashAggExec(Executor):
             gid = gid * (card + 1) + eff
         return gid, cards, bases, total
 
+    # ---- fully fused device path ------------------------------------------
+    def _try_fused_device(self):
+        """The flagship aggregation path: device-resident padded columns
+        (memoized on the replica), ON-DEVICE argument evaluation via the
+        exprjit lowering, host filter mask as the only per-query upload,
+        one XLA program end to end.  Returns an output Chunk or None to
+        fall back."""
+        from .executors import TableReaderExec
+        from ..ops.exprjit import is_jittable, stable_key
+        plan = self.plan
+        child = self.children[0]
+        if not isinstance(child, TableReaderExec):
+            return None
+        rep = getattr(child, "_replica", None)
+        if rep is None or child.scan.pushed_agg is not None:
+            return None
+        from ..expression import Column as ExprColumn, Constant
+
+        # ---- eligibility + spec/arg-program assembly --------------------
+        specs: List[Tuple[str, bool]] = []
+        arg_exprs: List = []      # jittable expr | ("mask", slot) | None
+        slots: List[tuple] = []
+        for d in plan.aggs:
+            if d.distinct:
+                return None
+            if d.name == AGG_COUNT:
+                a = d.args[0]
+                if isinstance(a, Constant) and a.value is not None:
+                    specs.append(("count_star", False))
+                    arg_exprs.append(None)
+                    slots.append(("dev", len(specs) - 1))
+                elif isinstance(a, ExprColumn):
+                    specs.append(("count", True))
+                    arg_exprs.append(("mask", a.index))
+                    slots.append(("dev", len(specs) - 1))
+                elif is_jittable(a):
+                    specs.append(("count", True))
+                    arg_exprs.append(a)
+                    slots.append(("dev", len(specs) - 1))
+                else:
+                    return None
+            elif d.name == AGG_SUM:
+                a = d.args[0]
+                if not is_jittable(a):
+                    return None
+                if (d.ret_type.eval_type is EvalType.REAL
+                        and a.eval_type is not EvalType.REAL):
+                    from ..expression.builtins import new_function
+                    a = new_function("cast_real", [a])
+                specs.append(("sum", True))
+                arg_exprs.append(a)
+                slots.append(("dev", len(specs) - 1))
+            elif d.name == AGG_AVG:
+                a = d.args[0]
+                if not is_jittable(a):
+                    return None
+                from ..expression.builtins import new_function
+                ar = a if a.eval_type is EvalType.REAL \
+                    else new_function("cast_real", [a])
+                specs.append(("sum", True))
+                arg_exprs.append(ar)
+                specs.append(("count", True))
+                arg_exprs.append(a)
+                slots.append(("avg", len(specs) - 2, len(specs) - 1))
+            elif d.name in (AGG_MAX, AGG_MIN):
+                a = d.args[0]
+                if not is_jittable(a):
+                    return None
+                if (a.eval_type is EvalType.INT
+                        and getattr(a.ret_type, "is_unsigned", False)):
+                    return None  # unsigned order map: sort path handles
+                specs.append((("max" if d.name == AGG_MAX else "min"), True))
+                arg_exprs.append(a)
+                slots.append(("dev_mm", len(specs) - 1, False))
+            elif d.name == AGG_FIRST_ROW:
+                if not isinstance(d.args[0], ExprColumn):
+                    return None
+                slots.append(("first", d.args[0]))
+            else:
+                return None
+
+        # group keys must be plain Columns (codes memoized on the replica)
+        for e in plan.group_by:
+            if not isinstance(e, ExprColumn):
+                return None
+
+        chk, filters, rep = child.take_raw_replica()
+        if chk is None:
+            return None  # nothing consumed: reader bails identically
+        n = chk.full_rows()
+        nb = kernels.bucket(max(n, 1))
+        jn = kernels.jnp()
+        # stable per-slot ids: replica memos are shared across queries with
+        # different column pruning, so slot INDEXES must never key them
+        slot_ids = [ci.id if ci is not None else "handle"
+                    for ci in child._decode_cols]
+
+        # ---- per-key codes (memoized per replica) -----------------------
+        key_layouts = []
+        for e in plan.group_by:
+            lay = self._rep_key_codes(rep, e, chk, slot_ids[e.index])
+            if lay is None:
+                child._replica = rep  # un-consume for the fallback path
+                return None
+            key_layouts.append(lay)
+        n_segments = 1
+        for _, card, _, _ in key_layouts:
+            n_segments *= card + 1
+        if n_segments > kernels.MAX_SEGMENTS and plan.group_by:
+            child._replica = rep
+            return None
+
+        # ---- device columns (memoized per replica + bucket) -------------
+        needed = set()
+        for a in arg_exprs:
+            if isinstance(a, tuple):
+                needed.add((a[1], "mask"))
+            elif a is not None:
+                for c in a.collect_columns():
+                    needed.add((c.index, "full"))
+        dev_cols = [None] * len(chk.columns)
+        for idx, kind in needed:
+            col = chk.columns[idx]
+            v = col.values()
+            m = col.null_mask()
+            sid = slot_ids[idx]
+            if v.dtype == object or v.dtype.kind == "U":
+                if kind == "full":
+                    child._replica = rep
+                    return None  # string values in a compute expr
+                dv = None
+            else:
+                dv = rep.memo(("devv", sid, nb),
+                              lambda v=v: jn.asarray(kernels.pad1(v, nb)))
+            dn = rep.memo(("devn", sid, nb),
+                          lambda m=m: jn.asarray(kernels.pad1(m, nb, True)))
+            dev_cols[idx] = (dv, dn)
+
+        # count-over-column programs read only the null mask
+        progs = []
+        for a in arg_exprs:
+            if isinstance(a, tuple):
+                slot = a[1]
+                progs.append(_count_mask_program(slot))
+            else:
+                progs.append(a)
+
+        # ---- filter mask (the only per-query upload) --------------------
+        mask = np.zeros(nb, dtype=bool)
+        if filters:
+            mask[:n] = vectorized_filter(filters, chk)
+        else:
+            mask[:n] = True
+        mask_dev = jn.asarray(mask)
+
+        program_key = tuple(
+            f"mask@{a[1]}" if isinstance(a, tuple)
+            else (stable_key(a) if a is not None else "-")
+            for a in arg_exprs)
+
+        # ---- run --------------------------------------------------------
+        if not plan.group_by:
+            out_keys = []
+            out_aggs, first_orig = kernels.fused_scalar_aggregate(
+                dev_cols, specs, progs, n, nb, mask_dev,
+                program_key=program_key)
+        else:
+            gid_dev = rep.memo(
+                ("gid_dev", tuple(slot_ids[e.index]
+                                  for e in plan.group_by), nb),
+                lambda: jn.asarray(kernels.pad1(
+                    self._compose_gid(key_layouts, n), nb)))
+            present, out_aggs, first_orig = kernels.fused_segment_aggregate(
+                dev_cols, gid_dev, n_segments, specs, progs, n, mask_dev,
+                program_key=program_key)
+            out_keys = self._decode_present(present, key_layouts)
+        return self._assemble_output(chk, plan, slots, out_keys, out_aggs,
+                                     first_orig,
+                                     [l[3] for l in key_layouts])
+
+    @staticmethod
+    def _rep_key_codes(rep, e, chk, slot_id):
+        """(codes np[int64], card, base, decode) memoized on the replica,
+        keyed by the column's stable id (NOT the query-local offset)."""
+        idx = e.index
+        col = chk.columns[idx]
+        v = col.values()
+        null = col.null_mask()
+        is_string = v.dtype == object or v.dtype.kind == "U"
+        uns = (not is_string and v.dtype == np.int64
+               and getattr(e.ret_type, "is_unsigned", False))
+
+        def build():
+            if is_string:
+                safe = np.where(null, "", v)
+                uniq, codes = np.unique(safe.astype(str),
+                                        return_inverse=True)
+                codes = np.where(null, len(uniq),
+                                 codes).astype(np.int64)
+                return codes, len(uniq), 0, uniq
+            w = (v ^ np.int64(-2**63)) if uns else v
+            if w.dtype != np.int64:
+                return None
+            nn = w[~null]
+            if len(nn) == 0:
+                return (np.zeros(len(w), dtype=np.int64), 0, 0, None)
+            vmin, vmax = int(nn.min()), int(nn.max())
+            card = vmax - vmin + 1
+            if card > kernels.MAX_SEGMENTS:
+                return None
+            codes = np.where(null, card, w - vmin).astype(np.int64)
+            return codes, card, vmin, None
+        return rep.memo(("keycodes", slot_id, is_string, uns), build)
+
+    @staticmethod
+    def _compose_gid(key_layouts, n: int) -> np.ndarray:
+        gid = np.zeros(n, dtype=np.int64)
+        for codes, card, _, _ in key_layouts:
+            gid = gid * (card + 1) + codes
+        return gid
+
+    @staticmethod
+    def _decode_present(present, key_layouts):
+        out_keys = []
+        strides = []
+        s = 1
+        for _, card, _, _ in reversed(key_layouts):
+            strides.append(s)
+            s *= card + 1
+        strides.reverse()
+        for (codes, card, base, decode), stride in zip(key_layouts, strides):
+            code = (present // stride) % (card + 1)
+            is_null = code == card
+            vals = np.where(is_null, 0, code + base)
+            out_keys.append((vals.astype(np.int64), is_null))
+        return out_keys
+
     def next(self) -> Optional[Chunk]:
         if self._done:
             return None
         self._done = True
         plan = self.plan
+        fused = self._try_fused_device()
+        if fused is not None:
+            return fused
         chk, filter_mask = self._raw_replica_input()
         if chk is None:
             chk = _drain_chunk(self.children[0],
@@ -242,6 +491,13 @@ class TPUHashAggExec(Executor):
             else:
                 out_keys, out_aggs, first_orig = kernels.group_aggregate(
                     key_cols, specs, arg_cols, n, filter_mask=filter_mask)
+        return self._assemble_output(chk, plan, slots, out_keys, out_aggs,
+                                     first_orig, [d for _, _, d in keys])
+
+    def _assemble_output(self, chk, plan, slots, out_keys, out_aggs,
+                         first_orig, decodes):
+        """Materialize the output chunk from kernel results (shared by the
+        fused, segment, scalar, and sort-based aggregation paths)."""
         ng = len(first_orig)
 
         # empty input + no GROUP BY: single default row (COUNT=0, SUM=NULL)
@@ -249,14 +505,12 @@ class TPUHashAggExec(Executor):
             from .aggfuncs import new_state
             out = Chunk(self.field_types(), cap=1)
             states = [new_state(d) for d in plan.aggs]
-            gbv = []
             row = []
             for src, idx in plan.output_map:
                 row.append(states[idx].result() if src == "agg" else None)
             out.append_row(row)
             return out
 
-        # ---- materialize output columns --------------------------------
         def agg_result(i: int) -> CCol:
             d = plan.aggs[i]
             slot = slots[i]
@@ -278,7 +532,7 @@ class TPUHashAggExec(Executor):
             return CCol.from_numpy(d.ret_type, v[first_orig], m[first_orig])
 
         def gb_result(i: int) -> CCol:
-            v, m, decode = keys[i]
+            decode = decodes[i]
             e = plan.group_by[i]
             if decode is not None:
                 vals = np.empty(ng, dtype=object)
@@ -289,7 +543,7 @@ class TPUHashAggExec(Executor):
             kv, km = out_keys[i]
             if (kv.dtype == np.int64 and e.eval_type is EvalType.INT
                     and getattr(e.ret_type, "is_unsigned", False)):
-                kv = kv ^ np.int64(-2**63)  # undo _encode_key's order map
+                kv = kv ^ np.int64(-2**63)  # undo the unsigned order map
             return CCol.from_numpy(e.ret_type, kv, km)
 
         cols = []

@@ -92,7 +92,17 @@ def compile_expr(e: Expression) -> Callable[[Sequence[VV]], VV]:
             dt = j.float64
 
         def const_fn(cols):
-            n = cols[0][0].shape[0] if cols else 1
+            # broadcast length: first populated slot (sparse device-column
+            # lists hold None for untouched columns; string slots may carry
+            # only their null mask)
+            n = 1
+            for c in cols:
+                if c is None:
+                    continue
+                arr = c[0] if c[0] is not None else c[1]
+                if arr is not None:
+                    n = arr.shape[0]
+                    break
             return (j.full((n,), cval, dtype=dt),
                     j.full((n,), is_null, dtype=bool))
         return const_fn
@@ -292,6 +302,19 @@ def _apply(name: str, vals: List[VV], arg_types, ret_int: bool,
         v, nl = vals[0]
         return _to_real_u(v, arg_uns[0]), nl
     raise ValueError(f"not jittable: {name}")
+
+
+def stable_key(e: Expression) -> str:
+    """Cache key independent of per-query Column unique ids: identifies an
+    expression by schema OFFSETS + types, so the same query shape reuses
+    one compiled program across sessions."""
+    if isinstance(e, Column):
+        return f"@{e.index}:{e.ret_type.tp}:{e.ret_type.flag & 32}"
+    if isinstance(e, Constant):
+        return f"c({e.value!r}:{e.ret_type.tp})"
+    if isinstance(e, ScalarFunction):
+        return f"{e.name}({','.join(stable_key(a) for a in e.args)})"
+    return repr(e)
 
 
 def compile_filter(conds: List[Expression]) -> Callable[[Sequence[VV]], object]:

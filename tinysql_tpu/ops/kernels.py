@@ -64,7 +64,10 @@ def bucket(n: int) -> int:
 def pad1(a: np.ndarray, n: int, fill=0) -> np.ndarray:
     if len(a) == n:
         return a
-    out = np.full(n, fill, dtype=a.dtype)
+    if fill == 0 or fill is False:
+        out = np.zeros(n, dtype=a.dtype)  # calloc: no fill pass
+    else:
+        out = np.full(n, fill, dtype=a.dtype)
     out[: len(a)] = a
     return out
 
@@ -289,6 +292,145 @@ def segment_group_aggregate(gids: np.ndarray, n_segments: int,
     out_aggs = [(np.asarray(v)[present], np.asarray(m)[present])
                 for v, m in outs]
     return present, out_aggs, np.asarray(first_orig)[present]
+
+
+# ---- fully fused aggregation over device-resident columns -----------------
+# The flagship TPU path: raw table columns live padded in HBM (memoized on
+# the columnar replica), aggregate ARGUMENT expressions evaluate on device
+# through the exprjit lowering, the filter mask is the only per-query
+# upload, and the whole thing is ONE XLA program.
+
+_FUSED_CACHE: Dict[tuple, Callable] = {}
+
+
+def fused_segment_aggregate(dev_cols, gid_dev, n_segments: int,
+                            agg_specs, arg_exprs, n_rows: int,
+                            mask_dev, program_key: tuple = ()):
+    """dev_cols: per-schema-slot (values, null) device pairs padded to one
+    bucket (None for slots no jittable expression touches); gid_dev:
+    composite group ids padded with an out-of-range id; arg_exprs: the agg
+    argument expressions, lowered on device.  Returns the group_aggregate
+    contract (present_ids, out_aggs, first_orig)."""
+    j = jax()
+    jn = jnp()
+    nb = int(gid_dev.shape[0])
+    ns = bucket(max(n_segments, 1))
+    key = ("seg", tuple(agg_specs), program_key, ns, nb)
+    fn = _FUSED_CACHE.get(key)
+    if fn is None:
+        from .exprjit import compile_expr
+        arg_fns = [e if callable(e) else
+                   (compile_expr(e) if e is not None else None)
+                   for e in arg_exprs]
+
+        def kernel(cols, gid, mask):
+            n = gid.shape[0]
+            valid = mask  # mandatory: covers filter AND padding rows
+            g = jn.where(valid, gid, ns)
+            nseg = ns + 1
+            presence = j.ops.segment_sum(valid.astype(jn.int64), g,
+                                         num_segments=nseg)[:ns]
+            first_orig = j.ops.segment_min(jn.arange(n), g,
+                                           num_segments=nseg)[:ns]
+            first_orig = jn.minimum(first_orig, n - 1)
+            outs = []
+            for (func, has_arg), af in zip(agg_specs, arg_fns):
+                av = an = None
+                if has_arg and af is not None:
+                    av, an = af(cols)
+                if func == "count_star":
+                    outs.append((presence, jn.zeros(ns, dtype=bool)))
+                    continue
+                live = valid & ~an
+                gl = jn.where(live, gid, ns)
+                if func == "count":
+                    outs.append((j.ops.segment_sum(
+                        live.astype(jn.int64), gl,
+                        num_segments=nseg)[:ns],
+                        jn.zeros(ns, dtype=bool)))
+                elif func == "sum":
+                    total = j.ops.segment_sum(jn.where(live, av, 0), gl,
+                                              num_segments=nseg)[:ns]
+                    cnt = j.ops.segment_sum(live.astype(jn.int64), gl,
+                                            num_segments=nseg)[:ns]
+                    outs.append((total, cnt == 0))
+                elif func in ("min", "max"):
+                    op = (j.ops.segment_min if func == "min"
+                          else j.ops.segment_max)
+                    if av.dtype == jn.int64:
+                        fill = (jn.iinfo(jn.int64).max if func == "min"
+                                else jn.iinfo(jn.int64).min)
+                    else:
+                        fill = jn.inf if func == "min" else -jn.inf
+                    r = op(jn.where(live, av, fill), gl,
+                           num_segments=nseg)[:ns]
+                    cnt = j.ops.segment_sum(live.astype(jn.int64), gl,
+                                            num_segments=nseg)[:ns]
+                    outs.append((r, cnt == 0))
+                else:  # pragma: no cover
+                    raise ValueError(func)
+            return presence, first_orig, outs
+        fn = _FUSED_CACHE[key] = j.jit(kernel)
+    presence, first_orig, outs = fn(dev_cols, gid_dev, mask_dev)
+    present = np.nonzero(np.asarray(presence) > 0)[0]
+    present = present[present < n_segments]
+    out_aggs = [(np.asarray(v)[present], np.asarray(m)[present])
+                for v, m in outs]
+    return present, out_aggs, np.asarray(first_orig)[present]
+
+
+def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
+                           nb: int, mask_dev, program_key: tuple = ()):
+    """Global-group variant of the fused path: masked reductions with
+    on-device argument evaluation."""
+    j = jax()
+    jn = jnp()
+    key = ("scalar", tuple(agg_specs), program_key, nb)
+    fn = _FUSED_CACHE.get(key)
+    if fn is None:
+        from .exprjit import compile_expr
+        arg_fns = [e if callable(e) else
+                   (compile_expr(e) if e is not None else None)
+                   for e in arg_exprs]
+
+        def kernel(cols, valid):
+            outs = []
+            for (func, has_arg), af in zip(agg_specs, arg_fns):
+                av = an = None
+                if has_arg and af is not None:
+                    av, an = af(cols)
+                if func == "count_star":
+                    outs.append((jn.sum(valid.astype(jn.int64))[None],
+                                 jn.zeros(1, dtype=bool)))
+                    continue
+                live = valid & ~an
+                if func == "count":
+                    outs.append((jn.sum(live.astype(jn.int64))[None],
+                                 jn.zeros(1, dtype=bool)))
+                elif func == "sum":
+                    total = jn.sum(jn.where(live, av, 0))[None]
+                    cnt = jn.sum(live.astype(jn.int64))
+                    outs.append((total, (cnt == 0)[None]))
+                elif func in ("min", "max"):
+                    if av.dtype == jn.int64:
+                        fill = (jn.iinfo(jn.int64).max if func == "min"
+                                else jn.iinfo(jn.int64).min)
+                    else:
+                        fill = jn.inf if func == "min" else -jn.inf
+                    red = jn.min if func == "min" else jn.max
+                    r = red(jn.where(live, av, fill))[None]
+                    cnt = jn.sum(live.astype(jn.int64))
+                    outs.append((r, (cnt == 0)[None]))
+                else:  # pragma: no cover
+                    raise ValueError(func)
+            n_valid = jn.sum(valid.astype(jn.int64))
+            first_orig = jn.argmax(valid)[None]
+            return n_valid, first_orig, outs
+        fn = _FUSED_CACHE[key] = j.jit(kernel)
+    n_valid, first_orig, outs = fn(dev_cols, mask_dev)
+    ng = 1 if int(n_valid) > 0 else 0
+    out_aggs = [(np.asarray(v)[:ng], np.asarray(m)[:ng]) for v, m in outs]
+    return out_aggs, np.asarray(first_orig)[:ng]
 
 
 _SCALAR_AGG_CACHE: Dict[tuple, Callable] = {}

@@ -357,6 +357,13 @@ class PlanBuilder:
         db = tn.db or self.ctx.current_db
         if not db:
             raise PlanError("No database selected")
+        from ..catalog.memtables import is_memtable, memtable_columns
+        if is_memtable(db, tn.name):
+            from .logical import LogicalMemTable
+            alias = src.as_name or tn.name
+            cols = [Column(ft, name=name, table=alias, db=db)
+                    for name, ft in memtable_columns(tn.name)]
+            return LogicalMemTable(db, tn.name.lower(), cols)
         tbl: TableInfo = self.ctx.infoschema().table_by_name(db, tn.name)
         alias = src.as_name or tn.name
         cols = []

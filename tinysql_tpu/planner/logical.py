@@ -129,6 +129,17 @@ class LogicalLimit(LogicalPlan):
         self.schema = child.schema
 
 
+class LogicalMemTable(LogicalPlan):
+    """Virtual INFORMATION_SCHEMA source (reference: infoschema mem-tables
+    + planner MemTable plan)."""
+
+    def __init__(self, db_name: str, table: str, columns: List[Column]):
+        super().__init__()
+        self.db_name = db_name
+        self.table = table
+        self.schema = Schema(columns)
+
+
 class LogicalTableDual(LogicalPlan):
     """One-row (or zero-row) constant source (reference: TableDual)."""
 

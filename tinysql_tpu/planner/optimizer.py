@@ -305,6 +305,10 @@ def to_physical(p: LogicalPlan) -> PhysicalPlan:
         return PhysicalLimit(p.offset, p.count, to_physical(p.child(0)))
     if isinstance(p, LogicalTableDual):
         return PhysicalTableDual(p.schema, p.row_count)
+    from .logical import LogicalMemTable
+    if isinstance(p, LogicalMemTable):
+        from .physical import PhysicalMemTable
+        return PhysicalMemTable(p.table, p.schema)
     raise PlanError(f"no physical mapping for {type(p).__name__}")
 
 

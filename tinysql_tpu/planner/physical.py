@@ -101,6 +101,13 @@ class PhysicalIndexLookUpReader(PhysicalPlan):
         self.schema = table_scan.schema
 
 
+class PhysicalMemTable(PhysicalPlan):
+    def __init__(self, table: str, schema: Schema):
+        super().__init__()
+        self.table = table
+        self.schema = schema
+
+
 class PhysicalSelection(PhysicalPlan):
     def __init__(self, conditions: List[Expression], child: PhysicalPlan):
         super().__init__()

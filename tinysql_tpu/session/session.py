@@ -63,6 +63,9 @@ class SessionError(Exception):
     pass
 
 
+SLOW_QUERY_THRESHOLD_MS = 300.0  # reference: logutil slow-query threshold
+
+
 class Session:
     """reference: session/session.go session struct."""
 
@@ -81,6 +84,9 @@ class Session:
         self.ddl = self._shared_ddl(storage)
         self._is: Optional[InfoSchema] = None
         self.last_affected = 0
+        # per-statement phase timings (reference: session.go DurationParse
+        # :590 / DurationCompile :612 + slow-query logging)
+        self.last_query_info: Dict[str, float] = {}
 
     # ---- shared per-storage singletons ---------------------------------
     @staticmethod
@@ -149,8 +155,27 @@ class Session:
 
     # ---- entry -----------------------------------------------------------
     def execute(self, sql: str) -> List[Optional[ResultSet]]:
+        import time
+        t0 = time.perf_counter()
         stmts = parse(sql)
-        return [self._execute_stmt(s) for s in stmts]
+        t_parse = time.perf_counter() - t0
+        out = []
+        for s in stmts:
+            t1 = time.perf_counter()
+            out.append(self._execute_stmt(s))
+            t_exec = time.perf_counter() - t1
+            self.last_query_info = {
+                "parse_s": t_parse / max(len(stmts), 1),
+                "exec_s": t_exec,
+                "total_s": t_parse / max(len(stmts), 1) + t_exec,
+            }
+            total_ms = self.last_query_info["total_s"] * 1e3
+            if total_ms > SLOW_QUERY_THRESHOLD_MS:
+                import logging
+                logging.getLogger("tinysql_tpu.slowlog").warning(
+                    "slow query (%.0fms): %s", total_ms,
+                    sql[:200].replace("\n", " "))
+        return out
 
     def query(self, sql: str) -> ResultSet:
         out = [r for r in self.execute(sql) if r is not None]
@@ -189,7 +214,9 @@ class Session:
                              ast.AlterTableStmt, ast.TruncateTableStmt)):
             return self._exec_ddl(stmt)
         if isinstance(stmt, ast.UseStmt):
-            if not self.infoschema().schema_exists(stmt.db):
+            from ..catalog.memtables import DB_NAME as INFO_SCHEMA_DB
+            if (stmt.db.lower() != INFO_SCHEMA_DB
+                    and not self.infoschema().schema_exists(stmt.db)):
                 raise SessionError(f"Unknown database '{stmt.db}'")
             self.current_db = stmt.db
             return None
