@@ -38,6 +38,22 @@ def _drain_chunk(ex: Executor, fields) -> Chunk:
     return out
 
 
+def _child_input(ex: Executor) -> Chunk:
+    """Materialize a child's full output: TableReaders on the columnar
+    replica hand over zero-copy column views (filters applied by selection
+    compaction) instead of slicing + re-appending chunk by chunk."""
+    from .executors import TableReaderExec
+    if isinstance(ex, TableReaderExec):
+        chk, filters, _rep = ex.take_raw_replica()
+        if chk is not None:
+            if filters:
+                mask = vectorized_filter(filters, chk)
+                chk.set_sel(np.nonzero(mask)[0])
+                chk = chk.compact()
+            return chk
+    return _drain_chunk(ex, ex.field_types()).compact()
+
+
 def _count_mask_program(slot: int):
     """COUNT(col) consumes only the column's null mask; the value half of
     the device pair may be absent (string columns upload masks only)."""
@@ -570,8 +586,8 @@ class TPUHashJoinExec(Executor):
             return None
         self._done = True
         plan = self.plan
-        lchk = _drain_chunk(self.children[0], self.children[0].field_types())
-        rchk = _drain_chunk(self.children[1], self.children[1].field_types())
+        lchk = _child_input(self.children[0])
+        rchk = _child_input(self.children[1])
         if plan.left_conditions:
             mask = vectorized_filter(plan.left_conditions, lchk)
             lchk.set_sel(np.nonzero(mask)[0])
@@ -650,8 +666,7 @@ class TPUSortExec(Executor):
 
     def next(self) -> Optional[Chunk]:
         if self._out is None:
-            chk = _drain_chunk(self.children[0],
-                               self.children[0].field_types()).compact()
+            chk = _child_input(self.children[0])
             n = chk.num_rows()
             if n == 0:
                 self._out = iter([])
@@ -677,8 +692,7 @@ class TPUTopNExec(Executor):
 
     def next(self) -> Optional[Chunk]:
         if self._out is None:
-            chk = _drain_chunk(self.children[0],
-                               self.children[0].field_types()).compact()
+            chk = _child_input(self.children[0])
             n = chk.num_rows()
             if n == 0:
                 self._out = iter([])

@@ -1,0 +1,109 @@
+"""Fault-injecting storage wrapper (reference: kv/fault_injection.go:1-124
+— InjectionConfig + InjectedStore/InjectedTransaction: configured errors
+surface from Begin/Get/Commit without touching the underlying store)."""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+class InjectionConfig:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._begin_err: Optional[Exception] = None
+        self._get_err: Optional[Exception] = None
+        self._commit_err: Optional[Exception] = None
+
+    def set_begin_error(self, err: Optional[Exception]) -> None:
+        with self._mu:
+            self._begin_err = err
+
+    def set_get_error(self, err: Optional[Exception]) -> None:
+        with self._mu:
+            self._get_err = err
+
+    def set_commit_error(self, err: Optional[Exception]) -> None:
+        with self._mu:
+            self._commit_err = err
+
+    @property
+    def begin_err(self):
+        with self._mu:
+            return self._begin_err
+
+    @property
+    def get_err(self):
+        with self._mu:
+            return self._get_err
+
+    @property
+    def commit_err(self):
+        with self._mu:
+            return self._commit_err
+
+
+class InjectedTransaction:
+    """Delegates to the real transaction, layering configured failures."""
+
+    def __init__(self, txn, cfg: InjectionConfig):
+        self._txn = txn
+        self._cfg = cfg
+
+    def get(self, key: bytes) -> bytes:
+        err = self._cfg.get_err
+        if err is not None:
+            raise err
+        return self._txn.get(key)
+
+    def commit(self) -> None:
+        err = self._cfg.commit_err
+        if err is not None:
+            raise err
+        self._txn.commit()
+
+    def __getattr__(self, name):
+        return getattr(self._txn, name)
+
+
+class InjectedSnapshot:
+    """Snapshot wrapper: injected get errors cover the snapshot/coprocessor
+    read path too (reference wraps snapshots as well)."""
+
+    def __init__(self, snap, cfg: InjectionConfig):
+        self._snap = snap
+        self._cfg = cfg
+
+    def get(self, key: bytes) -> bytes:
+        err = self._cfg.get_err
+        if err is not None:
+            raise err
+        return self._snap.get(key)
+
+    def iter_range(self, start, end):
+        err = self._cfg.get_err
+        if err is not None:
+            raise err
+        return self._snap.iter_range(start, end)
+
+    def __getattr__(self, name):
+        return getattr(self._snap, name)
+
+
+class InjectedStorage:
+    """Storage facade wrapper (reference: InjectedStore)."""
+
+    def __init__(self, storage, cfg: InjectionConfig):
+        self._storage = storage
+        self._cfg = cfg
+
+    def begin(self, start_ts=None):
+        err = self._cfg.begin_err
+        if err is not None:
+            raise err
+        return InjectedTransaction(self._storage.begin(start_ts), self._cfg)
+
+    def get_snapshot(self, ts=None):
+        return InjectedSnapshot(self._storage.get_snapshot(ts), self._cfg)
+
+    def __getattr__(self, name):
+        return getattr(self._storage, name)

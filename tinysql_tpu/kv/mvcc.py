@@ -215,6 +215,43 @@ class MVCCStore:
             self.rollback([primary], lock_ts)
             return 0, False
 
+    def gc(self, safepoint_ts: int) -> int:
+        """Garbage-collect versions no snapshot at/after `safepoint_ts` can
+        see (reference: the GC the tinykv side performs under the
+        safepoint watched by store/tikv/safepoint.go).  Keeps, per key,
+        the newest write with commit_ts <= safepoint plus everything
+        newer; drops rollback records at/below the safepoint and orphaned
+        data versions.  Returns versions removed."""
+        removed = 0
+        with self._mu:
+            for key, e in list(self._entries.items()):
+                keep: List[Tuple[int, int, int]] = []
+                kept_visible = False
+                for w in e.writes:  # newest first
+                    cts, wtype, sts = w
+                    if cts > safepoint_ts:
+                        keep.append(w)
+                        continue
+                    if wtype == W_ROLLBACK:
+                        removed += 1
+                        continue
+                    if not kept_visible:
+                        kept_visible = True
+                        if wtype == W_DELETE:
+                            removed += 1  # tombstone below safepoint: drop
+                        else:
+                            keep.append(w)
+                    else:
+                        removed += 1
+                e.writes = keep
+                live = {w[2] for w in keep}
+                for sts in [s for s in e.data if s not in live]:
+                    del e.data[sts]
+                if not e.writes and e.lock is None and not e.data:
+                    del self._entries[key]
+                    self._dirty = True
+        return removed
+
     def resolve_lock(self, key: bytes, start_ts: int, commit_ts: int) -> None:
         """Resolve one secondary per txn status (reference:
         lock_resolver.go resolveLock)."""
