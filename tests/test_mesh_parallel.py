@@ -1,0 +1,68 @@
+"""Multi-chip query execution over the device mesh: the fused aggregation
+shards rows across all devices (8 virtual CPU devices in CI via conftest)
+and merges partial segment tables with psum/pmin/pmax over the mesh axis —
+SURVEY §2.11 P5's reduce-scatter schema driven from REAL SQL queries.
+"""
+import jax
+import pytest
+
+from tinysql_tpu.session.session import new_session
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 2,
+                                reason="needs a multi-device mesh")
+
+
+@pytest.fixture
+def tk():
+    s = new_session()
+    s.execute("create database test")
+    s.execute("use test")
+    s.execute("create table t (a int primary key, b int, c varchar(8), "
+              "d double)")
+    import random
+    random.seed(11)
+    rows = []
+    for i in range(1, 2049):
+        b = random.choice([None, 1, 2, 3, 4])
+        c = random.choice(["'x'", "'y'", "'z'", "null"])
+        d = round(random.uniform(-7, 7), 3)
+        rows.append(f"({i}, {b if b is not None else 'null'}, {c}, {d})")
+    s.execute("insert into t values " + ", ".join(rows))
+    s.query("select * from t")  # hydrate the replica
+    return s
+
+
+QUERIES = [
+    "select c, count(*), count(b), sum(d), min(d), max(d), avg(d) "
+    "from t group by c order by c",
+    "select b, c, count(*), sum(d * 2 - 1) from t where d > 0 "
+    "group by b, c order by b, c",
+    "select b, min(a), max(a) from t group by b order by b",
+]
+
+
+def _canon(rows):
+    return [[f"{v:.9g}" if isinstance(v, float) else v for v in r]
+            for r in rows]
+
+
+def test_sharded_agg_matches_single_device(tk):
+    for q in QUERIES:
+        tk.execute("set @@tidb_mesh_parallel = 0")
+        single = tk.query(q).rows
+        tk.execute("set @@tidb_mesh_parallel = 1")
+        sharded = tk.query(q).rows
+        assert _canon(sharded) == _canon(single), q
+    tk.execute("set @@tidb_mesh_parallel = 0")
+
+
+def test_sharded_agg_matches_cpu_tier(tk):
+    tk.execute("set @@tidb_mesh_parallel = 1")
+    for q in QUERIES:
+        tk.execute("set @@tidb_use_tpu = 1")
+        sharded = tk.query(q).rows
+        tk.execute("set @@tidb_use_tpu = 0")
+        cpu = tk.query(q).rows
+        assert _canon(sharded) == _canon(cpu), q
+    tk.execute("set @@tidb_use_tpu = 1")
+    tk.execute("set @@tidb_mesh_parallel = 0")

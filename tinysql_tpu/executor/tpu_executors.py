@@ -54,6 +54,9 @@ def _child_input(ex: Executor) -> Chunk:
     return _drain_chunk(ex, ex.field_types()).compact()
 
 
+_MESH_CACHE = None  # one mesh per device set (kernels close over it)
+
+
 def _count_mask_program(slot: int):
     """COUNT(col) consumes only the column's null mask; the value half of
     the device pair may be absent (string columns upload masks only)."""
@@ -330,13 +333,37 @@ class TPUHashAggExec(Executor):
                                   for e in plan.group_by), nb),
                 lambda: jn.asarray(kernels.pad1(
                     self._compose_gid(key_layouts, n), nb)))
-            present, out_aggs, first_orig = kernels.fused_segment_aggregate(
-                dev_cols, gid_dev, n_segments, specs, progs, n, mask_dev,
-                program_key=program_key)
+            mesh = self._mesh_if_enabled(nb)
+            if mesh is not None:
+                present, out_aggs, first_orig = \
+                    kernels.fused_segment_aggregate_sharded(
+                        mesh, dev_cols, gid_dev, n_segments, specs, progs,
+                        n, mask_dev, program_key=program_key)
+            else:
+                present, out_aggs, first_orig = \
+                    kernels.fused_segment_aggregate(
+                        dev_cols, gid_dev, n_segments, specs, progs, n,
+                        mask_dev, program_key=program_key)
             out_keys = self._decode_present(present, key_layouts)
         return self._assemble_output(chk, plan, slots, out_keys, out_aggs,
                                      first_orig,
                                      [l[3] for l in key_layouts])
+
+    def _mesh_if_enabled(self, nb: int):
+        """Multi-chip mesh for the sharded aggregate when the session asks
+        for it (SET @@tidb_mesh_parallel = 1) and the bucket divides over
+        the devices (power-of-two buckets over power-of-two meshes)."""
+        if not bool(self.ctx.session_vars.get("tidb_mesh_parallel", 0)):
+            return None
+        jx = kernels.jax()
+        devs = jx.devices()
+        if len(devs) < 2 or nb % len(devs) != 0 or nb < len(devs) * 16:
+            return None
+        global _MESH_CACHE
+        if _MESH_CACHE is None or _MESH_CACHE.devices.size != len(devs):
+            from ..parallel import dist
+            _MESH_CACHE = dist.make_mesh(len(devs))
+        return _MESH_CACHE
 
     @staticmethod
     def _rep_key_codes(rep, e, chk, slot_id):

@@ -294,6 +294,46 @@ def segment_group_aggregate(gids: np.ndarray, n_segments: int,
     return present, out_aggs, np.asarray(first_orig)[present]
 
 
+def _fused_agg_outs(j, jn, agg_specs, arg_fns, cols, gid, valid,
+                    ns, presence, merge_sum, merge_min, merge_max):
+    """Per-aggregate switch shared by the single-device and sharded fused
+    kernels; merge_* combine per-shard partials (identity single-device,
+    psum/pmin/pmax over the mesh axis)."""
+    nseg = ns + 1
+    outs = []
+    for (func, has_arg), af in zip(agg_specs, arg_fns):
+        av = an = None
+        if has_arg and af is not None:
+            av, an = af(cols)
+        if func == "count_star":
+            outs.append((presence, jn.zeros(ns, dtype=bool)))
+            continue
+        live = valid & ~an
+        gl = jn.where(live, gid, ns)
+        cnt = merge_sum(j.ops.segment_sum(
+            live.astype(jn.int64), gl, num_segments=nseg)[:ns])
+        if func == "count":
+            outs.append((cnt, jn.zeros(ns, dtype=bool)))
+        elif func == "sum":
+            total = merge_sum(j.ops.segment_sum(
+                jn.where(live, av, 0), gl, num_segments=nseg)[:ns])
+            outs.append((total, cnt == 0))
+        elif func in ("min", "max"):
+            op = j.ops.segment_min if func == "min" else j.ops.segment_max
+            if av.dtype == jn.int64:
+                fill = (jn.iinfo(jn.int64).max if func == "min"
+                        else jn.iinfo(jn.int64).min)
+            else:
+                fill = jn.inf if func == "min" else -jn.inf
+            local = op(jn.where(live, av, fill), gl,
+                       num_segments=nseg)[:ns]
+            merged = merge_min(local) if func == "min" else merge_max(local)
+            outs.append((merged, cnt == 0))
+        else:  # pragma: no cover
+            raise ValueError(func)
+    return outs
+
+
 # ---- fully fused aggregation over device-resident columns -----------------
 # The flagship TPU path: raw table columns live padded in HBM (memoized on
 # the columnar replica), aggregate ARGUMENT expressions evaluate on device
@@ -333,42 +373,9 @@ def fused_segment_aggregate(dev_cols, gid_dev, n_segments: int,
             first_orig = j.ops.segment_min(jn.arange(n), g,
                                            num_segments=nseg)[:ns]
             first_orig = jn.minimum(first_orig, n - 1)
-            outs = []
-            for (func, has_arg), af in zip(agg_specs, arg_fns):
-                av = an = None
-                if has_arg and af is not None:
-                    av, an = af(cols)
-                if func == "count_star":
-                    outs.append((presence, jn.zeros(ns, dtype=bool)))
-                    continue
-                live = valid & ~an
-                gl = jn.where(live, gid, ns)
-                if func == "count":
-                    outs.append((j.ops.segment_sum(
-                        live.astype(jn.int64), gl,
-                        num_segments=nseg)[:ns],
-                        jn.zeros(ns, dtype=bool)))
-                elif func == "sum":
-                    total = j.ops.segment_sum(jn.where(live, av, 0), gl,
-                                              num_segments=nseg)[:ns]
-                    cnt = j.ops.segment_sum(live.astype(jn.int64), gl,
-                                            num_segments=nseg)[:ns]
-                    outs.append((total, cnt == 0))
-                elif func in ("min", "max"):
-                    op = (j.ops.segment_min if func == "min"
-                          else j.ops.segment_max)
-                    if av.dtype == jn.int64:
-                        fill = (jn.iinfo(jn.int64).max if func == "min"
-                                else jn.iinfo(jn.int64).min)
-                    else:
-                        fill = jn.inf if func == "min" else -jn.inf
-                    r = op(jn.where(live, av, fill), gl,
-                           num_segments=nseg)[:ns]
-                    cnt = j.ops.segment_sum(live.astype(jn.int64), gl,
-                                            num_segments=nseg)[:ns]
-                    outs.append((r, cnt == 0))
-                else:  # pragma: no cover
-                    raise ValueError(func)
+            ident = lambda x: x
+            outs = _fused_agg_outs(j, jn, agg_specs, arg_fns, cols, gid,
+                                   valid, ns, presence, ident, ident, ident)
             return presence, first_orig, outs
         fn = _FUSED_CACHE[key] = j.jit(kernel)
     presence, first_orig, outs = fn(dev_cols, gid_dev, mask_dev)
@@ -431,6 +438,73 @@ def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
     ng = 1 if int(n_valid) > 0 else 0
     out_aggs = [(np.asarray(v)[:ng], np.asarray(m)[:ng]) for v, m in outs]
     return out_aggs, np.asarray(first_orig)[:ng]
+
+
+def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
+                                    n_segments: int, agg_specs, arg_exprs,
+                                    n_rows: int, mask_dev,
+                                    program_key: tuple = ()):
+    """Multi-chip variant of the fused aggregate (SURVEY §2.11 P5: the
+    partial/final split AS a reduce-scatter schema): rows shard over the
+    mesh axis, each chip segment-reduces its shard with arguments evaluated
+    on-device, partial tables merge with psum/pmin/pmax over ICI.
+
+    Inputs must be padded to a bucket divisible by the mesh size (power-of-
+    two buckets over power-of-two meshes always are)."""
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    j = jax()
+    jn = jnp()
+    nb = int(gid_dev.shape[0])
+    n_dev = mesh.devices.size
+    assert nb % n_dev == 0, (nb, n_dev)
+    ns = bucket(max(n_segments, 1))
+    key = ("seg_sharded", tuple(agg_specs), program_key, ns, nb, n_dev)
+    fn = _FUSED_CACHE.get(key)
+    if fn is None:
+        from .exprjit import compile_expr
+        arg_fns = [e if callable(e) else
+                   (compile_expr(e) if e is not None else None)
+                   for e in arg_exprs]
+
+        def kernel(cols, gid, mask):
+            rows_local = gid.shape[0]
+            shard = j.lax.axis_index("shard")
+            base = shard.astype(jn.int64) * rows_local
+            valid = mask
+            g = jn.where(valid, gid, ns)
+            nseg = ns + 1
+            presence = j.lax.psum(j.ops.segment_sum(
+                valid.astype(jn.int64), g, num_segments=nseg)[:ns], "shard")
+            first_local = j.ops.segment_min(
+                jn.arange(rows_local) + base, g,
+                num_segments=nseg)[:ns]
+            first_orig = j.lax.pmin(
+                jn.minimum(first_local, nb - 1), "shard")
+            outs = _fused_agg_outs(
+                j, jn, agg_specs, arg_fns, cols, gid, valid, ns, presence,
+                merge_sum=lambda x: j.lax.psum(x, "shard"),
+                merge_min=lambda x: j.lax.pmin(x, "shard"),
+                merge_max=lambda x: j.lax.pmax(x, "shard"))
+            return presence, first_orig, outs
+
+        col_spec = tuple(
+            ((P("shard") if c[0] is not None else None, P("shard"))
+             if c is not None else None)
+            for c in dev_cols)
+        sm = shard_map(kernel, mesh=mesh,
+                       in_specs=(col_spec, P("shard"), P("shard")),
+                       out_specs=(P(), P(), [(P(), P())] * len(agg_specs)))
+        fn = _FUSED_CACHE[key] = j.jit(sm)
+    presence, first_orig, outs = fn(tuple(dev_cols), gid_dev, mask_dev)
+    present = np.nonzero(np.asarray(presence) > 0)[0]
+    present = present[present < n_segments]
+    out_aggs = [(np.asarray(v)[present], np.asarray(m)[present])
+                for v, m in outs]
+    return present, out_aggs, np.asarray(first_orig)[present]
 
 
 _SCALAR_AGG_CACHE: Dict[tuple, Callable] = {}

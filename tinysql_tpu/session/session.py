@@ -41,6 +41,7 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     "tidb_index_lookup_concurrency": 4,
     "tidb_use_tpu": 1,           # device enforcer master switch
     "tidb_enable_cascades_planner": 0,
+    "tidb_mesh_parallel": 0,     # shard fused aggregates over the device mesh
     "sql_mode": "STRICT_TRANS_TABLES",
     "max_execution_time": 0,
 }
