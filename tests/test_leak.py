@@ -1,0 +1,59 @@
+"""Thread-leak discipline (reference: util/testleak — every suite defers
+AfterTest asserting no goroutines leaked).  Runs a representative workload
+(sessions, lookups with worker pools, a wire server with connections),
+closes everything, and asserts no non-daemon threads survive.
+"""
+import threading
+import time
+
+
+def _non_daemon_threads():
+    return [t for t in threading.enumerate()
+            if t is not threading.main_thread() and not t.daemon]
+
+
+def test_no_thread_leak_after_workload():
+    baseline = set(id(t) for t in _non_daemon_threads())
+
+    from tinysql_tpu.session.session import new_session
+    from tinysql_tpu.server.server import Server
+    import socket
+    import struct
+
+    s = new_session()
+    s.execute("create database lk")
+    s.execute("use lk")
+    s.execute("create table t (a int primary key, b int, key ib (b))")
+    s.execute("insert into t values " + ", ".join(
+        f"({i}, {i % 5})" for i in range(1, 301)))
+    # index lookup spins its worker pool
+    s.execute("set @@tidb_use_tpu = 0")
+    assert s.query("select * from t where b = 3 order by a").rows
+    # cop scatter-gather spins its pool
+    from tinysql_tpu.codec import tablecodec
+    info = s.infoschema().table_by_name("lk", "t")
+    for h in (100, 200):
+        s.storage.cluster.split(tablecodec.encode_row_key(info.id, h))
+    s.storage.cache.invalidate_all()
+    assert s.query("select count(*) from t where a > 0").rows == [[300]]
+
+    # wire server: connect, query, quit
+    srv = Server(s.storage, port=0)
+    srv.start()
+    conn = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+    conn.recv(4096)  # greeting
+    payload = struct.pack("<IIB", 0x0200 | 0x8000, 1 << 24, 0x21) \
+        + b"\x00" * 23 + b"root\x00\x00"
+    conn.sendall(struct.pack("<I", len(payload))[:3] + b"\x01" + payload)
+    conn.recv(4096)
+    conn.close()
+    srv.close()
+
+    deadline = time.time() + 3.0
+    while time.time() < deadline:
+        extra = [t for t in _non_daemon_threads() if id(t) not in baseline]
+        if not extra:
+            break
+        time.sleep(0.05)
+    extra = [t for t in _non_daemon_threads() if id(t) not in baseline]
+    assert not extra, f"leaked non-daemon threads: {[t.name for t in extra]}"

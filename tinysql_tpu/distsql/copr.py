@@ -56,6 +56,8 @@ def run_dag(req: DAGRequest, chk: Chunk) -> list:
     """Execute the pushed chain over decoded rows; returns output rows as
     plain value lists (the 'tipb.SelectResponse chunk' analogue)."""
     import numpy as np
+    if req.analyze:
+        return _analyze_partial(req, chk)
     if req.filters:
         conds = [pb_to_expr(d) for d in req.filters]
         if chk.num_rows():
@@ -70,6 +72,32 @@ def run_dag(req: DAGRequest, chk: Chunk) -> list:
     if req.limit is not None:
         rows = rows[:req.limit]
     return rows
+
+
+ANALYZE_REGION_SAMPLES = 10_000
+
+
+def _analyze_partial(req: DAGRequest, chk: Chunk) -> list:
+    """Per-region ANALYZE task (reference: tipb.AnalyzeReq handled by
+    mocktikv/analyze.go): per scan column, one ReservoirSampler pass
+    producing the bounded uniform sample + null count + CMSketch +
+    FMSketch partials for the root's weighted merge."""
+    from ..statistics.sketches import ReservoirSampler
+    n = chk.num_rows()
+    out_cols = {}
+    for cid, col in zip(req.scan.col_ids, chk.columns):
+        rs = ReservoirSampler(ANALYZE_REGION_SAMPLES)
+        null = col.null_mask()
+        for i in range(n):
+            rs.collect(None if null[i] else col.get(i))
+        out_cols[cid] = {
+            "nulls": rs.null_count,
+            "live": rs.seen,
+            "samples": rs.samples,
+            "cms": rs.cms,
+            "fm": rs.fm,
+        }
+    return [{"rows": n, "cols": out_cols}]
 
 
 def _partial_agg(agg_pb: dict, chk: Chunk) -> list:

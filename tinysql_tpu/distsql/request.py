@@ -35,4 +35,5 @@ class DAGRequest:
     #   [{"name","args":[pb],"distinct"}]} — PARTIAL1 on the cop side
     topn: Optional[dict] = None               # {"by": [(pb, desc)], "n": int}
     limit: Optional[int] = None
+    analyze: bool = False                     # per-region stats partials
     resolved: Tuple[int, ...] = ()            # resolved-lock start_ts cache

@@ -1,10 +1,12 @@
 """ANALYZE TABLE: collect per-column histograms, CMSketch, FMSketch NDV.
 
-Capability parity with reference executor/analyze.go (:44-470 — column and
-index pushdown tasks, result merge) + statistics/builder.go, redesigned
+Capability parity with reference executor/analyze.go (:44-470 — column
+pushdown tasks, result merge) + statistics/builder.go, redesigned
 columnar-first: when the columnar replica is available the whole column is
-sampled vectorized; otherwise a row scan feeds reservoir samplers.  Results
-persist through statistics/table_stats.py (the mysql.stats_* analogue).
+sampled vectorized; otherwise per-region analyze tasks run through the
+coprocessor (reservoir samples + CMSketch + FMSketch partials, merged at
+root with live-count weighting).  Results persist through
+statistics/table_stats.py (the mysql.stats_* analogue).
 """
 from __future__ import annotations
 
@@ -13,7 +15,6 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..catalog.model import TableInfo
-from ..catalog.table import Table
 from ..mytypes import EvalType
 from .histogram import Histogram
 from .sketches import CMSketch, ReservoirSampler
@@ -32,10 +33,72 @@ def analyze_table(session, info: TableInfo) -> TableStats:
         if rep is not None:
             stats = _analyze_columnar(info, rep)
         else:
-            stats = _analyze_rows(info, txn)
+            stats = _analyze_distributed(storage, info, txn)
     finally:
         txn.rollback()
     save_stats(storage, stats)
+    return stats
+
+
+def _analyze_distributed(storage, info: TableInfo, txn) -> TableStats:
+    """Per-region analyze tasks merged at root (reference:
+    executor/analyze.go pushdown builders :171,318 + result merge
+    :251-316; region partials carry samples + CMSketch + FMSketch)."""
+    from ..codec import tablecodec
+    from ..distsql import DAGRequest, ScanInfo, select
+    from ..distsql.exprpb import _ft_to_pb
+    cols = info.public_columns()
+    req = DAGRequest(
+        start_ts=txn.start_ts,
+        scan=ScanInfo(
+            table_id=info.id,
+            col_ids=[c.id for c in cols],
+            col_fts=[_ft_to_pb(c.ft) for c in cols],
+            col_defaults=[c.default for c in cols],
+            handle_slots=[],
+            pk_id=(info.get_pk_handle_col().id
+                   if info.get_pk_handle_col() else None)),
+        analyze=True)
+    stats = TableStats(info.id, row_count=0)
+    # keep PER-REGION partials: regions of different sizes contribute to
+    # the final sample proportionally to their live counts (reference:
+    # statistics.MergeSampleCollector's weighted merge), otherwise a
+    # 10k-row region would weigh as much as a 1M-row one
+    parts: Dict[int, list] = {}
+    for batch in select(storage, req,
+                        [tablecodec.record_range(info.id)]):
+        for part in batch:
+            stats.row_count += part["rows"]
+            for cid, p in part["cols"].items():
+                parts.setdefault(cid, []).append(p)
+    rng = np.random.default_rng(0)
+    for cid, plist in parts.items():
+        live = sum(p["live"] for p in plist)
+        nulls = sum(p["nulls"] for p in plist)
+        target = min(SAMPLE_CAP, live)
+        samples: list = []
+        for p in plist:
+            if live == 0 or not p["samples"]:
+                continue
+            want = max(1, round(target * p["live"] / live))
+            src = p["samples"]
+            if want >= len(src):
+                samples.extend(src)
+            else:
+                idx = rng.choice(len(src), want, replace=False)
+                samples.extend(src[i] for i in idx)
+        cms = plist[0]["cms"]
+        fm = plist[0]["fm"]
+        for p in plist[1:]:
+            cms.merge(p["cms"])
+            fm.merge(p["fm"])
+        scale = max(1.0, live / max(len(samples), 1))
+        h = Histogram.build(cid, samples, null_count=nulls,
+                            max_buckets=MAX_BUCKETS)
+        _scale_histogram(h, scale, live + nulls, nulls)
+        h.ndv = max(h.ndv, fm.ndv() if scale > 1 else h.ndv)
+        stats.columns[cid] = h
+        stats.cms[cid] = cms
     return stats
 
 
@@ -79,27 +142,6 @@ def _analyze_columnar(info: TableInfo, rep) -> TableStats:
             cms.table = (cms.table.astype(np.float64) * scale).astype(np.uint32)
             cms.count = int(cms.count * scale)
         stats.cms[c.id] = cms
-    return stats
-
-
-def _analyze_rows(info: TableInfo, txn) -> TableStats:
-    cols = info.public_columns()
-    samplers = {c.id: ReservoirSampler(SAMPLE_CAP) for c in cols}
-    n = 0
-    for _, row in Table(info).iter_records(txn):
-        n += 1
-        for c in cols:
-            samplers[c.id].collect(row[c.offset])
-    stats = TableStats(info.id, row_count=n)
-    for c in cols:
-        s = samplers[c.id]
-        scale = max(1.0, s.seen / max(len(s.samples), 1))
-        h = Histogram.build(c.id, s.samples, null_count=s.null_count,
-                            max_buckets=MAX_BUCKETS)
-        _scale_histogram(h, scale, s.seen + s.null_count, s.null_count)
-        h.ndv = max(h.ndv, s.fm.ndv() if scale > 1 else h.ndv)
-        stats.columns[c.id] = h
-        stats.cms[c.id] = s.cms
     return stats
 
 

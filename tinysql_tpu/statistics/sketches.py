@@ -111,6 +111,18 @@ class FMSketch:
     def ndv(self) -> int:
         return (int(self.mask) + 1) * len(self.hashset)
 
+    def merge(self, other: "FMSketch") -> None:
+        """Union two sketches (per-region ANALYZE partials): lift both to
+        the coarser mask, union the surviving hashes, shrink as needed."""
+        mask = max(int(self.mask), int(other.mask))
+        merged = {x for x in self.hashset if x & mask == 0}
+        merged |= {x for x in other.hashset if x & mask == 0}
+        while len(merged) > self.max_size:
+            mask = (mask << 1) | 1
+            merged = {x for x in merged if x & mask == 0}
+        self.mask = np.uint64(mask)
+        self.hashset = merged
+
 
 class ReservoirSampler:
     """Fixed-size uniform row sample (reference: sample.go
