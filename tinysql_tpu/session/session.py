@@ -144,11 +144,11 @@ class Session:
         self._explicit_txn = False
 
     def _finish_stmt(self, ok: bool) -> None:
-        """Autocommit boundary (reference: session/tidb.go finishStmt)."""
-        if self._explicit_txn:
-            if not ok:
-                self.rollback_txn()
-            return
+        """Autocommit boundary (reference: session/tidb.go finishStmt):
+        with autocommit=0 the implicit transaction stays open across
+        statements until COMMIT/ROLLBACK, exactly like BEGIN."""
+        if self._explicit_txn or not bool(self.get_sysvar("autocommit")):
+            return  # statement-level rollback handled via checkpoints
         if ok:
             self.commit_txn()
         else:
@@ -188,7 +188,9 @@ class Session:
         # statement-level rollback inside an explicit txn (reference:
         # session/txn.go StmtRollback): a failed statement undoes only its
         # own buffered writes, the transaction stays open
-        cp = self._txn.checkpoint() if (self._explicit_txn and self._txn) else None
+        in_txn_scope = self._explicit_txn or not bool(
+            self.get_sysvar("autocommit"))
+        cp = self._txn.checkpoint() if (in_txn_scope and self._txn) else None
         self.last_affected = 0  # per-statement affected-rows counter
         try:
             rs = self._dispatch(stmt)
