@@ -148,3 +148,27 @@ def test_schema_version_bumps_per_state(tk):
     v1 = Meta(txn).schema_version()
     txn.rollback()
     assert v1 - v0 >= 5
+
+
+def test_commit_aborts_when_schema_changed_mid_txn(tk):
+    """Schema validator (reference: domain/schema_validator.go via
+    2pc.go:633): a write txn spanning a DDL must abort at commit, or its
+    buffered rows would silently miss the new index."""
+    import pytest
+    from tinysql_tpu.kv import RetryableError
+    from tinysql_tpu.session.session import Session
+    tk.must_exec("create table sv (a int primary key, b int)")
+    tk.must_exec("begin")
+    tk.must_exec("insert into sv values (1, 1)")
+    other = Session(tk.session.storage, current_db="test")
+    other.execute("alter table sv add index ib (b)")
+    with pytest.raises(RetryableError, match="schema"):
+        tk.must_exec("commit")
+    # aborted cleanly: no row, no index inconsistency
+    assert other.query("select count(*) from sv").rows == [[0]]
+    assert other.query("admin check table sv").rows == [["OK"]]
+    # retry succeeds under the new schema
+    tk.must_exec("begin")
+    tk.must_exec("insert into sv values (1, 1)")
+    tk.must_exec("commit")
+    assert other.query("select a from sv where b = 1").rows == [[1]]

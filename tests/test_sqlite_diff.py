@@ -70,25 +70,41 @@ class _Gen:
         r = self.rng
         shape = r.random()
         where = f" where {self.pred()}" if r.random() < 0.7 else ""
-        if shape < 0.45:  # plain select
+        if shape < 0.4:  # plain select
             exprs = ", ".join(self.scalar() for _ in range(r.randint(1, 3)))
-            order = " order by a"
-            limit = f" limit {r.randint(1, 20)}" if r.random() < 0.4 else ""
+            keys = ["a"]
+            if r.random() < 0.4:
+                keys.insert(0, r.choice(["b", "c", "d", "b desc",
+                                         "c desc", "d desc"]))
+            order = " order by " + ", ".join(keys)
+            limit = ""
+            if r.random() < 0.4:
+                limit = f" limit {r.randint(1, 20)}"
+                if r.random() < 0.4:
+                    limit += f" offset {r.randint(0, 10)}"
             return f"select a, {exprs} from t{where}{order}{limit}"
-        if shape < 0.85:  # aggregate
+        if shape < 0.8:  # aggregate (+ HAVING sometimes)
             gb = r.choice(["b", "d", "b, d", ""])
             aggs = ", ".join(r.choice(
                 ["count(*)", "count(b)", "count(d)", "sum(b)", "sum(c)",
                  "min(b)", "max(c)", "avg(c)", "min(d)", "max(d)"])
                 for _ in range(r.randint(1, 3)))
             if gb:
+                having = ""
+                if r.random() < 0.35:
+                    having = f" having count(*) > {r.randint(0, 5)}"
                 return (f"select {gb}, {aggs} from t{where} "
-                        f"group by {gb} order by {gb}")
+                        f"group by {gb}{having} order by {gb}")
             return f"select {aggs} from t{where}"
-        # join
+        if shape < 0.92:  # join
+            cond = r.choice(["t.b = u.k", "t.a = u.k"])
+            jt = r.choice(["join", "left join"])
+            return (f"select t.a, u.v from t {jt} u on {cond}{where} "
+                    f"order by t.a, u.v")
+        # aggregate over a join
         cond = r.choice(["t.b = u.k", "t.a = u.k"])
-        return (f"select t.a, u.v from t join u on {cond}{where} "
-                f"order by t.a, u.v")
+        return (f"select u.v, count(*), sum(t.b) from t join u on {cond}"
+                f"{where} group by u.v order by u.v")
 
 
 def _canon(rows):

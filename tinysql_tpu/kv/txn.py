@@ -325,10 +325,12 @@ class TwoPhaseCommitter:
         committed = False
         try:
             self.prewrite()
-            # schema re-check before the point of no return (2pc.go:633)
-            if self.txn.schema_check is not None:
-                self.txn.schema_check(self.start_ts)
+            # schema re-check at the COMMIT timestamp, before the point of
+            # no return (2pc.go:633): a DDL landing between prewrite and
+            # commit_ts logically precedes this txn and must abort it
             self.commit_ts = self.storage.oracle.get_timestamp()
+            if self.txn.schema_check is not None:
+                self.txn.schema_check(self.commit_ts)
             failpoint.inject("beforeCommit")
             self.commit_keys()
             committed = True

@@ -105,9 +105,7 @@ class Session:
 
     # ---- schema cache (reference: domain.Reload; lazy version check) ---
     def infoschema(self) -> InfoSchema:
-        txn = self.storage.begin()
-        ver = Meta(txn).schema_version()
-        txn.rollback()
+        ver = self._schema_version()
         if self._is is None or self._is.version != ver:
             self._is = InfoSchema.load(self.storage)
         return self._is
@@ -126,7 +124,35 @@ class Session:
     def get_txn(self):
         if self._txn is None:
             self._txn = self.storage.begin()
+            # schema validity re-check before the commit point (reference:
+            # domain/schema_validator.go Check via 2pc.go:633): a DDL that
+            # landed mid-transaction would make buffered writes miss index
+            # maintenance, so the commit must abort and retry instead
+            # baseline read through the txn's OWN snapshot so the check
+            # compares against what this txn actually sees
+            start_ver = Meta(self._txn).schema_version()
+            storage = self.storage
+
+            def schema_check(commit_ts):
+                txn = storage.begin()
+                try:
+                    now_ver = Meta(txn).schema_version()
+                finally:
+                    txn.rollback()
+                if now_ver != start_ver:
+                    raise RetryableError(
+                        "Information schema is changed during the "
+                        "execution of the statement (schema version "
+                        f"{start_ver} -> {now_ver})")
+            self._txn.schema_check = schema_check
         return self._txn
+
+    def _schema_version(self) -> int:
+        txn = self.storage.begin()
+        try:
+            return Meta(txn).schema_version()
+        finally:
+            txn.rollback()
 
     def in_txn(self) -> bool:
         return self._explicit_txn
@@ -227,7 +253,7 @@ class Session:
             return self._exec_set(stmt)
         if isinstance(stmt, ast.BeginStmt):
             self.commit_txn()
-            self._txn = self.storage.begin()
+            self.get_txn()  # hooks the schema validator on the fresh txn
             self._explicit_txn = True
             return None
         if isinstance(stmt, ast.CommitStmt):
