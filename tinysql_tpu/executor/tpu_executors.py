@@ -38,19 +38,45 @@ def _drain_chunk(ex: Executor, fields) -> Chunk:
     return out
 
 
+MASK_COMPACT_SEL = 0.3  # below this selectivity, compacting beats masking
+
+
+def _take_replica_masked(ex: Executor, extra_conds=None):
+    """Single owner of the raw-replica intake: (chunk, mask, replica) with
+    scan filters plus `extra_conds` folded into one mask (None when no
+    conditions), or (None, None, None) when the child cannot serve raw."""
+    from .executors import TableReaderExec
+    if not isinstance(ex, TableReaderExec):
+        return None, None, None
+    chk, filters, rep = ex.take_raw_replica()
+    if chk is None:
+        return None, None, None
+    conds = list(filters) + list(extra_conds or [])
+    mask = vectorized_filter(conds, chk) if conds else None
+    return chk, mask, rep
+
+
+def _compact_if_selective(chk: Chunk, mask):
+    """Selective filters compact (less kernel work); permissive ones stay
+    masked (stable bucket shape = one TPU compile per table size)."""
+    if mask is not None and mask.size and mask.mean() < MASK_COMPACT_SEL:
+        chk.set_sel(np.nonzero(mask)[0])
+        return chk.compact(), None
+    if mask is not None and not mask.size:
+        return chk, None  # empty chunk: nothing to mask
+    return chk, mask
+
+
 def _child_input(ex: Executor) -> Chunk:
     """Materialize a child's full output: TableReaders on the columnar
     replica hand over zero-copy column views (filters applied by selection
     compaction) instead of slicing + re-appending chunk by chunk."""
-    from .executors import TableReaderExec
-    if isinstance(ex, TableReaderExec):
-        chk, filters, _rep = ex.take_raw_replica()
-        if chk is not None:
-            if filters:
-                mask = vectorized_filter(filters, chk)
-                chk.set_sel(np.nonzero(mask)[0])
-                chk = chk.compact()
-            return chk
+    chk, mask, _rep = _take_replica_masked(ex)
+    if chk is not None:
+        if mask is not None:
+            chk.set_sel(np.nonzero(mask)[0])
+            chk = chk.compact()
+        return chk
     return _drain_chunk(ex, ex.field_types()).compact()
 
 
@@ -101,22 +127,13 @@ class TPUHashAggExec(Executor):
         and turn the scan filters into a device-side valid mask, skipping
         chunk slicing, host compaction, and append copies entirely (the
         filter+aggregate fusion XLA is built for)."""
-        child = self.children[0]
-        from .executors import TableReaderExec
-        if not isinstance(child, TableReaderExec):
-            return None, None
-        chk, filters, _rep = child.take_raw_replica()
+        chk, mask, _rep = _take_replica_masked(self.children[0])
         if chk is None:
             return None, None
-        mask = vectorized_filter(filters, chk) if filters else None
         # low-selectivity GROUPED aggregates sort faster over a compacted
-        # input than over the full table with a mask; scalar aggregates
-        # never sort, so they always keep the fused mask
-        if (mask is not None and self.plan.group_by
-                and mask.mean() < 0.3):
-            chk.set_sel(np.nonzero(mask)[0])
-            chk = chk.compact()
-            mask = None
+        # input; scalar aggregates never sort, so they keep the fused mask
+        if self.plan.group_by:
+            chk, mask = _compact_if_selective(chk, mask)
         return chk, mask
 
     @staticmethod
@@ -608,29 +625,38 @@ class TPUHashJoinExec(Executor):
         super().open(ctx)
         self._done = False
 
+    def _side_input(self, i: int, side_conds):
+        """(chunk, mask, replica): replica-backed readers keep RAW rows
+        with scan and side filters folded into a mask; other children
+        materialize compacted with side conds applied."""
+        ex = self.children[i]
+        chk, mask, rep = _take_replica_masked(ex, side_conds)
+        if chk is not None:
+            chk, mask = _compact_if_selective(chk, mask)
+            return chk, mask, (rep if mask is not None else None)
+        chk = _child_input(ex)
+        if side_conds:
+            m = vectorized_filter(side_conds, chk)
+            chk.set_sel(np.nonzero(m)[0])
+            chk = chk.compact()
+        return chk, None, None
+
     def next(self) -> Optional[Chunk]:
         if self._done:
             return None
         self._done = True
         plan = self.plan
-        lchk = _child_input(self.children[0])
-        rchk = _child_input(self.children[1])
-        if plan.left_conditions:
-            mask = vectorized_filter(plan.left_conditions, lchk)
-            lchk.set_sel(np.nonzero(mask)[0])
-            lchk = lchk.compact()
-        if plan.right_conditions:
-            mask = vectorized_filter(plan.right_conditions, rchk)
-            rchk.set_sel(np.nonzero(mask)[0])
-            rchk = rchk.compact()
-        lk, lnull = plan.left_keys[0].vec_eval(lchk)
-        rk, rnull = plan.right_keys[0].vec_eval(rchk)
+        lchk, lmask, lrep = self._side_input(0, plan.left_conditions)
+        rchk, rmask, rrep = self._side_input(1, plan.right_conditions)
+        lk, lnull = self._key_arrays(plan.left_keys[0], lchk, lrep, 0)
+        rk, rnull = self._key_arrays(plan.right_keys[0], rchk, rrep, 1)
         if lk.dtype != rk.dtype:
-            lk = lk.astype(np.float64)
-            rk = rk.astype(np.float64)
-        li, ri = kernels.join_match((lk, lnull), lchk.num_rows(),
-                                    (rk, rnull), rchk.num_rows(),
-                                    outer=(plan.tp == "left"))
+            lk = np.asarray(lk).astype(np.float64)
+            rk = np.asarray(rk).astype(np.float64)
+        li, ri = kernels.join_match((lk, lnull), lchk.full_rows(),
+                                    (rk, rnull), rchk.full_rows(),
+                                    outer=(plan.tp == "left"),
+                                    lvalid=lmask, rvalid=rmask)
         # gather output columns
         unmatched = ri < 0
         ri_safe = np.where(unmatched, 0, ri)
@@ -679,6 +705,33 @@ class TPUHashJoinExec(Executor):
                 for c in out.columns[len(lchk.columns):]:
                     c.null_mask()[idx] = True
         return keep
+
+
+    def _key_arrays(self, key_expr, chk, rep, side):
+        """Join key (values, null) — for a bare Column over an uncompacted
+        replica, PADDED DEVICE arrays memoized on the replica (no re-upload
+        per query); numpy otherwise."""
+        from ..expression import Column as ExprColumn
+        from .executors import TableReaderExec
+        if rep is not None and isinstance(key_expr, ExprColumn):
+            child = self.children[side]
+            if isinstance(child, TableReaderExec):
+                ci = child._decode_cols[key_expr.index]
+                sid = ci.id if ci is not None else "handle"
+                nb = kernels.bucket(max(chk.full_rows(), 1))
+                jn = kernels.jnp()
+                col = chk.columns[key_expr.index]
+                v = col.values()
+                m = col.null_mask()
+                if v.dtype != object and v.dtype.kind != "U":
+                    dv = rep.memo(("devv", sid, nb),
+                                  lambda v=v: jn.asarray(
+                                      kernels.pad1(v, nb)))
+                    dn = rep.memo(("devn", sid, nb),
+                                  lambda m=m: jn.asarray(
+                                      kernels.pad1(m, nb, True)))
+                    return dv, dn
+        return key_expr.vec_eval(chk)
 
 
 class TPUSortExec(Executor):

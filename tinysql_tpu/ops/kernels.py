@@ -638,19 +638,29 @@ def _join_expand_kernel(outer: bool):
 
 def join_match(lkey: Tuple[np.ndarray, np.ndarray], n_left: int,
                rkey: Tuple[np.ndarray, np.ndarray], n_right: int,
-               outer: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+               outer: bool = False, lvalid: np.ndarray = None,
+               rvalid: np.ndarray = None) -> Tuple[np.ndarray, np.ndarray]:
     """Returns (left_indices, right_indices) of matching row pairs; for
-    outer, unmatched left rows appear once with right index -1."""
+    outer, unmatched VALID left rows appear once with right index -1.
+    `lvalid`/`rvalid` fold side filters into the kernel's masks so callers
+    skip host compaction AND keep bucket shapes stable across differently
+    selective filters (one TPU compile per table size, not per filter)."""
     jn = jnp()
     nlb, nrb = bucket(max(n_left, 1)), bucket(max(n_right, 1))
     lv = np.zeros(nlb, dtype=bool)
-    lv[:n_left] = True
+    lv[:n_left] = lvalid if lvalid is not None else True
     rv = np.zeros(nrb, dtype=bool)
-    rv[:n_right] = True
-    lk = jn.asarray(pad1(lkey[0], nlb))
-    ln = jn.asarray(pad1(lkey[1], nlb, True))
-    rk = jn.asarray(pad1(rkey[0], nrb))
-    rn = jn.asarray(pad1(rkey[1], nrb, True))
+    rv[:n_right] = rvalid if rvalid is not None else True
+    def dev(a, n, fill):
+        # already-padded device arrays (replica-memoized keys) pass through
+        if isinstance(a, np.ndarray):
+            return jn.asarray(pad1(a, n, fill))
+        assert a.shape[0] == n, (a.shape, n)
+        return a
+    lk = dev(lkey[0], nlb, 0)
+    ln = dev(lkey[1], nlb, True)
+    rk = dev(rkey[0], nrb, 0)
+    rn = dev(rkey[1], nrb, True)
     ck = ("count", nlb, nrb, str(lk.dtype), str(rk.dtype))
     cfn = _JOIN_COUNT_CACHE.get(ck)
     if cfn is None:
