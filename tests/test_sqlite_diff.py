@@ -54,9 +54,13 @@ class _Gen:
             if kind < 0.55:
                 op = r.choice(["=", "!=", "<", "<=", ">", ">="])
                 return f"{self.scalar()} {op} {self.scalar()}"
-            if kind < 0.7:
+            if kind < 0.63:
                 col = r.choice(["b", "c", "d"])
                 return f"{col} is {'not ' if r.random() < .5 else ''}null"
+            if kind < 0.7:
+                op = r.choice(["=", "!=", "<", "<=", ">", ">="])
+                lit = r.choice(STRINGS + ["b", "zeta"])
+                return f"d {op} '{lit}'"
             if kind < 0.85:
                 vals = ", ".join(str(r.choice([-5, 0, 1, 2, 3, 7, 100]))
                                  for _ in range(r.randint(1, 3)))

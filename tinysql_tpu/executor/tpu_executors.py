@@ -44,7 +44,12 @@ MASK_COMPACT_SEL = 0.3  # below this selectivity, compacting beats masking
 def _take_replica_masked(ex: Executor, extra_conds=None):
     """Single owner of the raw-replica intake: (chunk, mask, replica) with
     scan filters plus `extra_conds` folded into one mask (None when no
-    conditions), or (None, None, None) when the child cannot serve raw."""
+    conditions), or (None, None, None) when the child cannot serve raw.
+
+    String comparisons against constants rewrite to integer compares over
+    replica-memoized dictionary codes (ordered np.unique) — built once per
+    replica version, they turn e.g. TPC-H date-range filters from <U
+    string compares into int64 compares."""
     from .executors import TableReaderExec
     if not isinstance(ex, TableReaderExec):
         return None, None, None
@@ -52,8 +57,82 @@ def _take_replica_masked(ex: Executor, extra_conds=None):
     if chk is None:
         return None, None, None
     conds = list(filters) + list(extra_conds or [])
-    mask = vectorized_filter(conds, chk) if conds else None
+    if not conds:
+        return chk, None, rep
+    mask = None
+    residual = []
+    for c in conds:
+        m = _string_cmp_mask(ex, rep, chk, c)
+        if m is None:
+            residual.append(c)
+        else:
+            mask = m if mask is None else (mask & m)
+    if residual:
+        rm = vectorized_filter(residual, chk)
+        mask = rm if mask is None else (mask & rm)
     return chk, mask, rep
+
+
+_STR_CMP_OPS = {"=", "!=", "<", "<=", ">", ">="}
+
+
+def _rep_string_dict(rep, sid, chk, idx):
+    """Ordered dictionary codes for a string replica column, memoized per
+    replica version in the SAME slot the group-key path uses:
+    (codes int64 [n] with NULL -> card, card, base=0, uniques)."""
+    def build():
+        col = chk.columns[idx]
+        v = col.values()
+        null = col.null_mask()
+        safe = np.where(null, "", v)
+        uniques, codes = np.unique(safe.astype(str), return_inverse=True)
+        codes = np.where(null, len(uniques), codes).astype(np.int64)
+        return codes, len(uniques), 0, uniques
+    return rep.memo(("keycodes", sid, True, False), build)
+
+
+def _string_cmp_mask(ex, rep, chk, cond):
+    """Try to evaluate `cond` (string Column vs string Constant compare)
+    through dictionary codes; returns a bool mask or None."""
+    from ..expression import Column as ExprColumn, Constant, ScalarFunction
+    from ..mytypes import EvalType as ET
+    if not (isinstance(cond, ScalarFunction)
+            and cond.name in _STR_CMP_OPS and len(cond.args) == 2):
+        return None
+    a, b = cond.args
+    flip = False
+    if isinstance(b, ExprColumn) and isinstance(a, Constant):
+        a, b = b, a
+        flip = True
+    if not (isinstance(a, ExprColumn) and isinstance(b, Constant)):
+        return None
+    if a.eval_type is not ET.STRING or not isinstance(b.value, str):
+        return None
+    col = chk.columns[a.index]
+    if col.values().dtype.kind != "U":
+        return None
+    op = cond.name
+    if flip:
+        op = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+              "=": "=", "!=": "!="}[op]
+    ci = ex._decode_cols[a.index]
+    sid = ci.id if ci is not None else "handle"
+    codes, card, _, uniques = _rep_string_dict(rep, sid, chk, a.index)
+    val = b.value
+    lo = int(np.searchsorted(uniques, val, side="left"))
+    hi = int(np.searchsorted(uniques, val, side="right"))
+    live = codes != card  # NULL code = card: comparisons exclude it
+    if op == "=":
+        return live & (codes >= lo) & (codes < hi)
+    if op == "!=":
+        return live & ((codes < lo) | (codes >= hi))
+    if op == "<":
+        return live & (codes < lo)
+    if op == "<=":
+        return live & (codes < hi)
+    if op == ">":
+        return live & (codes >= hi)
+    return live & (codes >= lo)  # >=
 
 
 def _compact_if_selective(chk: Chunk, mask):
@@ -264,7 +343,7 @@ class TPUHashAggExec(Executor):
             if not isinstance(e, ExprColumn):
                 return None
 
-        chk, filters, rep = child.take_raw_replica()
+        chk, fmask, rep = _take_replica_masked(child)
         if chk is None:
             return None  # nothing consumed: reader bails identically
         n = chk.full_rows()
@@ -325,12 +404,10 @@ class TPUHashAggExec(Executor):
             else:
                 progs.append(a)
 
-        # ---- filter mask (the only per-query upload) --------------------
+        # ---- filter mask (the only per-query upload; string compares
+        # already rewritten to dictionary-code int compares) -------------
         mask = np.zeros(nb, dtype=bool)
-        if filters:
-            mask[:n] = vectorized_filter(filters, chk)
-        else:
-            mask[:n] = True
+        mask[:n] = fmask if fmask is not None else True
         mask_dev = jn.asarray(mask)
 
         program_key = tuple(
@@ -393,15 +470,11 @@ class TPUHashAggExec(Executor):
         is_string = v.dtype == object or v.dtype.kind == "U"
         uns = (not is_string and v.dtype == np.int64
                and getattr(e.ret_type, "is_unsigned", False))
+        if is_string:
+            # shared with the filter rewrite: one dictionary per column
+            return _rep_string_dict(rep, slot_id, chk, idx)
 
         def build():
-            if is_string:
-                safe = np.where(null, "", v)
-                uniq, codes = np.unique(safe.astype(str),
-                                        return_inverse=True)
-                codes = np.where(null, len(uniq),
-                                 codes).astype(np.int64)
-                return codes, len(uniq), 0, uniq
             w = (v ^ np.int64(-2**63)) if uns else v
             if w.dtype != np.int64:
                 return None
