@@ -38,7 +38,15 @@ def _drain_chunk(ex: Executor, fields) -> Chunk:
     return out
 
 
-MASK_COMPACT_SEL = 0.3  # below this selectivity, compacting beats masking
+def _mask_compact_threshold() -> float:
+    """Below this selectivity, compacting beats masking.  On real TPUs
+    masked full-table kernels win almost always (stable shapes = one
+    compile; throughput absorbs the extra rows); on the CPU backend the
+    extra rows are pure cost, so compact much more aggressively."""
+    try:
+        return 0.3 if kernels.jax().default_backend() == "tpu" else 0.75
+    except Exception:
+        return 0.3
 
 
 def _take_replica_masked(ex: Executor, extra_conds=None):
@@ -138,7 +146,8 @@ def _string_cmp_mask(ex, rep, chk, cond):
 def _compact_if_selective(chk: Chunk, mask):
     """Selective filters compact (less kernel work); permissive ones stay
     masked (stable bucket shape = one TPU compile per table size)."""
-    if mask is not None and mask.size and mask.mean() < MASK_COMPACT_SEL:
+    if (mask is not None and mask.size
+            and mask.mean() < _mask_compact_threshold()):
         chk.set_sel(np.nonzero(mask)[0])
         return chk.compact(), None
     if mask is not None and not mask.size:
