@@ -213,6 +213,54 @@ def test_status_endpoint(server):
         st.close()
 
 
+def test_wire_fidelity_fuzz(server):
+    """Randomized queries return IDENTICAL results through the MySQL
+    protocol and the embedded session (text-protocol encode/decode
+    fidelity over the fuzz grammar)."""
+    import random
+    from test_sqlite_diff import _Gen, _gen_rows
+    from tinysql_tpu.session.session import Session
+    rng = random.Random(99)
+    rows = _gen_rows(rng, 50)
+    s = Session(server.storage)
+    s.execute("create database if not exists wf")
+    s.execute("use wf")
+    s.execute("create table t (a int primary key, b int, c double, "
+              "d varchar(12), key ib (b))")
+    s.execute("create table u (k int primary key, v varchar(6))")
+    s.execute("insert into t values " + ", ".join(
+        "(" + ", ".join(
+            "null" if v is None
+            else (f"'{v}'" if isinstance(v, str) else repr(v))
+            for v in r) + ")" for r in rows))
+    s.execute("insert into u values " + ", ".join(
+        f"({k}, 'v{k % 6}')" for k in range(-2, 9)))
+    c = MiniClient(server.port, db="wf")
+    gen = _Gen(rng)
+
+    def canon(rows):
+        out = []
+        for r in rows:
+            key = []
+            for v in r:
+                if v is None:
+                    key.append("\x00N")
+                else:
+                    try:
+                        key.append(f"{float(v):.9g}")
+                    except (TypeError, ValueError):
+                        key.append(str(v))
+            out.append(tuple(key))
+        return sorted(out)
+
+    for _ in range(40):
+        q = gen.query()
+        direct = canon(s.query(q).rows)
+        wire = canon(c.query(q)[1])
+        assert direct == wire, q
+    c.close()
+
+
 def test_config_strict_load(tmp_path):
     from tinysql_tpu import config as cfgmod
     f = tmp_path / "ok.toml"
