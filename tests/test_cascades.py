@@ -25,6 +25,12 @@ QUERIES = [
     "select a from t where a in (1, 5, 50) order by a",
     "select b, max(a) from t where c = 'x1' group by b order by b",
     "select a from t where a between 10 and 20 and b != 4 order by a",
+    # the shared normalization rewrites, through BOTH pipelines:
+    "select max(a) from t",                              # max/min -> TopN(1)
+    "select a, count(*), sum(b) from t where a < 5 group by a order by a",
+    "select t.a from t left join u on t.b = u.k order by t.a limit 4",
+    "select count(*) from t join u on t.b = u.k "
+    "join t t2 on t.a = t2.a",                           # 3-way reorder
 ]
 
 

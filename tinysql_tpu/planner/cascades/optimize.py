@@ -115,12 +115,14 @@ def _expr_cost(ge: GroupExpr, childs) -> Tuple[float, float]:
 
 
 def find_best_plan(logical: LogicalPlan, tpu: bool = True):
-    """Full cascades pipeline: memo -> explore -> implement -> shared
-    physical tail (reference: Optimize/FindBestPlan optimize.go:105)."""
-    from ..optimizer import column_pruning, to_physical
+    """Full cascades pipeline: pre-normalization -> memo -> explore ->
+    implement -> shared physical tail (reference: Optimize/FindBestPlan
+    optimize.go:105; the pre-passes mirror the System-R rewrites whose
+    effects the transformation rule set does not replicate)."""
+    from ..optimizer import normalize_logical, to_physical
     from ..device import place_devices
     from ..cop import push_to_cop
-    column_pruning(logical, {c.unique_id for c in logical.schema.columns})
+    logical = normalize_logical(logical, push_predicates=False)
     memo = Memo()
     root = memo.build(logical)
     explore(memo, root)

@@ -321,23 +321,33 @@ def _ds_row_count(ds) -> float:
     return float(s.row_count) if s else 0.0
 
 
-def optimize(logical: LogicalPlan, tpu: bool = True) -> PhysicalPlan:
-    """The System-R style pipeline (reference: planner/core/optimizer.go:77
-    — the fixed-order rewrite list of optimizer.go:44-55), physical
-    conversion, then the device enforcer + coprocessor pushdown."""
+def normalize_logical(logical: LogicalPlan,
+                      push_predicates: bool = True) -> LogicalPlan:
+    """The fixed-order logical rewrite list (reference:
+    planner/core/optimizer.go:44-55), shared by BOTH optimizer frameworks
+    so their normalization can never drift.  The cascades pipeline skips
+    predicate pushdown (its transformation rules own that)."""
     from .rules_extra import (eliminate_aggregation, eliminate_max_min,
                               eliminate_outer_joins, eliminate_projections,
                               join_reorder)
     root_needed = {c.unique_id for c in logical.schema.columns}
     logical = eliminate_outer_joins(logical, root_needed)
-    retained, logical = predicate_pushdown(logical, [])
-    if retained:
-        logical = LogicalSelection(retained, logical)
+    if push_predicates:
+        retained, logical = predicate_pushdown(logical, [])
+        if retained:
+            logical = LogicalSelection(retained, logical)
     column_pruning(logical, root_needed)
     logical = eliminate_aggregation(logical)
     logical = eliminate_max_min(logical)
     logical = eliminate_projections(logical)
-    logical = join_reorder(logical, stats_of=_ds_row_count)
+    return join_reorder(logical, stats_of=_ds_row_count)
+
+
+def optimize(logical: LogicalPlan, tpu: bool = True) -> PhysicalPlan:
+    """The System-R style pipeline (reference: planner/core/optimizer.go:77
+    — the fixed-order rewrite list of optimizer.go:44-55), physical
+    conversion, then the device enforcer + coprocessor pushdown."""
+    logical = normalize_logical(logical)
     logical = topn_pushdown(logical)
     phys = to_physical(logical)
     from .device import place_devices
