@@ -16,6 +16,8 @@ from tinysql_tpu.session.session import new_session
 
 N_QUERIES = int(os.environ.get("TINYSQL_FUZZ_N", "120"))
 SEED = int(os.environ.get("TINYSQL_FUZZ_SEED", "1234"))
+N_ROWS = int(os.environ.get("TINYSQL_FUZZ_ROWS", "80"))
+MESH = os.environ.get("TINYSQL_FUZZ_MESH", "") == "1"
 
 COLS = [("a", "int"), ("b", "int"), ("c", "double"), ("d", "varchar(12)")]
 STRINGS = ["alpha", "beta", "Γδ", "x", "", "zz9", "Beta"]
@@ -130,7 +132,7 @@ def _canon(rows):
 @pytest.fixture(scope="module")
 def engines():
     rng = random.Random(SEED)
-    rows = _gen_rows(rng, 80)
+    rows = _gen_rows(rng, N_ROWS)
     urows = [(k, f"v{k % 6}") for k in range(-2, 9)]
 
     s = new_session()
@@ -167,6 +169,8 @@ def test_differential_vs_sqlite(engines):
         want = _canon(lite.execute(q.replace("!=", "<>")).fetchall())
         for tier in (0, 1):
             s.execute(f"set @@tidb_use_tpu = {tier}")
+            s.execute(f"set @@tidb_mesh_parallel = "
+                      f"{1 if MESH and tier else 0}")
             got = _canon(s.query(q).rows)
             if got != want:
                 mismatches.append((q, tier, got[:4], want[:4]))

@@ -462,7 +462,14 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
     n_dev = mesh.devices.size
     assert nb % n_dev == 0, (nb, n_dev)
     ns = bucket(max(n_segments, 1))
-    key = ("seg_sharded", tuple(agg_specs), program_key, ns, nb, n_dev)
+    # the shard_map spec is frozen per closure: the per-slot structure of
+    # dev_cols (absent / mask-only / full) MUST key the cache or a
+    # same-program query with a different column layout reuses a
+    # mismatched spec
+    dev_shape = tuple(0 if c is None else (1 if c[0] is None else 2)
+                      for c in dev_cols)
+    key = ("seg_sharded", tuple(agg_specs), program_key, ns, nb, n_dev,
+           dev_shape)
     fn = _FUSED_CACHE.get(key)
     if fn is None:
         from .exprjit import compile_expr
