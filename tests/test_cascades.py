@@ -50,10 +50,16 @@ def tk():
 
 
 def _normalize(rows):
-    """Strip volatile column ids (col#N) from explain text."""
+    """Strip volatile column ids (col#N) and data-dependent row estimates
+    from explain text (plan SHAPE is the regression target)."""
     import re
-    return [[re.sub(r"col#\d+", "col#?", cell) if isinstance(cell, str)
-             else cell for cell in r] for r in rows]
+    out = []
+    for r in rows:
+        cells = [re.sub(r"col#\d+", "col#?", c) if isinstance(c, str)
+                 else c for c in r]
+        cells[1] = "?" if cells[1] else ""  # estRows value is stats-driven
+        out.append(cells)
+    return out
 
 
 def test_planners_agree_on_results(tk):
@@ -76,7 +82,7 @@ def test_cascades_pushes_selection_to_access_path(tk):
         ops = [r[0].strip() for r in rows]
         assert any(o.startswith("IndexReader") for o in ops), rows
         rows = tk.query("explain select a from t where a = 5").rows
-        info = " ".join(r[2] for r in rows)
+        info = " ".join(r[3] for r in rows)
         assert "ranges:1 range" in info, rows
     finally:
         tk.execute("set @@tidb_enable_cascades_planner = 0")

@@ -38,7 +38,7 @@ def test_agg_pushdown_in_plan(tk):
     rows = tk.query("explain select b, sum(c), count(*) from t "
                     "where a > 10 group by b").rows
     reader = [r for r in rows if "TableReader" in r[0]][0]
-    assert "cop_agg" in reader[2], rows
+    assert "cop_agg" in reader[3], rows
 
 
 def test_agg_over_regions_matches_single_region(tk):
@@ -68,7 +68,7 @@ def test_scan_over_regions(tk):
 def test_topn_pushdown(tk):
     rows = tk.query("explain select a from t order by c desc limit 3").rows
     reader = [r for r in rows if "TableReader" in r[0]]
-    assert reader and "cop_topn" in reader[0][2], rows
+    assert reader and "cop_topn" in reader[0][3], rows
     _split(tk)
     got = tk.query("select a from t order by c desc limit 3").rows
     assert got == [[200], [199], [198]]
@@ -77,7 +77,7 @@ def test_topn_pushdown(tk):
 def test_limit_pushdown(tk):
     rows = tk.query("explain select a from t limit 5").rows
     reader = [r for r in rows if "TableReader" in r[0]]
-    assert reader and "cop_limit" in reader[0][2], rows
+    assert reader and "cop_limit" in reader[0][3], rows
     _split(tk)
     assert len(tk.query("select a from t limit 5").rows) == 5
 

@@ -178,13 +178,16 @@ def build_reader(ds: LogicalDataSource, stats,
         scan.ranges = path.ranges  # None = full scan
         scan.filters = _bind(path.remaining, ds.schema)
         scan.stats_row_count = path.est_rows
+        scan.has_estimate = True
         reader = PhysicalTableReader(scan)
         reader.stats_row_count = path.est_rows
+        reader.has_estimate = True
         return reader
 
     iscan = PhysicalIndexScan(ds.table_info, path.index, ds.db_name,
                               ds.alias, ds.schema, path.ranges)
     iscan.stats_row_count = path.est_rows
+    iscan.has_estimate = True
     if path.covering:
         # output plan: ds.schema columns sourced from index values / handle
         pk = ds.table_info.get_pk_handle_col()
@@ -199,6 +202,7 @@ def build_reader(ds: LogicalDataSource, stats,
         iscan.filters = _bind(path.remaining, ds.schema)
         reader = PhysicalIndexReader(iscan)
         reader.stats_row_count = path.est_rows
+        reader.has_estimate = True
         return reader
 
     tscan = PhysicalTableScan(ds.table_info, ds.db_name, ds.alias,
@@ -206,4 +210,5 @@ def build_reader(ds: LogicalDataSource, stats,
     tscan.filters = _bind(path.remaining, ds.schema)
     reader = PhysicalIndexLookUpReader(iscan, tscan)
     reader.stats_row_count = path.est_rows
+    reader.has_estimate = True
     return reader

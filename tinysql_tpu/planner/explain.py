@@ -73,6 +73,18 @@ def _task(p: PhysicalPlan) -> str:
     return "root"
 
 
+def _est_rows(p: PhysicalPlan) -> str:
+    """Row estimate column (reference explain format: id, estRows, task,
+    operator info); blank ONLY when the node carries no estimate at all —
+    a genuine 0-row estimate renders 0.00 like the reference."""
+    r = getattr(p, "stats_row_count", None)
+    if r is None or (r == 0.0 and not getattr(p, "has_estimate", False)):
+        # nodes never costed leave stats_row_count at the 0.0 default;
+        # costed nodes mark has_estimate so real zeros still render
+        return ""
+    return f"{r:.2f}"
+
+
 def explain_text(p: PhysicalPlan, depth: int = 0,
                  out: List[list] = None) -> List[list]:
     if out is None:
@@ -80,10 +92,11 @@ def explain_text(p: PhysicalPlan, depth: int = 0,
     name = p.op_name()
     if getattr(p, "use_tpu", False):
         name += "(TPU)"
-    out.append(["  " * depth + name, _task(p), _info(p)])
+    out.append(["  " * depth + name, _est_rows(p), _task(p), _info(p)])
     children = list(p.children)
     if isinstance(p, PhysicalTableReader):
-        out.append(["  " * (depth + 1) + "TableScan", "cop",
+        out.append(["  " * (depth + 1) + "TableScan",
+                    _est_rows(p.scan) or _est_rows(p), "cop",
                     f"table:{p.scan.alias}"])
     for c in children:
         explain_text(c, depth + 1, out)

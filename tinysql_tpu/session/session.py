@@ -473,7 +473,7 @@ class Session:
                               bool(self.get_sysvar("tidb_use_tpu")))
         from ..planner.explain import explain_text
         rows = explain_text(phys)
-        return ResultSet(["id", "task", "operator info"], rows)
+        return ResultSet(["id", "estRows", "task", "operator info"], rows)
 
     # ---- ANALYZE (stats phase wires this up) ----------------------------
     def _exec_analyze(self, stmt: ast.AnalyzeTableStmt) -> None:
