@@ -18,28 +18,18 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def _ensure_live_backend(probe_timeout=180):
-    """The runner's axon sitecustomize pins jax_platforms='axon,cpu' and the
-    first backend touch blocks on the TPU tunnel; if the tunnel is down it
-    hangs forever.  Probe backend init in a subprocess with a timeout and
-    fall back to CPU so the bench always produces its JSON line."""
-    import subprocess
+def _ensure_live_backend():
+    """Backend liveness now lives at engine level (ops/kernels
+    ensure_live_backend, honoring TINYSQL_BACKEND_PROBE_TIMEOUT); the
+    bench just triggers it eagerly and reports the resolved backend."""
+    from tinysql_tpu.ops import kernels
+    kernels.ensure_live_backend()
     try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d=jax.devices(); print(d[0].platform)"],
-            capture_output=True, text=True, timeout=probe_timeout)
-        ok = r.returncode == 0
-        plat = (r.stdout or "").strip().splitlines()[-1] if ok and r.stdout else ""
-    except subprocess.TimeoutExpired:
-        ok, plat = False, ""
-    if not ok:
-        print("[bench] WARNING: default jax backend unreachable "
-              "(TPU tunnel down?) — falling back to CPU", file=sys.stderr)
         import jax
-        jax.config.update("jax_platforms", "cpu")
-        plat = "cpu"
-    print(f"[bench] jax backend: {plat or 'default'}", file=sys.stderr)
+        plat = jax.devices()[0].platform
+    except Exception:
+        plat = "unknown"
+    print(f"[bench] jax backend: {plat}", file=sys.stderr)
 
 
 def main():

@@ -109,6 +109,11 @@ def test_decode_bytes_malformed():
     bad[-1] = 0x10  # corrupt marker
     with pytest.raises(ValueError):
         keycodec.decode_one(bytes(bad), 0)
+    # non-zero padding bytes: rejected (parity with native mc_decode_bytes)
+    bad = bytearray(enc1(b"abc"))
+    bad[-2] = 0x01  # last pad byte of the group
+    with pytest.raises(ValueError):
+        keycodec.decode_one(bytes(bad), 0)
 
 
 def test_rowcodec_wraps_like_column():

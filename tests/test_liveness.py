@@ -1,0 +1,49 @@
+"""Engine-level backend liveness (VERDICT r1 #7): a dead TPU tunnel —
+simulated by a probe command that hangs — must never hang embedded session
+creation or first query; the engine pins cpu after a probed timeout.
+
+Runs in a subprocess because the test process already resolved its JAX
+platform (conftest pins cpu), and the liveness logic is strictly
+first-touch-per-process.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, %(repo)r)
+os.environ.pop("JAX_PLATFORMS", None)
+# a probe that hangs simulates the dead tunnel; 2s budget keeps CI fast
+os.environ["TINYSQL_BACKEND_PROBE_CMD"] = "import time; time.sleep(600)"
+os.environ["TINYSQL_BACKEND_PROBE_TIMEOUT"] = "2"
+os.environ["TINYSQL_BACKEND_PROBE_TTL"] = "0"   # ignore any success sentinel
+import tempfile
+os.environ["TINYSQL_JAX_CACHE"] = tempfile.mkdtemp()
+import jax
+# simulate the sitecustomize pin: a device-first platform chain in CONFIG
+# (which overrides any later env var) — first backend touch would block
+jax.config.update("jax_platforms", "tpu,cpu")
+from tinysql_tpu.session import new_session
+s = new_session()
+s.execute("create database d")
+s.execute("use d")
+s.execute("create table t (a int)")
+s.execute("insert into t values (1), (2)")
+s.execute("set @@tidb_use_tpu = 1")   # force the device tier
+print("RESULT", s.query("select sum(a) from t").rows)
+print("PLAT", jax.devices()[0].platform)
+"""
+
+
+def test_session_survives_hanging_backend():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run(
+        [sys.executable, "-c", _SCRIPT % {"repo": REPO}],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "RESULT [[3]]" in r.stdout, r.stdout
+    assert "PLAT cpu" in r.stdout, r.stdout

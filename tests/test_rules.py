@@ -109,6 +109,16 @@ def test_merge_join_on_pk_keys(tk):
     # non-pk keys keep hash join
     ops = _ops(tk, "select t.a from t join u on t.b = u.k")
     assert any("HashJoin" in o for o in ops), ops
+    # merge LEFT join with an ON-clause outer-side condition: failing
+    # outer rows null-extend (same semantics as the hash path)
+    q = ("select p.id, q.w from p left join q "
+         "on p.v > 250 and p.id = q.id order by p.id")
+    ops = _ops(tk, q)
+    assert any("MergeJoin" in o for o in ops), ops
+    got = tk.query(q).rows
+    want = [[i, (f"q{i}" if i * 10 > 250 and i >= 10 else None)]
+            for i in range(1, 31)]
+    assert got == want
 
 
 def test_join_reorder_three_tables(tk):

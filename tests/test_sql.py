@@ -111,6 +111,30 @@ def test_joins(tk):
         rows("10 100"))
 
 
+def test_left_join_on_outer_side_condition(tk):
+    # ON-clause conditions on the OUTER side decide matching, not row
+    # survival: a failing outer row must null-extend, never disappear
+    # (reference: rule_predicate_push_down.go LeftOuterJoin + joiner
+    # onMissMatch).
+    tk.must_exec("create table l (id int primary key, a int)")
+    tk.must_exec("create table r (id int primary key, s varchar(5))")
+    tk.must_exec("insert into l values (1, 3), (2, 7), (3, 9)")
+    tk.must_exec("insert into r values (1, 'one'), (2, 'two')")
+    tk.must_query(
+        "select l.id, l.a, r.s from l left join r on l.a > 5 and l.id = r.id "
+        "order by l.id").check(
+        rows("1 3 <nil>", "2 7 two", "3 9 <nil>"))
+    # same conds in WHERE: now they DO filter output rows
+    tk.must_query(
+        "select l.id, r.s from l left join r on l.id = r.id "
+        "where l.a > 5 order by l.id").check(
+        rows("2 two", "3 <nil>"))
+    # inner join: ON left-side conds filter (unchanged semantics)
+    tk.must_query(
+        "select l.id, r.s from l join r on l.a > 5 and l.id = r.id").check(
+        rows("2 two"))
+
+
 def test_join_null_keys_never_match(tk):
     tk.must_exec("create table a (k int)")
     tk.must_exec("create table b (k int)")
@@ -318,3 +342,19 @@ def test_eager_duplicate_detection_and_stmt_rollback(tk):
     tk.must_query("select a, b from t order by a").check(rows("1 2", "2 4"))
     tk.must_exec("commit")
     tk.must_query("select a, b from t order by a").check(rows("1 2", "2 4"))
+
+
+def test_autocommit0_first_stmt_atomicity(tk):
+    # regression: under autocommit=0 the FIRST statement lazily creates the
+    # implicit txn; if it fails mid-way its partial writes must not survive
+    # to a later COMMIT (MySQL persists nothing here)
+    tk.must_exec("create table t (a int primary key)")
+    tk.must_exec("set autocommit = 0")
+    assert "Duplicate" in str(tk.exec_err("insert into t values (1), (1)"))
+    tk.must_exec("commit")
+    assert tk.must_query("select a from t").as_str() == []
+    # and the session keeps working normally afterwards
+    tk.must_exec("insert into t values (2)")
+    tk.must_exec("commit")
+    tk.must_query("select a from t").check(rows("2"))
+    tk.must_exec("set autocommit = 1")

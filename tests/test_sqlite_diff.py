@@ -105,6 +105,12 @@ class _Gen:
         if shape < 0.92:  # join
             cond = r.choice(["t.b = u.k", "t.a = u.k"])
             jt = r.choice(["join", "left join"])
+            # one-side ON conjuncts: for LEFT JOIN an outer-side cond
+            # decides matching (failing rows null-extend, never drop)
+            if r.random() < 0.4:
+                cond += " and " + r.choice(
+                    ["t.b > 1", "t.a < 3", "u.k > 0", "u.v < 'v3'",
+                     "t.c is not null"])
             return (f"select t.a, u.v from t {jt} u on {cond}{where} "
                     f"order by t.a, u.v")
         # aggregate over a join

@@ -116,6 +116,10 @@ def test_joins_inner_outer(tks):
               "on facts.g = dims.g and facts.v > dims.w")
     both(tks, "select facts.id, dims.w from facts left join dims "
               "on facts.g = dims.g and facts.v > dims.w")
+    # ON-clause condition on the OUTER side only: failing outer rows
+    # null-extend instead of dropping
+    both(tks, "select facts.id, dims.label from facts left join dims "
+              "on facts.g = dims.g and facts.v > 50")
 
 
 def test_sort_and_topn(tks):

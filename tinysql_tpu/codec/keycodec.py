@@ -93,6 +93,10 @@ def decode_bytes(buf: bytes, pos: int) -> Tuple[bytes, int]:
         if pad == 0:
             data += group
         elif 0 < pad <= _GROUP:
+            if any(group[_GROUP - pad:]):
+                # native mc_decode_bytes rejects non-zero padding; corrupt
+                # keys must decode identically with or without the library
+                raise ValueError("corrupt bytes encoding: non-zero padding")
             data += group[:_GROUP - pad]
             break
         else:

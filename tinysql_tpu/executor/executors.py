@@ -767,10 +767,16 @@ class HashJoinExec(Executor):
             if chk is None:
                 break
             chk = chk.compact()
+            lmask = None
             if plan.left_conditions:
                 mask = vectorized_filter(plan.left_conditions, chk)
-                chk.set_sel(np.nonzero(mask)[0])
-                chk = chk.compact()
+                if plan.tp == "left":
+                    # outer join: ON-clause left conds decide matching —
+                    # a failing outer row null-extends instead of dropping
+                    lmask = mask
+                else:
+                    chk.set_sel(np.nonzero(mask)[0])
+                    chk = chk.compact()
             if self._ht is not None:
                 v, null = plan.left_keys[0].vec_eval(chk)
                 ids, counts = self._ht.probe(
@@ -781,6 +787,9 @@ class HashJoinExec(Executor):
                         for e in plan.left_keys]
             for i in range(chk.num_rows()):
                 lrow = chk.get_row(i)
+                if lmask is not None and not lmask[i]:
+                    out.append_row(lrow + [None] * self._n_right)
+                    continue
                 if self._ht is not None:
                     matches = ids[offsets[i]:offsets[i + 1]]
                 else:
@@ -839,12 +848,17 @@ class _RowCursor:
     join key's semantic value per row; `side_conds` filter each chunk
     before it is exposed (the join's one-side conditions)."""
 
-    def __init__(self, ex: Executor, key_expr, side_conds=None):
+    def __init__(self, ex: Executor, key_expr, side_conds=None,
+                 mask_mode: bool = False):
         self.ex = ex
         self.key_expr = key_expr
         self.side_conds = side_conds or []
+        # mask_mode (outer side of an outer join): failing rows stay in the
+        # stream with passes()==False so the join can null-extend them
+        self.mask_mode = mask_mode
         self._chk = None
         self._keys = None
+        self._mask = None
         self._i = 0
         self.done = False
         self._advance_chunk()
@@ -856,10 +870,14 @@ class _RowCursor:
                 self.done = True
                 return
             chk = chk.compact()
+            self._mask = None
             if self.side_conds and chk.num_rows():
                 mask = vectorized_filter(self.side_conds, chk)
-                chk.set_sel(np.nonzero(mask)[0])
-                chk = chk.compact()
+                if self.mask_mode:
+                    self._mask = mask
+                else:
+                    chk.set_sel(np.nonzero(mask)[0])
+                    chk = chk.compact()
             if chk.num_rows() == 0:
                 continue
             self._chk = chk
@@ -869,6 +887,9 @@ class _RowCursor:
 
     def key(self):
         return self._keys[self._i]
+
+    def passes(self) -> bool:
+        return self._mask is None or bool(self._mask[self._i])
 
     def row(self):
         return self._chk.get_row(self._i)
@@ -902,7 +923,8 @@ class MergeJoinExec(Executor):
         plan = self.plan
         if self._lcur is None:
             self._lcur = _RowCursor(self.children[0], plan.left_keys[0],
-                                    plan.left_conditions)
+                                    plan.left_conditions,
+                                    mask_mode=(plan.tp == "left"))
             self._rcur = _RowCursor(self.children[1], plan.right_keys[0],
                                     plan.right_conditions)
             self._n_right = len(self.children[1].schema.columns)
@@ -913,6 +935,11 @@ class MergeJoinExec(Executor):
         lcur, rcur = self._lcur, self._rcur
         while not lcur.done and out.num_rows() < out_limit:
             lk = lcur.key()
+            if not lcur.passes():
+                # ON-clause outer-side cond failed: null-extend (left join)
+                out.append_row(lcur.row() + [None] * self._n_right)
+                lcur.advance()
+                continue
             if lk is None:  # NULL keys never equi-match
                 if plan.tp == "left":
                     out.append_row(lcur.row() + [None] * self._n_right)

@@ -728,10 +728,30 @@ class TPUHashJoinExec(Executor):
             return None
         self._done = True
         plan = self.plan
-        lchk, lmask, lrep = self._side_input(0, plan.left_conditions)
+        outer = plan.tp == "left"
+        # Outer join: ON-clause left conds decide MATCHING (failing outer
+        # rows null-extend), so they must NOT fold into lvalid (the kernel
+        # drops invalid rows).  Instead poison the key null-mask: a NULL
+        # key matches nothing, and the outer path emits unmatched valid
+        # rows once with right index -1.
+        on_left = plan.left_conditions if outer else []
+        lchk, lmask, lrep = self._side_input(
+            0, [] if on_left else plan.left_conditions)
         rchk, rmask, rrep = self._side_input(1, plan.right_conditions)
         lk, lnull = self._key_arrays(plan.left_keys[0], lchk, lrep, 0)
         rk, rnull = self._key_arrays(plan.right_keys[0], rchk, rrep, 1)
+        if on_left:
+            on_mask = vectorized_filter(on_left, lchk)
+            # poison only the NULL mask (values may stay replica-memoized
+            # on device); a padded device mask re-lands on host, padding
+            # rows are already null=True
+            lnull = np.asarray(lnull)
+            if lnull.shape[0] != on_mask.shape[0]:
+                fail = np.zeros(lnull.shape[0], dtype=bool)
+                fail[:on_mask.shape[0]] = ~on_mask
+                lnull = lnull | fail
+            else:
+                lnull = lnull | ~on_mask
         if lk.dtype != rk.dtype:
             lk = np.asarray(lk).astype(np.float64)
             rk = np.asarray(rk).astype(np.float64)

@@ -75,18 +75,12 @@ def bootstrap(storage) -> None:
 
 
 def _honor_jax_platforms_env() -> None:
-    """An explicit JAX_PLATFORMS env var wins over any platform the runner
-    image's sitecustomize pinned in jax config (it sets "axon,cpu", which
-    routes first backend use to the TPU tunnel even when the operator asked
-    for cpu)."""
-    import os
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        try:
-            import jax
-            jax.config.update("jax_platforms", want)
-        except Exception:
-            pass
+    """Resolve the JAX platform at server startup: explicit JAX_PLATFORMS
+    env wins over the sitecustomize-pinned config, and an unreachable
+    device backend (dead TPU tunnel) pins cpu after a probed timeout —
+    shared logic in ops/kernels.ensure_live_backend."""
+    from .ops.kernels import ensure_live_backend
+    ensure_live_backend()
 
 
 def main(argv=None) -> int:

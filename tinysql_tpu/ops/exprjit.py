@@ -27,10 +27,8 @@ _jnp = None
 def jnp():
     global _jnp
     if _jnp is None:
-        import jax
-        jax.config.update("jax_enable_x64", True)
-        import jax.numpy as jnp_mod
-        _jnp = jnp_mod
+        from . import kernels
+        _jnp = kernels.jnp()  # shares x64 + backend-liveness handling
     return _jnp
 
 

@@ -152,12 +152,19 @@ class PushSelDownJoin(Transformation):
         new_join = copy.copy(join)
         new_join.eq_conditions = list(join.eq_conditions) + new_eq
         new_join.other_conditions = list(join.other_conditions) + other
-        # the join's own one-side ON conditions push down WITH the
-        # selection's (seeding them is what keeps semantics — they must
-        # not be dropped from the transformed join)
-        left_push = list(join.left_conditions) + lp
+        # inner join: the join's own one-side ON conditions push down WITH
+        # the selection's.  Outer join: ON-clause outer-side conditions
+        # must STAY on the join (they decide matching; a failing outer row
+        # null-extends instead of being filtered) — only WHERE-side conds
+        # (lp) push below the outer child.
+        from ..logical import JOIN_INNER as _INNER
+        if join.tp == _INNER:
+            left_push = list(join.left_conditions) + lp
+            new_join.left_conditions = []
+        else:
+            left_push = lp
+            new_join.left_conditions = list(join.left_conditions)
         right_push = list(join.right_conditions) + rp
-        new_join.left_conditions = []
         new_join.right_conditions = []
         if not (left_push or right_push or new_eq):
             return False

@@ -62,9 +62,19 @@ def predicate_pushdown(p: LogicalPlan,
             conds, lsch, rsch, p.tp)
         p.eq_conditions.extend(new_eq)
         p.other_conditions.extend(other)
-        left_push = list(p.left_conditions) + lp
+        if p.tp == JOIN_INNER:
+            left_push = list(p.left_conditions) + lp
+            p.left_conditions = []
+        else:
+            # Outer join: ON-clause outer-side conditions stay attached to
+            # the join — they decide MATCHING, not row survival; a failing
+            # outer row must null-extend, not disappear (reference:
+            # rule_predicate_push_down.go LeftOuterJoin keeps LeftConditions
+            # on the join and the joiner null-extends on miss).  WHERE-side
+            # conds (lp) still push below the outer child.
+            left_push = lp
         right_push = list(p.right_conditions) + rp
-        p.left_conditions, p.right_conditions = [], []
+        p.right_conditions = []
         r1, lc = predicate_pushdown(p.children[0], left_push)
         r2, rc = predicate_pushdown(p.children[1], right_push)
         p.children[0] = LogicalSelection(r1, lc) if r1 else lc

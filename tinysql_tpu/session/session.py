@@ -225,6 +225,12 @@ class Session:
         except Exception:
             if cp is not None and self._txn is not None:
                 self._txn.restore(cp)
+            elif in_txn_scope and self._txn is not None:
+                # the failed statement itself lazily created the implicit
+                # txn (cp is None), so its partial writes are the txn's
+                # ONLY writes — roll the txn back, else a later COMMIT
+                # would persist them (statement atomicity)
+                self.rollback_txn()
             else:
                 self._finish_stmt(ok=False)
             raise
