@@ -23,7 +23,7 @@ def _ensure_live_backend():
     ensure_live_backend, honoring TINYSQL_BACKEND_PROBE_TIMEOUT); the
     bench just triggers it eagerly and reports the resolved backend."""
     from tinysql_tpu.ops import kernels
-    kernels.ensure_live_backend()
+    kernels.ensure_live_backend(force=True)  # bench must always emit JSON
     try:
         import jax
         plat = jax.devices()[0].platform

@@ -1,0 +1,96 @@
+"""estRows threading + cost-based device placement (VERDICT r1 #3).
+
+Every physical operator carries a row estimate (reference: stats.go
+DeriveStats + explain.go four-column format); live commit-time count
+deltas make estimates real WITHOUT ANALYZE (reference: mysql.stats_meta);
+and the device enforcer gates the TPU tier on estimated input rows so a
+tiny table never pays an XLA compile (tidb_tpu_min_rows).
+"""
+import pytest
+
+from tinysql_tpu.utils.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    t = TestKit()
+    t.must_exec("create database test")
+    t.must_exec("use test")
+    t.must_exec("create table t (a int primary key, b int, c varchar(8))")
+    t.must_exec("insert into t values " + ", ".join(
+        f"({i}, {i % 5}, 'x{i % 3}')" for i in range(1, 21)))
+    return t
+
+
+def _explain(tk, q):
+    return tk.must_query("explain " + q).as_str()
+
+
+def test_every_operator_has_estrows(tk):
+    queries = [
+        "select b, count(*), sum(a) from t where a > 3 group by b "
+        "order by b limit 3",
+        "select p.a, q.b from t p join t q on p.a = q.a where q.b > 1",
+        "select * from t where b = 2",
+        "select a + b from t order by b desc",
+    ]
+    for q in queries:
+        for row in _explain(tk, q):
+            assert row[1] != "", f"missing estRows in {row!r} for {q!r}"
+            float(row[1])  # renders as a number
+
+
+def test_live_counts_without_analyze(tk):
+    # 20 rows inserted, never analyzed: the scan estimate is the real
+    # count, maintained by commit-time deltas
+    rows = _explain(tk, "select * from t")
+    scan = [r for r in rows if r[0].strip().startswith("TableScan")][0]
+    assert scan[1] == "20.00", rows
+    tk.must_exec("delete from t where a <= 5")
+    rows = _explain(tk, "select * from t")
+    scan = [r for r in rows if r[0].strip().startswith("TableScan")][0]
+    assert scan[1] == "15.00", rows
+
+
+def test_stats_forgotten_on_truncate(tk):
+    tk.must_exec("truncate table t")
+    rows = _explain(tk, "select * from t")
+    scan = [r for r in rows if r[0].strip().startswith("TableScan")][0]
+    assert scan[1] == "10000.00", rows  # back to the pseudo default
+    # and counts start accumulating again on the fresh table id
+    tk.must_exec("insert into t values (1, 1, 'x')")
+    rows = _explain(tk, "select * from t")
+    scan = [r for r in rows if r[0].strip().startswith("TableScan")][0]
+    assert scan[1] == "1.00", rows
+
+
+def test_tpu_gate_on_estimated_rows(tk):
+    # default tidb_tpu_min_rows (8192): a 20-row table stays on CPU
+    for q in ("select c, count(*) from t group by c",
+              "select p.a from t p join t q on p.a = q.a",
+              "select a from t order by b"):
+        plan = " ".join(r[0] for r in _explain(tk, q))
+        assert "(TPU)" not in plan, (q, plan)
+    # gate off: the same plans use the device tier
+    tk.must_exec("set @@tidb_tpu_min_rows = 0")
+    for q, op in (("select c, count(*) from t group by c", "HashAgg(TPU)"),
+                  ("select p.a from t p join t q on p.b = q.b",
+                   "HashJoin(TPU)")):
+        plan = " ".join(r[0] for r in _explain(tk, q))
+        assert op in plan, (q, plan)
+    # cascades framework honors the same gate
+    tk.must_exec("set @@tidb_tpu_min_rows = 100000")
+    tk.must_exec("set @@tidb_enable_cascades_planner = 1")
+    plan = " ".join(r[0] for r in
+                    _explain(tk, "select c, count(*) from t group by c"))
+    assert "(TPU)" not in plan, plan
+    tk.must_exec("set @@tidb_enable_cascades_planner = 0")
+
+
+def test_statement_rollback_keeps_counts_exact(tk):
+    # a failed statement's delta must not leak into the live count
+    err = tk.exec_err("insert into t values (21, 0, 'y'), (1, 0, 'dup')")
+    assert "Duplicate" in str(err)
+    rows = _explain(tk, "select * from t")
+    scan = [r for r in rows if r[0].strip().startswith("TableScan")][0]
+    assert scan[1] == "20.00", rows

@@ -33,6 +33,7 @@ s.execute("use d")
 s.execute("create table t (a int)")
 s.execute("insert into t values (1), (2)")
 s.execute("set @@tidb_use_tpu = 1")   # force the device tier
+s.execute("set @@tidb_tpu_min_rows = 0")
 print("RESULT", s.query("select sum(a) from t").rows)
 print("PLAT", jax.devices()[0].platform)
 """

@@ -16,6 +16,7 @@ pytestmark = pytest.mark.skipif(len(jax.devices()) < 2,
 def tk():
     s = new_session()
     s.execute("create database test")
+    s.execute("set @@tidb_tpu_min_rows = 0")
     s.execute("use test")
     s.execute("create table t (a int primary key, b int, c varchar(8), "
               "d double)")

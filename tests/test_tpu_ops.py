@@ -19,6 +19,7 @@ def tks():
     tpu = TestKit(storage)
     tpu.must_exec("create database test")
     tpu.must_exec("use test")
+    tpu.must_exec("set @@tidb_tpu_min_rows = 0")  # tiny CI data on device
     cpu = TestKit(storage, "test")
     cpu.must_exec("set @@tidb_use_tpu = 0")
 

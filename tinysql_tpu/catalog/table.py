@@ -144,6 +144,7 @@ class Table:
         for idx in self.indices:
             if idx.info.state >= SchemaState.WRITE_ONLY:
                 idx.create(txn, row, handle)
+        self._bump(txn, +1)
         return handle
 
     def remove_record(self, txn, handle: int, row: List[Datum]) -> None:
@@ -151,6 +152,13 @@ class Table:
         for idx in self.indices:
             if idx.info.state >= SchemaState.DELETE_ONLY:
                 idx.delete(txn, row, handle)
+        self._bump(txn, -1)
+
+    def _bump(self, txn, d: int) -> None:
+        """Net row-count delta, applied to live stats at commit."""
+        sd = getattr(txn, "stats_delta", None)
+        if sd is not None:
+            sd[self.info.id] = sd.get(self.info.id, 0) + d
 
     def update_record(self, txn, handle: int, old_row: List[Datum],
                       new_row: List[Datum]) -> None:

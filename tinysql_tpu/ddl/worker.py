@@ -167,6 +167,8 @@ class DDLWorker:
         for k, _ in list(txn.iter_range(lo, hi)):
             txn.delete(k)
         txn.commit()
+        from ..statistics.table_stats import drop_stats
+        drop_stats(self.storage, t.id)
 
     # ---- columns (reference: ddl/column.go; course stub :216) ----------
     def _on_add_column(self, m: Meta, job: Job) -> bool:

@@ -376,6 +376,10 @@ class Transaction:
         self.valid = True
         self.schema_check: Optional[Callable[[int], None]] = None
         self.commit_ts = 0
+        # table_id -> net row delta this txn; applied to the live stats
+        # count at commit (reference: mysql.stats_meta modify/count deltas
+        # flushed by the session stats collector)
+        self.stats_delta: Dict[int, int] = {}
 
     # -- reads ------------------------------------------------------------
     def get(self, key: bytes) -> bytes:
@@ -432,10 +436,11 @@ class Transaction:
     # StmtRollback over the membuffer) ------------------------------------
     def checkpoint(self) -> tuple:
         return (dict(self.us.buffer._m), set(self.presume_not_exists),
-                dict(self.dup_info))
+                dict(self.dup_info), dict(self.stats_delta))
 
     def restore(self, cp: tuple) -> None:
-        m, pne, dup = cp
+        m, pne, dup, sd = cp
+        self.stats_delta = dict(sd)
         self.us.buffer._m = dict(m)
         self.us.buffer._dirty = True
         self.presume_not_exists = set(pne)

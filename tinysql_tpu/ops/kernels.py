@@ -25,7 +25,8 @@ _jax = None
 _probed = False
 
 
-def ensure_live_backend(jax_mod=None, timeout: float = None) -> None:
+def ensure_live_backend(jax_mod=None, timeout: float = None,
+                        force: bool = False) -> None:
     """First-touch backend liveness, at ENGINE level (not just bench.py):
     the runner image's sitecustomize pins jax_platforms="axon,cpu" in
     config — overriding a later JAX_PLATFORMS env var — and the first
@@ -82,9 +83,16 @@ def ensure_live_backend(jax_mod=None, timeout: float = None) -> None:
         except OSError:
             return False
 
-    if _fresh(sentinel, ttl):
+    # NOTE: a fresh success sentinel means hang exposure is bounded by the
+    # TTL window, not zero — callers that must NEVER block on a backend
+    # that died since the last probe (bench.py emitting its JSON line)
+    # pass force=True to re-probe unconditionally.
+    if not force and _fresh(sentinel, ttl):
         return
-    if _fresh(fail_sentinel, fail_ttl):
+    if not force and _fresh(fail_sentinel, fail_ttl):
+        logging.getLogger("tinysql_tpu").warning(
+            "jax backend %r recently probed unreachable (cached failure, "
+            "TTL %ss) — pinning jax_platforms=cpu", effective, fail_ttl)
         try:
             jax_mod.config.update("jax_platforms", "cpu")
         except Exception:

@@ -52,7 +52,12 @@ def _schema_col(ds: LogicalDataSource, name: str) -> Optional[Column]:
 def choose_path(ds: LogicalDataSource, stats) -> AccessPath:
     """Enumerate paths, skyline-prune, pick min cost."""
     conds = list(ds.pushed_conds)
-    total = float(stats.row_count) if stats and stats.row_count else PSEUDO_ROWS
+    # live commit-time count deltas make row_count real even without
+    # ANALYZE (stats_meta analogue); only a table we know NOTHING about
+    # falls back to the pseudo default
+    known = stats is not None and (stats.row_count > 0 or stats.columns
+                                   or stats.modify_count > 0)
+    total = float(max(stats.row_count, 1)) if known else PSEUDO_ROWS
 
     paths: List[AccessPath] = []
 
@@ -105,6 +110,17 @@ def _sel(stats, access_conds: List[Expression], fallback: float) -> float:
     if stats is not None and not stats.pseudo:
         return stats.selectivity(access_conds)
     return fallback
+
+
+def _residual_sel(stats, remaining: List[Expression]) -> float:
+    """Selectivity of the NON-access filters applied inside the scan: the
+    reader's OUTPUT estimate is access-rows x this (reference: the cop
+    Selection's own stats row)."""
+    if not remaining:
+        return 1.0
+    if stats is not None and not stats.pseudo:
+        return stats.selectivity(remaining)
+    return 0.8 ** len(remaining)  # selectionFactor per conjunct
 
 
 def _handle_heuristic(hranges, total: float) -> float:
@@ -180,7 +196,8 @@ def build_reader(ds: LogicalDataSource, stats,
         scan.stats_row_count = path.est_rows
         scan.has_estimate = True
         reader = PhysicalTableReader(scan)
-        reader.stats_row_count = path.est_rows
+        reader.stats_row_count = path.est_rows * _residual_sel(
+            stats, path.remaining)
         reader.has_estimate = True
         return reader
 
@@ -201,14 +218,18 @@ def build_reader(ds: LogicalDataSource, stats,
         iscan.output_sources = sources
         iscan.filters = _bind(path.remaining, ds.schema)
         reader = PhysicalIndexReader(iscan)
-        reader.stats_row_count = path.est_rows
+        reader.stats_row_count = path.est_rows * _residual_sel(
+            stats, path.remaining)
         reader.has_estimate = True
         return reader
 
     tscan = PhysicalTableScan(ds.table_info, ds.db_name, ds.alias,
                               ds.schema, with_handle)
     tscan.filters = _bind(path.remaining, ds.schema)
+    tscan.stats_row_count = path.est_rows * _residual_sel(
+        stats, path.remaining)
+    tscan.has_estimate = True
     reader = PhysicalIndexLookUpReader(iscan, tscan)
-    reader.stats_row_count = path.est_rows
+    reader.stats_row_count = tscan.stats_row_count
     reader.has_estimate = True
     return reader

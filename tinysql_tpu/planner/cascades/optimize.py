@@ -114,12 +114,14 @@ def _expr_cost(ge: GroupExpr, childs) -> Tuple[float, float]:
     return ccost + crows, crows
 
 
-def find_best_plan(logical: LogicalPlan, tpu: bool = True):
+def find_best_plan(logical: LogicalPlan, tpu: bool = True,
+                   tpu_min_rows: float = 0.0):
     """Full cascades pipeline: pre-normalization -> memo -> explore ->
     implement -> shared physical tail (reference: Optimize/FindBestPlan
     optimize.go:105; the pre-passes mirror the System-R rewrites whose
     effects the transformation rule set does not replicate)."""
     from ..optimizer import normalize_logical, to_physical
+    from ..derive_stats import derive_stats
     from ..device import place_devices
     from ..cop import push_to_cop
     logical = normalize_logical(logical, push_predicates=False)
@@ -128,5 +130,6 @@ def find_best_plan(logical: LogicalPlan, tpu: bool = True):
     explore(memo, root)
     _, _, tree = implement(root)
     phys = to_physical(tree)
-    phys = place_devices(phys, enabled=tpu)
+    phys = derive_stats(phys)
+    phys = place_devices(phys, enabled=tpu, min_rows=tpu_min_rows)
     return push_to_cop(phys)

@@ -50,13 +50,27 @@ def _agg_ok(d) -> bool:
     return all(is_jittable(a) for a in d.args)
 
 
-def place_devices(p: PhysicalPlan, enabled: bool = True) -> PhysicalPlan:
+def _input_rows(p: PhysicalPlan) -> float:
+    """Estimated input size of the operator's hot loop (derive_stats ran
+    before placement, so children carry estimates)."""
+    if not p.children:
+        return 0.0
+    return max(c.stats_row_count for c in p.children)
+
+
+def place_devices(p: PhysicalPlan, enabled: bool = True,
+                  min_rows: float = 0.0) -> PhysicalPlan:
+    """Decide placement per operator: CAPABILITY (kernel expressible) AND
+    COST (estimated input rows >= min_rows — an XLA compile is never worth
+    it for a handful of rows; reference task.go prices the cop/root
+    boundary the same way, tidb_tpu_min_rows carries the threshold)."""
     for c in p.children:
-        place_devices(c, enabled)
+        place_devices(c, enabled, min_rows)
     if not enabled:
         return p
+    big = _input_rows(p) >= min_rows
     if isinstance(p, PhysicalHashAgg):
-        p.use_tpu = (all(_key_ok(e) for e in p.group_by)
+        p.use_tpu = (big and all(_key_ok(e) for e in p.group_by)
                      and all(_agg_ok(d) for d in p.aggs))
     elif isinstance(p, PhysicalMergeJoin):
         p.use_tpu = False  # sorted-stream operator stays on the CPU tier
@@ -64,7 +78,7 @@ def place_devices(p: PhysicalPlan, enabled: bool = True) -> PhysicalPlan:
         def _uns(e):
             return (e.eval_type is EvalType.INT
                     and getattr(e.ret_type, "is_unsigned", False))
-        p.use_tpu = (len(p.left_keys) == 1
+        p.use_tpu = (big and len(p.left_keys) == 1
                      and is_jittable(p.left_keys[0])
                      and is_jittable(p.right_keys[0])
                      # mixed-signedness int keys need per-pair compare
@@ -72,9 +86,9 @@ def place_devices(p: PhysicalPlan, enabled: bool = True) -> PhysicalPlan:
                      and _uns(p.left_keys[0]) == _uns(p.right_keys[0])
                      and p.tp in ("inner", "left"))
     elif isinstance(p, (PhysicalSort, PhysicalTopN)):
-        p.use_tpu = all(_key_ok(e) for e, _ in p.by)
+        p.use_tpu = big and all(_key_ok(e) for e, _ in p.by)
     elif isinstance(p, PhysicalProjection):
-        p.use_tpu = all(is_jittable(e) for e in p.exprs)
+        p.use_tpu = big and all(is_jittable(e) for e in p.exprs)
     elif isinstance(p, PhysicalSelection):
-        p.use_tpu = all(is_jittable(c) for c in p.conditions)
+        p.use_tpu = big and all(is_jittable(c) for c in p.conditions)
     return p

@@ -353,14 +353,18 @@ def normalize_logical(logical: LogicalPlan,
     return join_reorder(logical, stats_of=_ds_row_count)
 
 
-def optimize(logical: LogicalPlan, tpu: bool = True) -> PhysicalPlan:
+def optimize(logical: LogicalPlan, tpu: bool = True,
+             tpu_min_rows: float = 0.0) -> PhysicalPlan:
     """The System-R style pipeline (reference: planner/core/optimizer.go:77
     — the fixed-order rewrite list of optimizer.go:44-55), physical
-    conversion, then the device enforcer + coprocessor pushdown."""
+    conversion, estimate derivation, then the device enforcer (cost+
+    capability) + coprocessor pushdown."""
     logical = normalize_logical(logical)
     logical = topn_pushdown(logical)
     phys = to_physical(logical)
+    from .derive_stats import derive_stats
+    phys = derive_stats(phys)
     from .device import place_devices
-    phys = place_devices(phys, enabled=tpu)
+    phys = place_devices(phys, enabled=tpu, min_rows=tpu_min_rows)
     from .cop import push_to_cop
     return push_to_cop(phys)

@@ -40,6 +40,7 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     "tidb_distsql_scan_concurrency": 15,
     "tidb_index_lookup_concurrency": 4,
     "tidb_use_tpu": 1,           # device enforcer master switch
+    "tidb_tpu_min_rows": 8192,   # row gate: smaller inputs stay on CPU
     "tidb_enable_cascades_planner": 0,
     "tidb_mesh_parallel": 0,     # shard fused aggregates over the device mesh
     "sql_mode": "STRICT_TRANS_TABLES",
@@ -162,6 +163,12 @@ class Session:
             txn, self._txn = self._txn, None
             self._explicit_txn = False
             txn.commit()
+            # flush live row-count deltas (reference: stats collector ->
+            # mysql.stats_meta at commit); post-commit, non-transactional
+            if txn.stats_delta:
+                from ..statistics.table_stats import update_count_delta
+                for tid, d in txn.stats_delta.items():
+                    update_count_delta(self.storage, tid, d)
 
     def rollback_txn(self) -> None:
         if self._txn is not None:
@@ -300,10 +307,12 @@ class Session:
     def _optimize(self, logical, use_tpu: bool):
         """Route between the two optimizer frameworks (reference:
         planner/optimize.go:29-56 EnableCascadesPlanner switch)."""
+        min_rows = float(self.get_sysvar("tidb_tpu_min_rows") or 0)
         if bool(self.get_sysvar("tidb_enable_cascades_planner")):
             from ..planner.cascades import find_best_plan
-            return find_best_plan(logical, tpu=use_tpu)
-        return optimize(logical, tpu=use_tpu)
+            return find_best_plan(logical, tpu=use_tpu,
+                                  tpu_min_rows=min_rows)
+        return optimize(logical, tpu=use_tpu, tpu_min_rows=min_rows)
 
     def _run_select_plan(self, stmt: ast.SelectStmt, txn) -> List[list]:
         builder = PlanBuilder(self)
@@ -344,7 +353,7 @@ class Session:
             rw = ExprRewriter(plan.schema, builder)
             plan = LogicalSelection(split_cnf(rw.rewrite(stmt.where)), plan)
         use_tpu = bool(self.get_sysvar("tidb_use_tpu"))
-        phys = optimize(plan, tpu=use_tpu)
+        phys = self._optimize(plan, use_tpu)
         txn = self.get_txn()
         ex = build_executor(phys, use_tpu=use_tpu)
         ex.open(ExecContext(txn, self.sysvars, self.infoschema(),

@@ -155,6 +155,30 @@ def save_stats(storage, stats: TableStats) -> None:
     _cache_of(storage)[stats.table_id] = stats
 
 
+def update_count_delta(storage, table_id: int, delta: int) -> None:
+    """Live row-count maintenance without ANALYZE (reference:
+    mysql.stats_meta count/modify_count deltas flushed at commit by the
+    session stats collector, picked up by handle.Update) — feeds the
+    planner real table sizes so e.g. the TPU row-gate never routes a
+    3-row table to an XLA compile."""
+    if delta == 0:
+        return
+    stats = load_stats(storage, table_id)
+    if stats is None:
+        stats = TableStats(table_id)
+    stats.row_count = max(0, stats.row_count + delta)
+    stats.modify_count += abs(delta)
+    save_stats(storage, stats)
+
+
+def drop_stats(storage, table_id: int) -> None:
+    """Forget a table's stats (DROP/TRUNCATE TABLE)."""
+    txn = storage.begin()
+    txn.delete(_STATS_PREFIX + b"%08d" % table_id)
+    txn.commit()
+    _cache_of(storage).pop(table_id, None)
+
+
 def load_stats(storage, table_id: int) -> Optional[TableStats]:
     cache = _cache_of(storage)
     hit = cache.get(table_id)
