@@ -31,6 +31,12 @@ QUERIES = [
     "select t.a from t left join u on t.b = u.k order by t.a limit 4",
     "select count(*) from t join u on t.b = u.k "
     "join t t2 on t.a = t2.a",                           # 3-way reorder
+    # aggregation pushdown through join: sum() args from one side,
+    # group key from the other (rule_aggregation_push_down.go:181)
+    "select u.v, count(*), sum(t.a) from t join u on t.b = u.k "
+    "group by u.v order by u.v",
+    "select t.b, avg(t.a), max(t.c) from t join u on t.b = u.k "
+    "group by t.b order by t.b",
 ]
 
 

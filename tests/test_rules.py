@@ -121,6 +121,29 @@ def test_merge_join_on_pk_keys(tk):
     assert got == want
 
 
+def test_agg_pushdown_through_join(tk):
+    # rule_aggregation_push_down.go:181 analogue: the partial aggregation
+    # lands BELOW the join, the root aggregation turns FINAL
+    rows = tk.query("explain select u.v, count(*), sum(t.a) from t "
+                    "join u on t.b = u.k group by u.v").rows
+    ops = [r[0] for r in rows]
+    agg_depths = [len(o) - len(o.lstrip()) for o in ops if "HashAgg" in o]
+    join_depth = [len(o) - len(o.lstrip()) for o in ops if "Join" in o]
+    assert len(agg_depths) == 2, ops          # final + partial
+    assert min(agg_depths) < join_depth[0] < max(agg_depths), ops
+    # correctness vs the unpushed plan (outer joins never push)
+    got = tk.query("select u.v, count(*), sum(t.a) from t "
+                   "join u on t.b = u.k group by u.v order by u.v").rows
+    want = tk.query("select u.v, count(*), sum(t.a) from t "
+                    "left join u on t.b = u.k where u.k is not null "
+                    "group by u.v order by u.v").rows
+    assert got == want
+    # residual cross-side conditions block the push
+    rows = tk.query("explain select u.v, sum(t.a) from t "
+                    "join u on t.b = u.k and t.a > u.k group by u.v").rows
+    assert sum("HashAgg" in r[0] for r in rows) == 1, rows
+
+
 def test_join_reorder_three_tables(tk):
     # chain of inner joins reorders smallest-first but stays correct
     tk.execute("analyze table t")

@@ -125,10 +125,13 @@ def generate(sf: float = 1.0, seed: int = 7):
     return {"customer": customer, "orders": orders, "lineitem": lineitem}
 
 
-def load(session, sf: float = 1.0, seed: int = 7) -> dict:
-    """Create schemas + columnar bulk-load (returns row counts)."""
+def load(session, sf: float = 1.0, seed: int = 7, data=None) -> dict:
+    """Create schemas + columnar bulk-load (returns row counts).  Pass a
+    pre-generated `data` dict to avoid regenerating (bench shares one
+    dataset between this engine and the sqlite baseline)."""
     from ..columnar.store import bulk_load
-    data = generate(sf, seed)
+    if data is None:
+        data = generate(sf, seed)
     session.execute("create database if not exists tpch")
     session.execute("use tpch")
     counts = {}

@@ -161,6 +161,11 @@ def bulk_load(storage, info: TableInfo,
     rep = ColumnarTable(info.id, n or 0, storage.current_version(), ver,
                         cols, np.asarray(handles, dtype=np.int64))
     store_of(storage).put(rep)
+    # bulk ingest bypasses add_record, so feed the live stats count here
+    # (keeps planner estimates and the TPU row-gate truthful); absolute
+    # set — the replica REPLACES the table's contents
+    from ..statistics.table_stats import set_count
+    set_count(storage, info.id, n or 0)
     return n or 0
 
 

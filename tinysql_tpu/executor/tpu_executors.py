@@ -249,14 +249,14 @@ class TPUHashAggExec(Executor):
                 else:
                     vmin, vmax = int(nn.min()), int(nn.max())
                     card = vmax - vmin + 1
-                    if card > kernels.MAX_SEGMENTS:
+                    if card > kernels.seg_limit(n):
                         return None
                     base = vmin
                     eff = np.where(null, card, v - vmin)
             else:
                 return None  # float keys: sort-based path
             total *= card + 1
-            if total > kernels.MAX_SEGMENTS:
+            if total > kernels.seg_limit(n):
                 return None
             cards.append(card)
             bases.append(base)
@@ -288,10 +288,30 @@ class TPUHashAggExec(Executor):
         specs: List[Tuple[str, bool]] = []
         arg_exprs: List = []      # jittable expr | ("mask", slot) | None
         slots: List[tuple] = []
+        from ..expression.aggregation import AggMode
         for d in plan.aggs:
             if d.distinct:
                 return None
-            if d.name == AGG_COUNT:
+            if d.mode is AggMode.FINAL and d.name == AGG_COUNT:
+                a = d.args[0]
+                if not is_jittable(a):
+                    return None
+                specs.append(("sum", True))
+                arg_exprs.append(a)
+                slots.append(("dev", len(specs) - 1))
+            elif d.mode is AggMode.FINAL and d.name == AGG_AVG:
+                a0, a1 = d.args
+                if not (is_jittable(a0) and is_jittable(a1)):
+                    return None
+                if a0.eval_type is not EvalType.REAL:
+                    from ..expression.builtins import new_function
+                    a0 = new_function("cast_real", [a0])
+                specs.append(("sum", True))
+                arg_exprs.append(a0)
+                specs.append(("sum", True))
+                arg_exprs.append(a1)
+                slots.append(("avg", len(specs) - 2, len(specs) - 1))
+            elif d.name == AGG_COUNT:
                 a = d.args[0]
                 if isinstance(a, Constant) and a.value is not None:
                     specs.append(("count_star", False))
@@ -374,7 +394,7 @@ class TPUHashAggExec(Executor):
         n_segments = 1
         for _, card, _, _ in key_layouts:
             n_segments *= card + 1
-        if n_segments > kernels.MAX_SEGMENTS and plan.group_by:
+        if n_segments > kernels.seg_limit(n) and plan.group_by:
             child._replica = rep
             return None
 
@@ -492,7 +512,7 @@ class TPUHashAggExec(Executor):
                 return (np.zeros(len(w), dtype=np.int64), 0, 0, None)
             vmin, vmax = int(nn.min()), int(nn.max())
             card = vmax - vmin + 1
-            if card > kernels.MAX_SEGMENTS:
+            if card > kernels.seg_limit(len(w)):
                 return None
             codes = np.where(null, card, w - vmin).astype(np.int64)
             return codes, card, vmin, None
@@ -573,8 +593,22 @@ class TPUHashAggExec(Executor):
             arg_cols.append((v, m))
             return was_mapped
 
+        from ..expression.aggregation import AggMode
         for d in plan.aggs:
-            if d.name == AGG_COUNT:
+            # FINAL mode merges PARTIAL states (agg pushdown through join):
+            # count partials SUM; avg partials are a (sum, count) column
+            # pair; sum/min/max/first_row merge with their own op
+            if d.mode is AggMode.FINAL and d.name == AGG_COUNT:
+                specs.append(("sum", True))
+                add_arg(d.args[0])
+                slots.append(("dev", len(specs) - 1))
+            elif d.mode is AggMode.FINAL and d.name == AGG_AVG:
+                specs.append(("sum", True))
+                add_arg(d.args[0], cast_real=True)
+                specs.append(("sum", True))
+                add_arg(d.args[1])
+                slots.append(("avg", len(specs) - 2, len(specs) - 1))
+            elif d.name == AGG_COUNT:
                 from ..expression import Constant
                 a = d.args[0]
                 if isinstance(a, Constant) and a.value is not None:

@@ -120,7 +120,8 @@ def _residual_sel(stats, remaining: List[Expression]) -> float:
         return 1.0
     if stats is not None and not stats.pseudo:
         return stats.selectivity(remaining)
-    return 0.8 ** len(remaining)  # selectionFactor per conjunct
+    from ..statistics.table_stats import DEFAULT_SELECTIVITY
+    return DEFAULT_SELECTIVITY ** len(remaining)  # selectionFactor/conjunct
 
 
 def _handle_heuristic(hranges, total: float) -> float:

@@ -21,7 +21,9 @@ from .physical import (PhysicalHashAgg, PhysicalHashJoin,
                        PhysicalSort, PhysicalTableDual, PhysicalTableReader,
                        PhysicalTopN)
 
-SELECTION_FACTOR = 0.8   # reference: selectionFactor
+# single source for the reference's selectionFactor tuning constant
+from ..statistics.table_stats import DEFAULT_SELECTIVITY as SELECTION_FACTOR
+
 GROUP_NDV_FACTOR = 0.8   # pseudo NDV of one group-by column
 MEMTABLE_ROWS = 100.0    # virtual INFORMATION_SCHEMA tables are tiny
 

@@ -339,13 +339,14 @@ def normalize_logical(logical: LogicalPlan,
     predicate pushdown (its transformation rules own that)."""
     from .rules_extra import (eliminate_aggregation, eliminate_max_min,
                               eliminate_outer_joins, eliminate_projections,
-                              join_reorder)
+                              join_reorder, push_agg_through_join)
     root_needed = {c.unique_id for c in logical.schema.columns}
     logical = eliminate_outer_joins(logical, root_needed)
     if push_predicates:
         retained, logical = predicate_pushdown(logical, [])
         if retained:
             logical = LogicalSelection(retained, logical)
+    logical = push_agg_through_join(logical)
     column_pruning(logical, root_needed)
     logical = eliminate_aggregation(logical)
     logical = eliminate_max_min(logical)

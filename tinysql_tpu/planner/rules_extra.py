@@ -302,3 +302,94 @@ def join_reorder(p: LogicalPlan, stats_of=None) -> LogicalPlan:
         if isinstance(cur, LogicalJoin):
             cur.other_conditions.append(eq)
     return cur
+
+
+# ===== aggregation pushdown through join ===================================
+
+def push_agg_through_join(p: LogicalPlan) -> LogicalPlan:
+    """Decompose an aggregation over an inner join into a PARTIAL
+    aggregation below one join side + the original aggregation in FINAL
+    mode above (reference: rule_aggregation_push_down.go:181
+    tryToPushDownAgg; the cascades course rule
+    transformation_rules.go:497 is the same shape).
+
+    Validity: the partial side's group keys always include that side's
+    equi-join keys, so every row of one partial group carries the SAME
+    join key and duplicates identically across matches — partial states
+    recombine exactly as the raw rows would have (sum of sums, count of
+    counts via FINAL mode, min of mins...).  Requirements enforced:
+
+    - inner join, no residual cross-side conditions (those filter
+      per-PAIR and would have to run before pre-aggregation), no side
+      conditions left on the push side
+    - every agg arg reads ONE side only; count(*)/const-arg descs ride
+      with whichever side the rest picked
+    - push-side group-by items and join keys are bare Columns
+    - no DISTINCT (partial states don't compose)
+    """
+    p.children = [push_agg_through_join(c) for c in p.children]
+    if not isinstance(p, LogicalAggregation) or not p.children:
+        return p
+    j = p.child(0)
+    if not isinstance(j, LogicalJoin) or j.tp != JOIN_INNER:
+        return p
+    if j.other_conditions or not j.eq_conditions:
+        return p
+    if any(d.distinct for d in p.agg_funcs) or not p.agg_funcs:
+        return p
+    lsch, rsch = j.children[0].schema, j.children[1].schema
+    sides = []
+    for d in p.agg_funcs:
+        cols = [c for a in d.args for c in a.collect_columns()]
+        if not cols:
+            sides.append(None)
+        elif all(lsch.contains(c) for c in cols):
+            sides.append(0)
+        elif all(rsch.contains(c) for c in cols):
+            sides.append(1)
+        else:
+            return p
+    picked = {s for s in sides if s is not None}
+    if len(picked) != 1:
+        return p
+    side = picked.pop()
+    if (j.left_conditions if side == 0 else j.right_conditions):
+        return p
+    side_schema = lsch if side == 0 else rsch
+    keys = [(a if side == 0 else b) for a, b in j.eq_conditions]
+    if not all(isinstance(k, Column) for k in keys):
+        return p
+    # partial group keys: push-side group-by columns + push-side join keys
+    part_keys: List[Column] = []
+    for e in p.group_by:
+        cols = e.collect_columns()
+        if any(side_schema.contains(c) for c in cols):
+            if not isinstance(e, Column):
+                return p
+            part_keys.append(e)
+    for k in keys:
+        if not any(k.unique_id == c.unique_id for c in part_keys):
+            part_keys.append(k)
+
+    partial_descs: List[AggFuncDesc] = []
+    partial_cols: List[Column] = []
+    final_descs: List[AggFuncDesc] = []
+    for d in p.agg_funcs:
+        prt = d.partial_result_types()
+        partials, final = d.split(list(range(len(prt))))
+        fresh = [Column(ft, name=f"partial_{d.name}#{len(partial_cols) + i}")
+                 for i, ft in enumerate(prt)]
+        final.args = list(fresh)  # rebind by unique id, not dummy ordinal
+        partial_descs.extend(partials)
+        partial_cols.extend(fresh)
+        final_descs.append(final)
+
+    part_schema = Schema(partial_cols + part_keys)
+    partial = LogicalAggregation(list(part_keys), partial_descs,
+                                 part_schema, j.children[side])
+    partial.output_cols = partial_cols
+    partial.gb_out_cols = list(part_keys)  # pass-through identity
+    j.children[side] = partial
+    j.schema = j.children[0].schema.merge(j.children[1].schema)
+    p.agg_funcs = final_descs
+    return p

@@ -148,11 +148,22 @@ def _col_const(args) -> tuple:
 # ---- handle (per-storage cache; reference: statistics/handle.go) ----------
 
 def save_stats(storage, stats: TableStats) -> None:
-    stats.version = storage.current_version()
-    txn = storage.begin()
-    txn.set(_STATS_PREFIX + b"%08d" % stats.table_id, stats.to_json().encode())
-    txn.commit()
-    _cache_of(storage)[stats.table_id] = stats
+    with _stats_write_lock:
+        stats.version = storage.current_version()
+        txn = storage.begin()
+        txn.set(_STATS_PREFIX + b"%08d" % stats.table_id,
+                stats.to_json().encode())
+        txn.commit()
+        _cache_of(storage)[stats.table_id] = stats
+
+
+import threading
+
+# serializes read-modify-write of the shared stats record across
+# concurrently committing sessions and ANALYZE (reference: the stats
+# Handle owns all stats_meta writes behind one collector); RLock because
+# update_count_delta calls save_stats under the same lock
+_stats_write_lock = threading.RLock()
 
 
 def update_count_delta(storage, table_id: int, delta: int) -> None:
@@ -163,12 +174,33 @@ def update_count_delta(storage, table_id: int, delta: int) -> None:
     3-row table to an XLA compile."""
     if delta == 0:
         return
-    stats = load_stats(storage, table_id)
-    if stats is None:
-        stats = TableStats(table_id)
-    stats.row_count = max(0, stats.row_count + delta)
-    stats.modify_count += abs(delta)
-    save_stats(storage, stats)
+    with _stats_write_lock:
+        stats = load_stats(storage, table_id)
+        if stats is None:
+            stats = TableStats(table_id)
+        stats.row_count = max(0, stats.row_count + delta)
+        stats.modify_count += abs(delta)
+        try:
+            save_stats(storage, stats)
+        except Exception:
+            # stats are advisory: a conflicting concurrent writer must
+            # never surface an error AFTER the data commit succeeded
+            _cache_of(storage).pop(table_id, None)
+
+
+def set_count(storage, table_id: int, n: int) -> None:
+    """Absolute row-count set (bulk loads REPLACE a table's contents);
+    one atomic read-modify-write under the stats lock."""
+    with _stats_write_lock:
+        stats = load_stats(storage, table_id)
+        if stats is None:
+            stats = TableStats(table_id)
+        stats.row_count = max(0, int(n))
+        stats.modify_count += 1
+        try:
+            save_stats(storage, stats)
+        except Exception:
+            _cache_of(storage).pop(table_id, None)
 
 
 def drop_stats(storage, table_id: int) -> None:
