@@ -4,9 +4,10 @@ full SQL path — parse -> plan (device enforcer) -> TPU executors — printing
 ONE JSON line:  {"metric", "value", "unit", "vs_baseline"}.
 
 value    = TPU-tier Q1 wall-clock (best of 3 warm runs), seconds
-vs_baseline = CPU-tier time / TPU-tier time on the same engine & data
-           (the Go reference publishes no numbers — BASELINE.md — so the
-           measured CPU executor tier is the baseline for this round).
+vs_baseline = sqlite_cpu_s / tpu_s on Q1 — sqlite3 over the SAME generated
+           data is the external CPU baseline (the Go reference cannot be
+           built here: no Go toolchain in the image — see BASELINE.md
+           round-2 note; detail[] also carries this engine's own CPU tier).
 
 Also prints per-query details for Q1/Q3/Q6 on stderr.
 """

@@ -208,6 +208,50 @@ def test_kernel_join_match_direct():
     assert sorted(zip(li.tolist(), ri.tolist())) == sorted(want_outer)
 
 
+def test_kernel_unique_join_match_direct():
+    # unique build side: each probe row has at most one match
+    rng = np.random.RandomState(7)
+    rk = np.arange(0, 50, dtype=np.int64)
+    rng.shuffle(rk)
+    rn = np.zeros(50, bool)
+    rn[3] = True  # one NULL build key
+    lk = rng.randint(-5, 55, 400).astype(np.int64)
+    ln = rng.rand(400) < 0.1
+    lv = rng.rand(400) < 0.8
+    rv = rng.rand(50) < 0.8
+    for outer in (False, True):
+        li, ri = kernels.unique_join_match((lk, ln), 400, (rk, rn), 50,
+                                           outer=outer, lvalid=lv,
+                                           rvalid=rv)
+        want = []
+        for i in range(400):
+            if not lv[i]:
+                continue
+            js = [j for j in range(50)
+                  if rv[j] and not rn[j] and not ln[i] and rk[j] == lk[i]]
+            if js:
+                want.append((i, js[0]))
+            elif outer:
+                want.append((i, -1))
+        assert sorted(zip(li.tolist(), ri.tolist())) == sorted(want)
+    # sentinel collision: a DEAD build row must never match a probe of
+    # int64 max even though both sort to the sentinel position
+    big = np.iinfo(np.int64).max
+    rk2 = np.array([big, 7], dtype=np.int64)
+    rv2 = np.array([False, True])  # the max-key row is filtered out
+    lk2 = np.array([big, 7], dtype=np.int64)
+    li, ri = kernels.unique_join_match(
+        (lk2, np.zeros(2, bool)), 2, (rk2, np.zeros(2, bool)), 2,
+        rvalid=rv2)
+    assert sorted(zip(li.tolist(), ri.tolist())) == [(1, 1)]
+    # and a LIVE max-valued key still matches
+    rv3 = np.array([True, True])
+    li, ri = kernels.unique_join_match(
+        (lk2, np.zeros(2, bool)), 2, (rk2, np.zeros(2, bool)), 2,
+        rvalid=rv3)
+    assert sorted(zip(li.tolist(), ri.tolist())) == [(0, 0), (1, 1)]
+
+
 def test_kernel_sort_permutation_direct():
     rng = np.random.RandomState(5)
     a = rng.randint(-5, 5, 200).astype(np.int64)

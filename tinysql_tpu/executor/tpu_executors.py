@@ -789,10 +789,24 @@ class TPUHashJoinExec(Executor):
         if lk.dtype != rk.dtype:
             lk = np.asarray(lk).astype(np.float64)
             rk = np.asarray(rk).astype(np.float64)
-        li, ri = kernels.join_match((lk, lnull), lchk.full_rows(),
-                                    (rk, rnull), rchk.full_rows(),
-                                    outer=(plan.tp == "left"),
-                                    lvalid=lmask, rvalid=rmask)
+        right_unique = getattr(plan, "right_unique", False)
+        left_unique = getattr(plan, "left_unique", False)
+        if right_unique:
+            # unique build side: expansion-free probe, no size sync
+            li, ri = kernels.unique_join_match(
+                (lk, lnull), lchk.full_rows(), (rk, rnull),
+                rchk.full_rows(), outer=(plan.tp == "left"),
+                lvalid=lmask, rvalid=rmask)
+        elif left_unique and plan.tp == "inner":
+            ri, li = kernels.unique_join_match(
+                (rk, rnull), rchk.full_rows(), (lk, lnull),
+                lchk.full_rows(), outer=False,
+                lvalid=rmask, rvalid=lmask)
+        else:
+            li, ri = kernels.join_match((lk, lnull), lchk.full_rows(),
+                                        (rk, rnull), rchk.full_rows(),
+                                        outer=(plan.tp == "left"),
+                                        lvalid=lmask, rvalid=rmask)
         # gather output columns
         unmatched = ri < 0
         ri_safe = np.where(unmatched, 0, ri)

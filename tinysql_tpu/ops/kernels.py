@@ -820,6 +820,77 @@ def join_match(lkey: Tuple[np.ndarray, np.ndarray], n_left: int,
     return li[keep], ri[keep]
 
 
+_UNIQUE_JOIN_CACHE: Dict[tuple, Callable] = {}
+
+
+def _unique_join_kernel():
+    j = jax()
+    jn = jnp()
+
+    def kernel(lk, ln, lvalid, rk, rn, rvalid):
+        r_live = rvalid & ~rn
+        sentinel = (jn.iinfo(jn.int64).max if rk.dtype == jn.int64
+                    else jn.inf)
+        rk_clean = jn.where(r_live, rk, sentinel)
+        rperm = jn.argsort(rk_clean)
+        rs = rk_clean[rperm]
+        n_r_live = jn.sum(r_live.astype(jn.int32))
+        pos = jn.searchsorted(rs, lk, side="left")
+        in_range = pos < n_r_live
+        cand = rperm[jn.clip(pos, 0, rs.shape[0] - 1)]
+        l_live = lvalid & ~ln
+        match = l_live & in_range & (rs[jn.clip(pos, 0, rs.shape[0] - 1)]
+                                     == lk)
+        # a dead row's sentinel can collide with a LIVE max-valued key;
+        # the candidate itself must be live, not just key-equal
+        match = match & r_live[cand]
+        return match, cand
+
+    return j.jit(kernel)
+
+
+def unique_join_match(lkey, n_left: int, rkey, n_right: int,
+                      outer: bool = False, lvalid: np.ndarray = None,
+                      rvalid: np.ndarray = None):
+    """join_match fast path when the RIGHT (build) key is UNIQUE among
+    its live rows (clustered pk, or a partial aggregate keyed by the join
+    key): each probe row has at most ONE match, so the output size is
+    bounded by n_left — no count kernel, no expansion, and no
+    device->host size sync.  Same (li, ri) contract as join_match."""
+    jn = jnp()
+    nlb, nrb = bucket(max(n_left, 1)), bucket(max(n_right, 1))
+    lv = np.zeros(nlb, dtype=bool)
+    lv[:n_left] = lvalid if lvalid is not None else True
+    rv = np.zeros(nrb, dtype=bool)
+    rv[:n_right] = rvalid if rvalid is not None else True
+
+    def dev(a, n, fill):
+        if isinstance(a, np.ndarray):
+            return jn.asarray(pad1(a, n, fill))
+        assert a.shape[0] == n, (a.shape, n)
+        return a
+    lk = dev(lkey[0], nlb, 0)
+    ln = dev(lkey[1], nlb, True)
+    rk = dev(rkey[0], nrb, 0)
+    rn = dev(rkey[1], nrb, True)
+    ck = ("unique", nlb, nrb, str(lk.dtype), str(rk.dtype))
+    fn = _UNIQUE_JOIN_CACHE.get(ck)
+    if fn is None:
+        fn = _UNIQUE_JOIN_CACHE[ck] = _unique_join_kernel()
+    match, cand = fn(lk, ln, jn.asarray(lv), rk, rn, jn.asarray(rv))
+    match = np.asarray(match)
+    cand = np.asarray(cand)
+    if outer:
+        # ALL valid left rows survive — NULL-key rows match nothing and
+        # null-extend (lv is host-side already; match is False for them)
+        li = np.nonzero(lv)[0]
+        ri = np.where(match[li], cand[li], -1)
+    else:
+        li = np.nonzero(match)[0]
+        ri = cand[li]
+    return li, ri
+
+
 # =========================================================================
 # sort / top-k
 # =========================================================================

@@ -249,6 +249,55 @@ def _merge_join_ok(p: LogicalJoin, left_phys: PhysicalPlan,
     return True
 
 
+def _unique_on(side: LogicalPlan, key_uids: Set[int], n_keys: int) -> bool:
+    """Is the join-key tuple UNIQUE among `side`'s output rows?  True for
+    a clustered-pk datasource keyed by its pk, an aggregation whose group
+    keys all sit inside the join keys, row-filtering operators over such,
+    and inner joins that preserve one side's multiplicity (the OTHER side
+    is unique on its own join keys)."""
+    if len(key_uids) != n_keys or not key_uids:
+        return False  # non-column keys or no equi keys
+    if isinstance(side, LogicalAggregation):
+        gb = side.group_by
+        return (bool(gb) and all(isinstance(e, Column) for e in gb)
+                and {e.unique_id for e in gb} <= key_uids)
+    if isinstance(side, LogicalDataSource):
+        pk = side.table_info.get_pk_handle_col()
+        if pk is None or n_keys != 1:
+            return False
+        sc = next((c for c in side.schema.columns if c.name == pk.name),
+                  None)
+        return sc is not None and sc.unique_id in key_uids
+    if isinstance(side, (LogicalSelection, LogicalSort, LogicalTopN,
+                         LogicalLimit)):
+        return _unique_on(side.child(0), key_uids, n_keys)
+    if isinstance(side, LogicalProjection):
+        # identity columns pass through; expression outputs don't
+        ident = {e.unique_id for e in side.exprs if isinstance(e, Column)}
+        if not key_uids <= ident:
+            return False
+        return _unique_on(side.child(0), key_uids, n_keys)
+    if isinstance(side, LogicalJoin) and side.tp == JOIN_INNER \
+            and side.eq_conditions:
+        lsch, rsch = side.children[0].schema, side.children[1].schema
+        lk = {a.unique_id for a, _ in side.eq_conditions
+              if isinstance(a, Column)}
+        rk = {b.unique_id for _, b in side.eq_conditions
+              if isinstance(b, Column)}
+        nk = len(side.eq_conditions)
+        if all(any(c.unique_id == u for c in rsch.columns)
+               for u in key_uids):
+            # keys from the right child: unique there AND the left child
+            # matches each right row at most once
+            return (_unique_on(side.children[1], key_uids, n_keys)
+                    and _unique_on(side.children[0], lk, nk))
+        if all(any(c.unique_id == u for c in lsch.columns)
+               for u in key_uids):
+            return (_unique_on(side.children[0], key_uids, n_keys)
+                    and _unique_on(side.children[1], rk, nk))
+    return False
+
+
 def to_physical(p: LogicalPlan) -> PhysicalPlan:
     if isinstance(p, LogicalDataSource):
         with_handle = any(c.name == HANDLE_COL_NAME for c in p.schema.columns)
@@ -298,6 +347,16 @@ def to_physical(p: LogicalPlan) -> PhysicalPlan:
         join = cls(p.tp, left, right, p.schema)
         join.left_keys = _bind([a for a, _ in p.eq_conditions], left.schema)
         join.right_keys = _bind([b for _, b in p.eq_conditions], right.schema)
+        # key-uniqueness per side (reference: schema key info feeding the
+        # join executors): unlocks the expansion-free unique-build probe
+        join.left_unique = _unique_on(
+            p.children[0], {a.unique_id for a, _ in p.eq_conditions
+                            if isinstance(a, Column)},
+            len(p.eq_conditions))
+        join.right_unique = _unique_on(
+            p.children[1], {b.unique_id for _, b in p.eq_conditions
+                            if isinstance(b, Column)},
+            len(p.eq_conditions))
         join.other_conditions = _bind(p.other_conditions, p.schema)
         # leftover one-side conds (outer joins keep them at the join)
         join.left_conditions = _bind(p.left_conditions, left.schema)
