@@ -87,6 +87,44 @@ def test_tpu_gate_on_estimated_rows(tk):
     tk.must_exec("set @@tidb_enable_cascades_planner = 0")
 
 
+def test_selectivity_interval_cover():
+    # reference selectivity.go:129-306: conjuncts on ONE column merge into
+    # one interval estimate instead of multiplying as if independent
+    from tinysql_tpu.statistics.table_stats import TableStats
+    from tinysql_tpu.statistics.histogram import Histogram
+    from tinysql_tpu.expression import Column as ECol, Constant, new_function
+    from tinysql_tpu.mytypes import new_int_type
+    h = Histogram.build(1, list(range(100)))
+    st = TableStats(1, row_count=100)
+    st.columns[1] = h
+    col = ECol(new_int_type(), name="a")
+    col.stats_col_id = 1
+
+    def cmp(op, v):
+        return new_function(op, [col, Constant(v, new_int_type())])
+    # a > 20 AND a <= 40: true fraction = 20/100
+    sel = st.selectivity([cmp(">", 20), cmp("<=", 40)])
+    assert abs(sel - 0.20) < 0.05, sel
+    # independence would give ~0.79 * 0.41 = 0.32 — the cover must NOT
+    naive = st.expr_selectivity(cmp(">", 20)) * st.expr_selectivity(
+        cmp("<=", 40))
+    assert abs(sel - naive) > 0.05
+    # duplicated condition: no double-count
+    sel2 = st.selectivity([cmp(">", 50), cmp(">", 50)])
+    one = st.selectivity([cmp(">", 50)])
+    assert abs(sel2 - one) < 1e-9
+    # contradictory range -> 0
+    assert st.selectivity([cmp(">", 80), cmp("<", 20)]) == 0.0
+    # different columns stay independent
+    col2 = ECol(new_int_type(), name="b")
+    col2.stats_col_id = 2
+    st.columns[2] = Histogram.build(2, list(range(100)))
+    c2 = new_function("<", [col2, Constant(50, new_int_type())])
+    both = st.selectivity([cmp(">", 20), c2])
+    assert abs(both - st.expr_selectivity(cmp(">", 20))
+               * st.expr_selectivity(c2)) < 1e-9
+
+
 def test_statement_rollback_keeps_counts_exact(tk):
     # a failed statement's delta must not leak into the live count
     err = tk.exec_err("insert into t values (21, 0, 'y'), (1, 0, 'dup')")

@@ -20,19 +20,33 @@ from tinysql_tpu.server.server import Server
 class MiniClient:
     """Just enough of the client side of the protocol for tests."""
 
-    def __init__(self, port, db=""):
+    def __init__(self, port, db="", user="root", password=""):
         self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
         self.io = PacketIO(self.sock)
         greeting = self.io.read_packet()
         assert greeting[0] == 10, "expected protocol v10 greeting"
-        self.server_version = greeting[1:greeting.index(0, 1)].decode()
-        caps = 0x0200 | 0x8000 | 0x00008 if db else 0x0200 | 0x8000
+        nul = greeting.index(0, 1)
+        self.server_version = greeting[1:nul].decode()
+        # salt: 8 bytes after conn_id, 12 more after the capability block
+        p1 = nul + 1 + 4
+        salt = bytes(greeting[p1:p1 + 8])
+        p2 = p1 + 8 + 1 + 2 + 1 + 2 + 2 + 1 + 10
+        salt += bytes(greeting[p2:p2 + 12])
+        from tinysql_tpu.server.auth import scramble
+        token = scramble(password, salt)
+        caps = 0x0200 | 0x8000 | (0x00008 if db else 0)
         payload = struct.pack("<IIB", caps, 1 << 24, 0x21) + b"\x00" * 23
-        payload += b"root\x00" + b"\x00"  # empty auth response (lenenc 0)
+        payload += user.encode() + b"\x00"
+        payload += bytes([len(token)]) + token
         if db:
             payload += db.encode() + b"\x00"
         self.io.write_packet(payload)
         resp = self.io.read_packet()
+        if resp[0] == 0xFF:
+            code = struct.unpack_from("<H", resp, 1)[0]
+            self.sock.close()
+            raise PermissionError(
+                f"{code}: {resp[9:].decode(errors='replace')}")
         assert resp[0] == 0x00, f"auth failed: {resp!r}"
 
     def query(self, sql):
@@ -98,6 +112,38 @@ def test_handshake_and_version(server):
     c = MiniClient(server.port)
     assert "tinysql-tpu" in c.server_version
     c.close()
+
+
+def test_mysql_native_password_auth(server):
+    # mysql_native_password scramble verification against mysql.user
+    # (full-TiDB conn.go:418 behavior, stripped in tinysql, restored here)
+    from tinysql_tpu.server.auth import hash_password
+    admin = MiniClient(server.port)
+    admin.query("insert into mysql.user values "
+                f"('alice', '{hash_password('sesame')}')")
+    # correct password: session works
+    c = MiniClient(server.port, user="alice", password="sesame")
+    _, rows = c.query("select 1 + 1")
+    assert rows == [["2"]]
+    c.close()
+    # wrong password -> ERR 1045, connection refused
+    with pytest.raises(PermissionError) as ei:
+        MiniClient(server.port, user="alice", password="wrong")
+    assert "1045" in str(ei.value) and "Access denied" in str(ei.value)
+    # password against a passwordless account -> denied
+    with pytest.raises(PermissionError):
+        MiniClient(server.port, user="root", password="something")
+    # unknown user -> denied
+    with pytest.raises(PermissionError):
+        MiniClient(server.port, user="mallory", password="x")
+    # root with no password still fine
+    MiniClient(server.port).close()
+    # SQL-injection usernames must not bypass auth or kill the conn thread
+    for evil in ("\\' or 1=1 -- x", "x' or ''='", "trailing\\"):
+        with pytest.raises(PermissionError):
+            MiniClient(server.port, user=evil, password="")
+    admin.query("delete from mysql.user where user = 'alice'")
+    admin.close()
 
 
 def test_query_roundtrip(server):

@@ -252,6 +252,37 @@ def test_kernel_unique_join_match_direct():
     assert sorted(zip(li.tolist(), ri.tolist())) == [(0, 0), (1, 1)]
 
 
+def test_kernel_topk_fast_path_direct():
+    rng = np.random.RandomState(11)
+    for dtype in (np.int64, np.float64):
+        v = (rng.randint(-1000, 1000, 5000).astype(dtype)
+             if dtype == np.int64 else rng.randn(5000) * 100)
+        m = rng.rand(5000) < 0.1
+        for desc in (False, True):
+            fast = kernels._topk_single((v, m), desc, 5000, 17)
+            assert fast is not None
+            slow = kernels.sort_permutation([(v, m)], [desc], 5000)[:17]
+            def keyf(i):
+                return (m[i] != desc, v[i] if not m[i] else 0)
+            # same KEYS in the same order (tie rows may differ by index)
+            want = [keyf(i) for i in slow]
+            got = [keyf(i) for i in fast]
+            if desc:
+                assert [(a, -b) for a, b in got] == [(a, -b)
+                                                     for a, b in want]
+            else:
+                assert got == want
+    # int64 extremes fall back to the exact sort path
+    ext = np.array([np.iinfo(np.int64).min, 0, 5], dtype=np.int64)
+    assert kernels._topk_single((ext, np.zeros(3, bool)), True, 3, 2) is None
+    # k beyond row count trims to real rows
+    v = np.arange(10, dtype=np.int64)
+    ids = kernels.top_k([(v, np.zeros(10, bool))], [True], 10, 30)
+    assert sorted(ids.tolist()) == list(range(10))
+    # LIMIT 0: empty result, no partition crash
+    assert kernels.top_k([(v, np.zeros(10, bool))], [True], 10, 0).size == 0
+
+
 def test_kernel_sort_permutation_direct():
     rng = np.random.RandomState(5)
     a = rng.randint(-5, 5, 200).astype(np.int64)

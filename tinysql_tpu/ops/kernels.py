@@ -938,9 +938,89 @@ def sort_permutation(key_cols: List[Tuple[np.ndarray, np.ndarray]],
     return perm[:n_rows]
 
 
+_TOPK_CACHE: Dict[tuple, Callable] = {}
+
+
+def _topk_kernel(kb: int):
+    j = jax()
+
+    def kernel(score):
+        _, ids = j.lax.top_k(score, kb)
+        return ids
+
+    return j.jit(kernel)
+
+
+def _topk_single(key, desc: bool, n_rows: int, k: int):
+    """lax.top_k fast path for ONE sort key: O(n·log k) selection instead
+    of a full O(n·log n) sort.  Maps the key onto a single total-order
+    score (bigger = earlier in output); NULL ordering (first for asc,
+    last for desc) and padding share a worst/best sentinel — lax.top_k's
+    stable lowest-index tie-break then prefers real rows, which all sit
+    before the padding.  Returns None when an exact mapping isn't safe
+    (key values touching the sentinel range, non-finite floats)."""
+    v, m = key
+    if v.dtype == object or getattr(v.dtype, "kind", "") == "U":
+        return None
+    nb = bucket(max(n_rows, 1))
+    if v.dtype == np.int64:
+        info = np.iinfo(np.int64)
+        vmin = int(v.min()) if n_rows else 0
+        vmax = int(v.max()) if n_rows else 0
+        if vmin < info.min + 2 or vmax > info.max - 2:
+            return None
+        if desc:  # null last -> worst score
+            score = np.where(m, info.min + 1, v)
+            pad_val = info.min
+        else:     # asc: ~v reverses order exactly; null first -> best
+            score = np.where(m, info.max, ~v)
+            pad_val = info.min
+    elif v.dtype == np.float64:
+        w = np.where(m, 0.0, v)
+        if n_rows and not np.isfinite(w).all():
+            return None
+        if desc:
+            score = np.where(m, -np.inf, w)
+            pad_val = -np.inf
+        else:
+            score = np.where(m, np.inf, -w)
+            pad_val = -np.inf
+    else:
+        return None
+    if jax().default_backend() == "cpu":
+        # XLA:CPU's top_k lowering barely beats the full sort; host
+        # partition selection is ~100x faster there.  Exact stable-tie
+        # semantics: all rows above the threshold, then lowest-index rows
+        # AT the threshold.
+        s = score[:n_rows]
+        kk = min(k, n_rows)
+        t = np.partition(s, n_rows - kk)[n_rows - kk]
+        above = np.nonzero(s > t)[0]
+        at = np.nonzero(s == t)[0][:kk - len(above)]
+        ids = np.concatenate([above, at])
+        return ids[np.lexsort((ids, -s[ids]))]
+    jn = jnp()
+    kb = bucket(max(k, 1))
+    if kb > nb:
+        return None
+    ck = (nb, kb, str(score.dtype))
+    fn = _TOPK_CACHE.get(ck)
+    if fn is None:
+        fn = _TOPK_CACHE[ck] = _topk_kernel(kb)
+    ids = np.asarray(fn(jn.asarray(pad1(score, nb, pad_val))))[:k]
+    return ids[ids < n_rows]  # k may exceed the row count
+
+
 def top_k(key_cols: List[Tuple[np.ndarray, np.ndarray]], descs: List[bool],
           n_rows: int, k: int) -> np.ndarray:
-    """Top-k row indices in sorted order (full device sort + slice; a
-    lax.top_k fast path for single keys can land later)."""
+    """Top-k row indices in requested order.  Single-key inputs take the
+    lax.top_k selection path (VERDICT r1 #10); multi-key falls back to
+    the full device sort + slice."""
+    if k <= 0 or n_rows <= 0:
+        return np.empty(0, dtype=np.int64)
+    if len(key_cols) == 1:
+        ids = _topk_single(key_cols[0], descs[0], n_rows, k)
+        if ids is not None:
+            return ids
     perm = sort_permutation(key_cols, descs, n_rows)
     return perm[:k]

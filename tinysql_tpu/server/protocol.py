@@ -70,13 +70,15 @@ def parse_handshake_response(payload: bytes) -> dict:
     user, pos = read_nul_str(payload, pos)
     if caps & CLIENT_SECURE_CONNECTION:
         alen = payload[pos]
+        auth = payload[pos + 1:pos + 1 + alen]
         pos += 1 + alen
     else:
-        _, pos = read_nul_str(payload, pos)
+        auth, pos = read_nul_str(payload, pos)
     db = b""
     if caps & CLIENT_CONNECT_WITH_DB and pos < len(payload):
         db, pos = read_nul_str(payload, pos)
-    return {"caps": caps, "user": user.decode(), "db": db.decode()}
+    return {"caps": caps, "user": user.decode(), "db": db.decode(),
+            "auth": bytes(auth)}
 
 
 def ok_packet(affected: int = 0, last_insert_id: int = 0,

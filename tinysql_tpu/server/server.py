@@ -29,15 +29,31 @@ class ClientConn:
         self.session = Session(server.storage)
         self.alive = True
 
-    # ---- handshake (reference: conn.go:117,418) -------------------------
+    # ---- handshake (reference: conn.go:117,418 — with the scramble
+    # verification full TiDB does and tinysql stripped) -------------------
     def handshake(self) -> bool:
         import struct
+        from . import auth
         salt = p.new_salt()
         self.io.write_packet(p.handshake_v10(self.conn_id, salt))
         try:
             resp = p.parse_handshake_response(self.io.read_packet())
         except (ConnectionError, IndexError, ValueError, struct.error):
             return False  # not a MySQL client; close quietly
+        try:
+            stored = auth.lookup_auth_string(self.server.storage,
+                                             resp["user"])
+        except Exception as e:  # auth lookup failure != dead server thread
+            log.warning("conn-%d auth lookup error: %s", self.conn_id, e)
+            self.io.write_packet(p.err_packet(1105, "auth lookup failed"))
+            return False
+        if stored is None or not auth.check_scramble(resp["auth"], salt,
+                                                     stored):
+            using = "YES" if resp["auth"] else "NO"
+            self.io.write_packet(p.err_packet(
+                1045, f"Access denied for user '{resp['user']}'@'%' "
+                      f"(using password: {using})", "28000"))
+            return False
         if resp["db"]:
             try:
                 self.session.execute(f"use `{resp['db']}`")
@@ -146,6 +162,8 @@ class Server:
 
     def start(self) -> int:
         """Bind + accept loop in a background thread; returns bound port."""
+        from .auth import ensure_user_table
+        ensure_user_table(self.storage)  # idempotent system-table bootstrap
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((self.host, self.port))

@@ -104,10 +104,84 @@ class TableStats:
         return DEFAULT_SELECTIVITY
 
     def selectivity(self, conds: List[Expression]) -> float:
-        s = 1.0
+        """Combined selectivity with a per-column cover (reference:
+        selectivity.go:129-306 greedy disjoint-set cover, reduced to the
+        single-column case): ALL range/eq conjuncts on one histogrammed
+        column merge into one interval estimate, so `a > 5 AND a < 10`
+        stops multiplying as if independent.  Index-prefix covers are
+        handled upstream by the access-path ranger; everything not
+        claimed by a cover falls back to per-conjunct independence."""
+        groups: dict = {}
+        rest: List[Expression] = []
         for c in conds:
+            col = self._range_cond_col(c)
+            if col is not None and self.columns.get(col) is not None:
+                groups.setdefault(col, []).append(c)
+            else:
+                rest.append(c)
+        s = 1.0
+        for col, cs in groups.items():
+            if len(cs) == 1:
+                s *= self.expr_selectivity(cs[0])
+            else:
+                try:
+                    s *= self._interval_selectivity(col, cs)
+                except TypeError:  # incomparable mixed-type constants
+                    for c in cs:
+                        s *= self.expr_selectivity(c)
+        for c in rest:
             s *= self.expr_selectivity(c)
         return s
+
+    @staticmethod
+    def _range_cond_col(e: Expression) -> Optional[int]:
+        """col id when `e` is a col-vs-const compare mergeable into an
+        interval; None otherwise."""
+        if isinstance(e, ScalarFunction) and e.name in ("=", "<", "<=",
+                                                        ">", ">="):
+            col, const = _col_const(e.args)
+            if col is not None and const is not None:
+                return col
+        return None
+
+    def _interval_selectivity(self, col: int,
+                              cs: List[Expression]) -> float:
+        """Intersect all compares on `col` into [lo, hi] and estimate one
+        histogram range count."""
+        h = self.columns[col]
+        if h.total_count <= 0:
+            return DEFAULT_SELECTIVITY
+        lo, lo_open = None, False   # None = unbounded
+        hi, hi_open = None, False
+        for e in cs:
+            c0, const = _col_const(e.args)
+            op = e.name
+            if isinstance(e.args[0], Constant):  # const OP col -> flip
+                op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+            if op == "=":
+                lo2, hi2, lo2o, hi2o = const, const, False, False
+            elif op in (">", ">="):
+                lo2, hi2, lo2o, hi2o = const, None, op == ">", False
+            else:  # <, <=
+                lo2, hi2, lo2o, hi2o = None, const, False, op == "<"
+            if lo2 is not None and (lo is None or lo2 > lo
+                                    or (lo2 == lo and lo2o)):
+                lo, lo_open = lo2, lo2o
+            if hi2 is not None and (hi is None or hi2 < hi
+                                    or (hi2 == hi and hi2o)):
+                hi, hi_open = hi2, hi2o
+        if lo is not None and hi is not None and (
+                lo > hi or (lo == hi and (lo_open or hi_open))):
+            return 0.0  # contradictory range
+        cnt = float(h.not_null_count())
+        upper = (h.less_row_count(hi) + (0 if hi_open
+                                         else h.equal_row_count(hi))
+                 if hi is not None else cnt)
+        lower = (h.less_row_count(lo) + (h.equal_row_count(lo)
+                                         if lo_open else 0)
+                 if lo is not None else 0.0)
+        est = max(upper - lower, 0.0)
+        return min(1.0, est / max(self.row_count, 1))
 
     # ---- persistence ----------------------------------------------------
     def to_json(self) -> str:
