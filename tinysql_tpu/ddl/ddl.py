@@ -131,26 +131,96 @@ class DDL:
     the worker's state machine (reference: ddl.go:158 DDL iface + doDDLJob
     :421 enqueue-and-wait)."""
 
-    def __init__(self, storage, owner: bool = True):
+    def __init__(self, storage, owner=None):
         self.storage = storage
+        from .owner import MockOwner, OwnerManager
         from .worker import DDLWorker
+        # single-node default: always-owner mock (reference: owner/mock.go);
+        # a Server passes a real campaigning OwnerManager
+        if owner is None or owner is True:
+            owner = MockOwner(storage)
+        assert isinstance(owner, OwnerManager)
+        self.owner = owner
         self.worker = DDLWorker(storage)
 
     # ---- helpers --------------------------------------------------------
-    def _run_job(self, job: Job) -> Job:
-        """Enqueue + run to completion (synchronous owner)."""
+    def _run_job(self, job: Job, wait_timeout_s: float = 30.0) -> Job:
+        """Enqueue, then either step the state machine (this server won
+        the owner campaign) or wait for the owner server to finish it
+        (reference: ddl.go doDDLJob :421 enqueue-and-wait — any server
+        enqueues, only the owner's worker runs)."""
+        import time
         txn = self.storage.begin()
         m = Meta(txn)
         job.id = m.gen_global_id()
         m.enqueue_job(job)
         txn.commit()
-        self.worker.run_until_done(job.id)
-        txn = self.storage.begin()
-        done = Meta(txn).get_history_job(job.id)
-        txn.rollback()
-        if done is not None and done.error:
+        deadline = time.monotonic() + wait_timeout_s
+        done = None
+        while done is None:
+            if self.owner.campaign():
+                self.worker.run_until_done(job.id, owner=self.owner)
+            txn = self.storage.begin()
+            done = Meta(txn).get_history_job(job.id)
+            txn.rollback()
+            if done is None:
+                if time.monotonic() > deadline:
+                    self._cancel_queued(job)
+                    # outcome re-check: the owner may have finished (or
+                    # be unstoppably mid-flight) in the cancel window —
+                    # never report 'failed' for a DDL that committed
+                    txn = self.storage.begin()
+                    done = Meta(txn).get_history_job(job.id)
+                    txn.rollback()
+                    if done is None or (
+                            done.error and "timed out" in done.error):
+                        raise DDLError(f"DDL job {job.id} timed out "
+                                       "waiting for the owner")
+                    break
+                time.sleep(0.005)
+        if done.error:
             raise DDLError(done.error)
+        # the OWNER thread may still be inside the final syncer barrier;
+        # the DDL statement must not return before every live server has
+        # loaded the final schema (reference: doDDLJob returns only after
+        # checkSchemaSynced — a client's next connection may land on any
+        # server and must see the new object)
+        txn = self.storage.begin()
+        try:
+            final_ver = Meta(txn).schema_version()
+        finally:
+            txn.rollback()
+        from ..domain import wait_schema_synced
+        wait_schema_synced(self.storage, final_ver,
+                           timeout_s=self.worker.sync_timeout_s)
         return done
+
+    def _cancel_queued(self, job: Job) -> None:
+        """A job reported as failed must never execute later: dequeue it
+        on the timeout path — but ONLY while it is still untouched
+        (schema_state NONE).  A job the owner is mid-stepping has already
+        moved the schema through F1 states and must run to completion or
+        roll back through the worker, never vanish from the queue."""
+        try:
+            txn = self.storage.begin()
+            m = Meta(txn)
+            if m.get_history_job(job.id) is None:
+                from ..catalog.model import SchemaState
+                queued = next((j for j in m._load_queue()
+                               if j.id == job.id), None)
+                if (queued is not None
+                        and queued.schema_state == SchemaState.NONE
+                        and queued.state == JobState.NONE):
+                    m.pop_job(job.id)
+                    job.state = JobState.CANCELLED
+                    job.error = "timed out waiting for the DDL owner"
+                    m.add_history_job(job)
+                    m.bump_schema_version()
+                    txn.commit()
+                    return
+            txn.rollback()
+        except Exception:
+            pass
 
     # ---- databases ------------------------------------------------------
     def create_database(self, name: str, if_not_exists=False) -> None:

@@ -31,12 +31,20 @@ class DDLWorker:
     (Single-process build: the etcd owner election collapses to local
     ownership; owner/manager.go's mock owner is the model.)"""
 
-    def __init__(self, storage):
+    def __init__(self, storage, sync_timeout_s: float = 1.0):
         self.storage = storage
+        self.sync_timeout_s = sync_timeout_s
 
     # ---- main loop ------------------------------------------------------
-    def run_until_done(self, job_id: int, max_steps: int = 10_000) -> None:
+    def run_until_done(self, job_id: int, max_steps: int = 10_000,
+                       owner=None) -> None:
+        """Step first-queued jobs until `job_id` reaches history.  With
+        an `owner` manager, each step re-campaigns (renewing the lease —
+        a long backfill must not silently lose ownership mid-job); lost
+        ownership returns control to the caller's wait loop."""
         for _ in range(max_steps):
+            if owner is not None and not owner.campaign():
+                return  # ownership lost/taken: another worker steps now
             txn = self.storage.begin()
             m = Meta(txn)
             if m.get_history_job(job_id) is not None:
@@ -53,8 +61,17 @@ class DDLWorker:
                     job.state = (JobState.CANCELLED if job.error
                                  else JobState.SYNCED)
                     m.add_history_job(job)
-                m.bump_schema_version()
+                ver = m.bump_schema_version()
                 txn.commit()
+                # syncer barrier (reference: ddl/util/syncer.go via
+                # ddl_worker.go waitSchemaSynced): every registered
+                # server domain must load this version before the NEXT
+                # state transition — the F1 "at most one state apart"
+                # invariant across servers; timeout falls through to the
+                # commit-time schema validator as the backstop
+                from ..domain import wait_schema_synced
+                wait_schema_synced(self.storage, ver,
+                                   timeout_s=self.sync_timeout_s)
             except KVError:
                 txn.rollback()
                 continue  # retry the step
@@ -69,6 +86,21 @@ class DDLWorker:
                 m.bump_schema_version()
                 txn.commit()
         raise RuntimeError(f"DDL job {job_id} did not converge")
+
+    def run_pending(self, owner=None, max_steps: int = 10_000) -> None:
+        """Owner background duty (reference: ddl_worker.go:112 start loop):
+        drain whatever is queued — jobs enqueued by OTHER servers must
+        not wait for the owner's lease to lapse."""
+        for _ in range(max_steps):
+            if owner is not None and not owner.campaign():
+                return
+            txn = self.storage.begin()
+            m = Meta(txn)
+            job = m.first_job()
+            txn.rollback()
+            if job is None:
+                return
+            self.run_until_done(job.id, owner=owner)
 
     # ---- dispatch (reference: ddl_worker.go:427 runDDLJob) -------------
     def _run_one_step(self, m: Meta, job: Job) -> bool:

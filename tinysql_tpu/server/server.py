@@ -26,7 +26,7 @@ class ClientConn:
         self.sock = conn
         self.conn_id = conn_id
         self.io = PacketIO(conn)
-        self.session = Session(server.storage)
+        self.session = Session(server.storage, domain=server.domain)
         self.alive = True
 
     # ---- handshake (reference: conn.go:117,418 — with the scramble
@@ -150,8 +150,14 @@ class ClientConn:
 
 
 class Server:
-    def __init__(self, storage, host: str = "127.0.0.1", port: int = 4000):
+    def __init__(self, storage, host: str = "127.0.0.1", port: int = 4000,
+                 lease_s: float = 0.05):
         self.storage = storage
+        # one schema-cache domain PER SERVER (reference: domain singleton
+        # per tidb-server process) with a background reload ticker so the
+        # DDL syncer barrier sees this server catch up
+        from ..domain import Domain
+        self.domain = Domain(storage, lease_s=lease_s, background=True)
         self.host = host
         self.port = port
         self.sock: Optional[socket.socket] = None
@@ -196,6 +202,7 @@ class Server:
     def close(self) -> None:
         """Graceful drain (reference: server.go:155-283)."""
         self._closed.set()
+        self.domain.close()
         if self.sock is not None:
             try:
                 self.sock.close()

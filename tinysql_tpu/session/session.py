@@ -14,7 +14,6 @@ from typing import Dict, List, Optional
 from ..catalog.infoschema import InfoSchema
 from ..catalog.meta import Meta
 from ..catalog.model import SchemaState, TableInfo
-from ..ddl.ddl import DDL, DDLError
 from ..executor.executors import ExecContext, build_executor
 from ..executor.write import DeleteExec, InsertExec, WriteError
 from ..expression import Constant, Schema
@@ -71,8 +70,16 @@ SLOW_QUERY_THRESHOLD_MS = 300.0  # reference: logutil slow-query threshold
 class Session:
     """reference: session/session.go session struct."""
 
-    def __init__(self, storage, current_db: str = ""):
+    def __init__(self, storage, current_db: str = "", domain=None):
+        """`domain`: a per-server schema cache (domain.Domain) with its
+        own lease + owner manager; None = the storage's shared embedded
+        domain (lease 0 — always fresh; reference: sessions hold a Domain
+        via domainMap)."""
         self.storage = storage
+        if domain is None:
+            from ..domain import shared_domain
+            domain = shared_domain(storage)
+        self.domain = domain
         self.current_db = current_db
         # session scope initialized from defaults overlaid with globals
         # (reference: session.go loadCommonGlobalVariablesIfNeeded); the
@@ -83,20 +90,12 @@ class Session:
         self.uservars: Dict[str, Datum] = {}
         self._txn = None
         self._explicit_txn = False
-        self.ddl = self._shared_ddl(storage)
-        self._is: Optional[InfoSchema] = None
+        self._pinned_is: Optional[InfoSchema] = None
+        self.ddl = domain.ddl()
         self.last_affected = 0
         # per-statement phase timings (reference: session.go DurationParse
         # :590 / DurationCompile :612 + slow-query logging)
         self.last_query_info: Dict[str, float] = {}
-
-    # ---- shared per-storage singletons ---------------------------------
-    @staticmethod
-    def _shared_ddl(storage) -> DDL:
-        d = getattr(storage, "_ddl", None)
-        if d is None:
-            d = storage._ddl = DDL(storage)
-        return d
 
     def _globals(self) -> Dict[str, Datum]:
         g = getattr(self.storage, "_global_vars", None)
@@ -104,12 +103,16 @@ class Session:
             g = self.storage._global_vars = {}
         return g
 
-    # ---- schema cache (reference: domain.Reload; lazy version check) ---
+    # ---- schema cache (reference: domain.Reload via the Domain) --------
     def infoschema(self) -> InfoSchema:
-        ver = self._schema_version()
-        if self._is is None or self._is.version != ver:
-            self._is = InfoSchema.load(self.storage)
-        return self._is
+        """Pinned per STATEMENT: every read within one statement — and
+        the commit-time validator anchor — sees the same InfoSchema
+        object even if the domain's background ticker reloads mid-flight
+        (otherwise a plan built at version V could commit under an
+        anchor captured at V+1, silently skipping index maintenance)."""
+        if self._pinned_is is None:
+            self._pinned_is = self.domain.info_schema()
+        return self._pinned_is
 
     # ---- variables ------------------------------------------------------
     def get_sysvar(self, name: str, scope: str = "") -> Datum:
@@ -129,9 +132,10 @@ class Session:
             # domain/schema_validator.go Check via 2pc.go:633): a DDL that
             # landed mid-transaction would make buffered writes miss index
             # maintenance, so the commit must abort and retry instead
-            # baseline read through the txn's OWN snapshot so the check
-            # compares against what this txn actually sees
-            start_ver = Meta(self._txn).schema_version()
+            # anchor on the schema version this session PLANS with (the
+            # domain cache may legitimately lag the store under its
+            # lease; a stale-planned txn must fail the commit check)
+            start_ver = self.infoschema().version
             storage = self.storage
 
             def schema_check(commit_ts):
@@ -147,13 +151,6 @@ class Session:
                         f"{start_ver} -> {now_ver})")
             self._txn.schema_check = schema_check
         return self._txn
-
-    def _schema_version(self) -> int:
-        txn = self.storage.begin()
-        try:
-            return Meta(txn).schema_version()
-        finally:
-            txn.rollback()
 
     def in_txn(self) -> bool:
         return self._explicit_txn
@@ -225,6 +222,7 @@ class Session:
             self.get_sysvar("autocommit"))
         cp = self._txn.checkpoint() if (in_txn_scope and self._txn) else None
         self.last_affected = 0  # per-statement affected-rows counter
+        self._pinned_is = None  # each statement pins a fresh InfoSchema
         try:
             rs = self._dispatch(stmt)
             self._finish_stmt(ok=True)
@@ -407,7 +405,8 @@ class Session:
                                 list(cons.columns), cons.tp == "unique")
                 elif spec.tp == "drop_index":
                     d.drop_index(db, stmt.table.name, spec.name)
-        self._is = None  # force schema cache reload
+        self._pinned_is = None  # next statement re-pins post-DDL schema
+        self.domain.reload()
         return None
 
     # ---- SET -------------------------------------------------------------
