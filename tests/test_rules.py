@@ -157,3 +157,55 @@ def test_join_reorder_three_tables(tk):
         b = i % 7
         want += sum(1 for wk, _ in [(1, 10), (1, 11), (2, 20)] if wk == b)
     assert got == [[want]]
+
+
+def test_order_property_sort_elimination(tk):
+    # pk order is provided by the handle-ordered table reader: no Sort
+    ops = _ops(tk, "select a, b from t order by a")
+    assert not any("Sort" in o for o in ops), ops
+    got = tk.query("select a from t order by a").rows
+    assert got == sorted(got)
+    # covering index provides b-order: IndexReader, no Sort (the cascades
+    # :800-style TopN->index choice, via the property framework)
+    ops = _ops(tk, "select b from t order by b")
+    assert any("IndexReader" in o for o in ops), ops
+    assert not any("Sort" in o for o in ops), ops
+    want = tk.query("select b from t").rows
+    got = tk.query("select b from t order by b").rows
+    assert got == sorted(want, key=lambda r: (r[0] is not None, r[0]))
+    # non-indexed column: the Sort enforcer stays
+    ops = _ops(tk, "select c from t order by c")
+    assert any("Sort" in o for o in ops), ops
+    # DESC cannot ride an ascending scan: Sort stays
+    ops = _ops(tk, "select a from t order by a desc")
+    assert any("Sort" in o for o in ops), ops
+
+
+def test_order_property_topn_becomes_limit(tk):
+    ops = _ops(tk, "select a from t order by a limit 5")
+    assert any(o.startswith("Limit") for o in ops), ops
+    assert not any("TopN" in o or "Sort" in o for o in ops), ops
+    assert tk.query("select a from t order by a limit 5").rows == [
+        [1], [2], [3], [4], [5]]
+    # unordered key keeps TopN
+    ops = _ops(tk, "select c from t order by c limit 5")
+    assert any("TopN" in o for o in ops), ops
+
+
+def test_merge_join_via_index_order(tk):
+    # covering-index readers provide key order, widening the old
+    # pk-reader-only merge-join gate; the seek condition makes the
+    # index path win the access choice
+    tk.execute("create table ix (a int primary key, b int, key ibx (b))")
+    tk.execute("insert into ix values " + ", ".join(
+        f"({i}, {i % 11})" for i in range(1, 60)))
+    q = ("select ix.b, u.v from ix join u on ix.b = u.k "
+         "where ix.b >= 0 and u.k >= 0 order by ix.b, u.v")
+    ops = _ops(tk, q)
+    assert any("MergeJoin" in o for o in ops), ops
+    assert any("IndexReader" in o for o in ops), ops
+    got = tk.query(q).rows
+    want = tk.query("select ix.b, u.v from ix join u on ix.b + 0 = u.k "
+                    "where ix.b >= 0 and u.k >= 0 "
+                    "order by ix.b, u.v").rows
+    assert got == want

@@ -165,6 +165,12 @@ class DDL:
             txn.rollback()
             if done is None:
                 if time.monotonic() > deadline:
+                    # a job the owner is actively stepping WILL commit:
+                    # keep waiting instead of reporting a false failure
+                    if self._job_in_flight(job.id):
+                        deadline = time.monotonic() + wait_timeout_s
+                        time.sleep(0.005)
+                        continue
                     self._cancel_queued(job)
                     # outcome re-check: the owner may have finished (or
                     # be unstoppably mid-flight) in the cancel window —
@@ -195,14 +201,30 @@ class DDL:
                            timeout_s=self.worker.sync_timeout_s)
         return done
 
+    def _job_in_flight(self, job_id: int) -> bool:
+        """Has the owner started stepping this job (schema state moved
+        past NONE)?  Such a job must run to completion or roll back via
+        the worker — cancelling or failing it would strand intermediate
+        F1 states."""
+        from ..catalog.model import SchemaState
+        txn = self.storage.begin()
+        try:
+            queued = next((j for j in Meta(txn)._load_queue()
+                           if j.id == job_id), None)
+        finally:
+            txn.rollback()
+        return (queued is not None
+                and (queued.schema_state != SchemaState.NONE
+                     or queued.state != JobState.NONE))
+
     def _cancel_queued(self, job: Job) -> None:
         """A job reported as failed must never execute later: dequeue it
         on the timeout path — but ONLY while it is still untouched
-        (schema_state NONE).  A job the owner is mid-stepping has already
-        moved the schema through F1 states and must run to completion or
-        roll back through the worker, never vanish from the queue."""
+        (schema_state NONE)."""
+        from ..kv.errors import KVError
+        txn = self.storage.begin()
+        committed = False
         try:
-            txn = self.storage.begin()
             m = Meta(txn)
             if m.get_history_job(job.id) is None:
                 from ..catalog.model import SchemaState
@@ -217,10 +239,15 @@ class DDL:
                     m.add_history_job(job)
                     m.bump_schema_version()
                     txn.commit()
-                    return
-            txn.rollback()
-        except Exception:
-            pass
+                    committed = True
+        except KVError:
+            pass  # lost a write conflict to the owner: it took the job
+        finally:
+            if not committed:
+                try:
+                    txn.rollback()
+                except Exception:
+                    pass
 
     # ---- databases ------------------------------------------------------
     def create_database(self, name: str, if_not_exists=False) -> None:

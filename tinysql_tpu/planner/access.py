@@ -49,8 +49,21 @@ def _schema_col(ds: LogicalDataSource, name: str) -> Optional[Column]:
     return None
 
 
-def choose_path(ds: LogicalDataSource, stats) -> AccessPath:
-    """Enumerate paths, skyline-prune, pick min cost."""
+def _sort_cost(n: float) -> float:
+    """Cost of materializing + sorting n rows, in scan-row units
+    (reference: task.go sort GetCost rows*log(rows)*cpuFactor)."""
+    import math
+    n = max(n, 1.0)
+    return n * math.log2(max(n, 2.0)) * 0.05
+
+
+def choose_path(ds: LogicalDataSource, stats,
+                order_names=None) -> AccessPath:
+    """Enumerate paths, skyline-prune, pick min cost.  `order_names`
+    (ascending column-name prefix required by a parent Sort/TopN) makes
+    this ORDER-AWARE (reference: findBestTask enumerating under a
+    required PhysicalProperty): an order-providing path wins when its
+    cost beats the cheapest path PLUS the Sort enforcer it avoids."""
     conds = list(ds.pushed_conds)
     # live commit-time count deltas make row_count real even without
     # ANALYZE (stats_meta analogue); only a table we know NOTHING about
@@ -60,6 +73,7 @@ def choose_path(ds: LogicalDataSource, stats) -> AccessPath:
     total = float(max(stats.row_count, 1)) if known else PSEUDO_ROWS
 
     paths: List[AccessPath] = []
+    order_paths: List[AccessPath] = []
 
     # ---- table path (clustered int pk -> handle ranges) ----------------
     pk = ds.table_info.get_pk_handle_col()
@@ -85,14 +99,27 @@ def choose_path(ds: LogicalDataSource, stats) -> AccessPath:
         if not icols:
             continue
         ranges, access, remaining = ranger.detach_conditions(conds, icols)
-        if not access:
-            continue  # no seek advantage; skip full index scans
         covering = _covers(ds, idx, pk)
-        est = total * _sel(stats, access, _heuristic_sel(ranges, icols))
-        paths.append(AccessPath(idx, ranges, access, remaining, covering,
-                                est, index_cols=icols))
+        idx_names = _order_idx_names(idx)
+        order_ok = (order_names is not None and covering
+                    and idx_names[:len(order_names)] == order_names)
+        if not access and not order_ok:
+            continue  # no seek advantage and no order to provide
+        est = total * _sel(stats, access, _heuristic_sel(ranges, icols)
+                           if access else 1.0)
+        if not access:
+            # order-only FULL scan: the whole keyspace INCLUDING the null
+            # section (a comparison-derived MIN bound would skip NULLs,
+            # but ORDER BY must emit them — first, like the key codec
+            # sorts them); exempt from skyline (kept for its ORDER)
+            ranges = [ranger.Range(low=(), high=())]
+            order_paths.append(AccessPath(idx, ranges, access, remaining,
+                                          covering, est, index_cols=icols))
+        else:
+            paths.append(AccessPath(idx, ranges, access, remaining,
+                                    covering, est, index_cols=icols))
 
-    paths = _skyline_prune(paths)
+    paths = _skyline_prune(paths) + order_paths
 
     for p in paths:
         if p.index is None:
@@ -101,7 +128,37 @@ def choose_path(ds: LogicalDataSource, stats) -> AccessPath:
             p.cost = p.est_rows * COVER_FACTOR
         else:
             p.cost = p.est_rows * (1.0 + LOOKUP_FACTOR)
-    return min(paths, key=lambda p: p.cost)
+    best = min(paths, key=lambda p: p.cost)
+    if order_names is not None:
+        sat = [p for p in paths if _path_provides(p, pk, order_names)]
+        if sat:
+            best_sat = min(sat, key=lambda p: p.cost)
+            out_rows = best.est_rows * _residual_sel(stats, best.remaining)
+            if best_sat.cost <= best.cost + _sort_cost(out_rows):
+                return best_sat
+    return best
+
+
+def _order_idx_names(idx: IndexInfo):
+    """Index columns usable for ORDER, stopping at the FIRST
+    prefix-length column — a truncated key column breaks the emitted
+    order for everything after it (shared by order_ok, _path_provides,
+    and build_reader's order_col_uids so they can never disagree)."""
+    out = []
+    for ic in idx.columns:
+        if ic.length >= 0:
+            break
+        out.append(ic.name)
+    return out
+
+
+def _path_provides(p: AccessPath, pk, order_names) -> bool:
+    """Does this path emit `order_names` (ascending prefix)?"""
+    if p.index is None:
+        return pk is not None and order_names == [pk.name]
+    if not p.covering:
+        return False  # double-read does not preserve index order here
+    return _order_idx_names(p.index)[:len(order_names)] == order_names
 
 
 def _sel(stats, access_conds: List[Expression], fallback: float) -> float:
@@ -185,10 +242,24 @@ def _skyline_prune(paths: List[AccessPath]) -> List[AccessPath]:
 
 # ===== physical construction ===============================================
 
-def build_reader(ds: LogicalDataSource, stats,
-                 with_handle: bool) -> PhysicalPlan:
+def build_reader(ds: LogicalDataSource, stats, with_handle: bool,
+                 order_hint=None) -> PhysicalPlan:
+    """`order_hint`: [(unique_id, desc)] required above this reader —
+    mapped to ascending column names for the order-aware path choice.
+    The built scans always carry their PROVIDED order metadata
+    (order_col_uid / order_col_uids) for props.provided_order."""
     from .optimizer import _bind  # late: avoid import cycle
-    path = choose_path(ds, stats)
+    order_names = None
+    if order_hint:
+        by_uid = {c.unique_id: c.name for c in ds.schema.columns}
+        if all(not desc and uid in by_uid for uid, desc in order_hint):
+            order_names = [by_uid[uid] for uid, _ in order_hint]
+    path = choose_path(ds, stats, order_names)
+    pk = ds.table_info.get_pk_handle_col()
+    pk_uid = None
+    if pk is not None:
+        sc = next((c for c in ds.schema.columns if c.name == pk.name), None)
+        pk_uid = sc.unique_id if sc is not None else None
     if path.index is None:
         scan = PhysicalTableScan(ds.table_info, ds.db_name, ds.alias,
                                  ds.schema, with_handle)
@@ -196,6 +267,7 @@ def build_reader(ds: LogicalDataSource, stats,
         scan.filters = _bind(path.remaining, ds.schema)
         scan.stats_row_count = path.est_rows
         scan.has_estimate = True
+        scan.order_col_uid = pk_uid  # handle-ordered scan
         reader = PhysicalTableReader(scan)
         reader.stats_row_count = path.est_rows * _residual_sel(
             stats, path.remaining)
@@ -206,6 +278,15 @@ def build_reader(ds: LogicalDataSource, stats,
                               ds.alias, ds.schema, path.ranges)
     iscan.stats_row_count = path.est_rows
     iscan.has_estimate = True
+    # index scans emit index-column order (the kv iteration is ordered);
+    # record the uid prefix that maps onto in-scope schema columns
+    uids = []
+    by_name = {c.name: c.unique_id for c in ds.schema.columns}
+    for name in _order_idx_names(path.index):
+        if name not in by_name:
+            break
+        uids.append(by_name[name])
+    iscan.order_col_uids = uids
     if path.covering:
         # output plan: ds.schema columns sourced from index values / handle
         pk = ds.table_info.get_pk_handle_col()

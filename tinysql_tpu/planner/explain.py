@@ -27,13 +27,15 @@ def _info(p: PhysicalPlan) -> str:
             push = f", cop_topn:{s.pushed_topn['n']}"
         elif s.pushed_limit is not None:
             push = f", cop_limit:{s.pushed_limit}"
+        ko = "true" if getattr(s, "keep_order", False) else "false"
         return (f"table:{s.alias}, ranges:{_ranges_str(s.ranges)}, "
-                f"keep order:false{filt}{push}")
+                f"keep order:{ko}{filt}{push}")
     if isinstance(p, PhysicalIndexReader):
         s = p.scan
         filt = f", filters:{len(s.filters)}" if s.filters else ""
+        ko = ", keep order:true" if getattr(s, "keep_order", False) else ""
         return (f"table:{s.alias}, index:{s.index.name}, covering, "
-                f"ranges:{_ranges_str(s.ranges)}{filt}")
+                f"ranges:{_ranges_str(s.ranges)}{ko}{filt}")
     if isinstance(p, PhysicalIndexLookUpReader):
         s = p.index_scan
         filt = (f", filters:{len(p.table_scan.filters)}"

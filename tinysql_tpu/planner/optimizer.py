@@ -222,31 +222,21 @@ def _bind(exprs: List[Expression], schema: Schema) -> List[Expression]:
 
 def _merge_join_ok(p: LogicalJoin, left_phys: PhysicalPlan,
                    right_phys: PhysicalPlan) -> bool:
-    """Merge join needs key-ordered inputs: the single equi key must be
-    each side's clustered pk AND the chosen physical access path must be a
-    handle-ordered table read — an index path emits index-key order, so
-    the decision is made on the BUILT readers (reference:
-    exhaust_physical_plans.go's merge-join candidate requires matching
+    """Merge join needs key-ordered inputs: decided on the BUILT
+    children via the order-property framework — any plan that PROVIDES
+    the key order qualifies (clustered-pk table read, covering index
+    read, ...), replacing the old ad-hoc pk-reader gate (reference:
+    exhaust_physical_plans.go merge-join candidates require matching
     sort properties of the child task)."""
     if p.tp not in (JOIN_INNER, JOIN_LEFT) or len(p.eq_conditions) != 1:
         return False
     a, b = p.eq_conditions[0]
     if not (isinstance(a, Column) and isinstance(b, Column)):
         return False
-    for side, phys, col in ((p.children[0], left_phys, a),
-                            (p.children[1], right_phys, b)):
-        if not isinstance(side, LogicalDataSource):
-            return False
-        if not isinstance(phys, PhysicalTableReader):
-            return False  # index readers emit index-key order
-        pk = side.table_info.get_pk_handle_col()
-        if pk is None:
-            return False
-        sc = next((c for c in side.schema.columns if c.name == pk.name),
-                  None)
-        if sc is None or sc.unique_id != col.unique_id:
-            return False
-    return True
+    from .props import provided_order, satisfies
+    return (satisfies(provided_order(left_phys), [(a.unique_id, False)])
+            and satisfies(provided_order(right_phys),
+                          [(b.unique_id, False)]))
 
 
 def _unique_on(side: LogicalPlan, key_uids: Set[int], n_keys: int) -> bool:
@@ -298,7 +288,13 @@ def _unique_on(side: LogicalPlan, key_uids: Set[int], n_keys: int) -> bool:
     return False
 
 
-def to_physical(p: LogicalPlan) -> PhysicalPlan:
+def to_physical(p: LogicalPlan,
+                order_hint=None) -> PhysicalPlan:
+    """`order_hint`: the sort property a parent Sort/TopN requires —
+    threaded through row-order-preserving operators down to the reader so
+    the access-path choice is ORDER-AWARE (reference: findBestTask over a
+    required PhysicalProperty; enforcer_rules.go adds the Sort only when
+    the child can't provide it)."""
     if isinstance(p, LogicalDataSource):
         with_handle = any(c.name == HANDLE_COL_NAME for c in p.schema.columns)
         from .access import build_reader
@@ -307,12 +303,19 @@ def to_physical(p: LogicalPlan) -> PhysicalPlan:
         if storage is not None:
             from ..statistics.table_stats import load_stats
             stats = load_stats(storage, p.table_info.id)
-        return build_reader(p, stats, with_handle)
+        return build_reader(p, stats, with_handle, order_hint)
     if isinstance(p, LogicalSelection):
-        child = to_physical(p.child(0))
+        child = to_physical(p.child(0), order_hint)
         return PhysicalSelection(_bind(p.conditions, child.schema), child)
     if isinstance(p, LogicalProjection):
-        child = to_physical(p.child(0))
+        # projections forward the hint when the ordered columns are
+        # identity outputs (their source order survives)
+        hint = None
+        if order_hint:
+            ident = {e.unique_id for e in p.exprs if isinstance(e, Column)}
+            if all(uid in ident for uid, _ in order_hint):
+                hint = order_hint
+        child = to_physical(p.child(0), hint)
         return PhysicalProjection(_bind(p.exprs, child.schema), p.schema, child)
     if isinstance(p, LogicalAggregation):
         child = to_physical(p.child(0))
@@ -342,8 +345,12 @@ def to_physical(p: LogicalPlan) -> PhysicalPlan:
     if isinstance(p, LogicalJoin):
         left = to_physical(p.children[0])
         right = to_physical(p.children[1])
-        cls = (PhysicalMergeJoin if _merge_join_ok(p, left, right)
-               else PhysicalHashJoin)
+        merge_ok = _merge_join_ok(p, left, right)
+        if merge_ok:
+            from .props import mark_keep_order
+            mark_keep_order(left)
+            mark_keep_order(right)
+        cls = PhysicalMergeJoin if merge_ok else PhysicalHashJoin
         join = cls(p.tp, left, right, p.schema)
         join.left_keys = _bind([a for a, _ in p.eq_conditions], left.schema)
         join.right_keys = _bind([b for _, b in p.eq_conditions], right.schema)
@@ -363,11 +370,25 @@ def to_physical(p: LogicalPlan) -> PhysicalPlan:
         join.right_conditions = _bind(p.right_conditions, right.schema)
         return join
     if isinstance(p, LogicalSort):
-        child = to_physical(p.child(0))
+        from .props import (mark_keep_order, provided_order, required_of,
+                            satisfies)
+        req = required_of(p.by)
+        child = to_physical(p.child(0), req)
+        if satisfies(provided_order(child), req):
+            mark_keep_order(child)
+            return child  # Sort eliminated: the reader provides the order
         by = [(e.resolve_indices(child.schema), d) for e, d in p.by]
         return PhysicalSort(by, child)
     if isinstance(p, LogicalTopN):
-        child = to_physical(p.child(0))
+        from .props import (mark_keep_order, provided_order, required_of,
+                            satisfies)
+        req = required_of(p.by)
+        child = to_physical(p.child(0), req)
+        if satisfies(provided_order(child), req):
+            # ordered input: TopN degenerates to Limit (the cascades :800
+            # course stub's TopN->index rewrite, done via properties)
+            mark_keep_order(child)
+            return PhysicalLimit(p.offset, p.count, child)
         by = [(e.resolve_indices(child.schema), d) for e, d in p.by]
         return PhysicalTopN(by, p.offset, p.count, child)
     if isinstance(p, LogicalLimit):
