@@ -102,3 +102,79 @@ def expr_referenced_indices(exprs: Sequence[Expression]) -> List[int]:
             if c.index >= 0:
                 out.add(c.index)
     return sorted(out)
+
+
+def propagate_constants(conds: List[Expression]) -> List[Expression]:
+    """Constant propagation across equalities (reference:
+    expression/constant_propagation.go:580 — reduced to the CNF list
+    form): `col = const` conjuncts substitute the constant into SIBLING
+    conjuncts, then fold; `col1 = col2` equalities propagate a constant
+    bound to either side onto the other.  Runs to a bounded fixpoint.
+    `a = 3 AND a < b` becomes `a = 3 AND 3 < b`, unlocking index paths
+    and pushdowns the raw form hides."""
+    conds = list(conds)
+    for _ in range(3):  # bounded fixpoint
+        bindings = {}
+        for c in conds:
+            if isinstance(c, ScalarFunction) and c.name == "=" \
+                    and len(c.args) == 2:
+                a, b = c.args
+                if isinstance(a, Column) and isinstance(b, Constant) \
+                        and b.value is not None:
+                    bindings.setdefault(a.unique_id, b)
+                elif isinstance(b, Column) and isinstance(a, Constant) \
+                        and a.value is not None:
+                    bindings.setdefault(b.unique_id, a)
+        if not bindings:
+            return conds
+        # col=col transitivity: bind the unbound side
+        grew = True
+        while grew:
+            grew = False
+            for c in conds:
+                if isinstance(c, ScalarFunction) and c.name == "=" \
+                        and len(c.args) == 2:
+                    a, b = c.args
+                    if isinstance(a, Column) and isinstance(b, Column):
+                        if (a.unique_id in bindings
+                                and b.unique_id not in bindings):
+                            bindings[b.unique_id] = bindings[a.unique_id]
+                            grew = True
+                        elif (b.unique_id in bindings
+                                and a.unique_id not in bindings):
+                            bindings[a.unique_id] = bindings[b.unique_id]
+                            grew = True
+
+        def subst(e: Expression) -> Expression:
+            # (defining `col = const` conjuncts and col=col join keys are
+            # excluded by the caller loop below, never rewritten here)
+            if isinstance(e, Column):
+                got = bindings.get(e.unique_id)
+                return got if got is not None else e
+            if isinstance(e, ScalarFunction):
+                return ScalarFunction(
+                    e.name, [subst(a) for a in e.args],
+                    e.ret_type, e._scalar_fn, e._vec_fn)
+            return e
+
+        changed = False
+        out: List[Expression] = []
+        for c in conds:
+            if isinstance(c, ScalarFunction) and c.name == "=" \
+                    and len(c.args) == 2:
+                a, b = c.args
+                col_const = ((isinstance(a, Column)
+                              and isinstance(b, Constant))
+                             or (isinstance(b, Column)
+                                 and isinstance(a, Constant)))
+                col_col = isinstance(a, Column) and isinstance(b, Column)
+                if col_const or col_col:
+                    out.append(c)  # defining / join-key equality: keep
+                    continue
+            new_c = fold_constants(subst(c))
+            changed = changed or new_c.key() != c.key()
+            out.append(new_c)
+        conds = out
+        if not changed:
+            break
+    return conds

@@ -411,6 +411,21 @@ def _ds_row_count(ds) -> float:
     return float(s.row_count) if s else 0.0
 
 
+def _propagate_constants_in_plan(p: LogicalPlan) -> None:
+    """Constant propagation across equalities in every CNF condition
+    list (reference: expression/constant_propagation.go, run as part of
+    the logical rewrite list): selections and join residuals get
+    `col = const` bindings substituted into sibling conjuncts so later
+    rules (pushdown, ranger) see the derived constants."""
+    from ..expression import propagate_constants
+    for c in p.children:
+        _propagate_constants_in_plan(c)
+    if isinstance(p, LogicalSelection):
+        p.conditions = propagate_constants(p.conditions)
+    elif isinstance(p, LogicalJoin) and p.other_conditions:
+        p.other_conditions = propagate_constants(p.other_conditions)
+
+
 def normalize_logical(logical: LogicalPlan,
                       push_predicates: bool = True) -> LogicalPlan:
     """The fixed-order logical rewrite list (reference:
@@ -421,6 +436,7 @@ def normalize_logical(logical: LogicalPlan,
                               eliminate_outer_joins, eliminate_projections,
                               join_reorder, push_agg_through_join)
     root_needed = {c.unique_id for c in logical.schema.columns}
+    _propagate_constants_in_plan(logical)
     logical = eliminate_outer_joins(logical, root_needed)
     if push_predicates:
         retained, logical = predicate_pushdown(logical, [])

@@ -253,6 +253,11 @@ def join_reorder(p: LogicalPlan, stats_of=None) -> LogicalPlan:
                 return float(s)
         return 1e4
 
+    if len(nodes) <= DP_REORDER_LIMIT:
+        tree = _dp_best_tree(nodes, eqs, est)
+        cur, _, pending_eqs = _build_join_tree(tree, nodes, list(eqs))
+        return _finish_reorder(cur, pending_eqs, others)
+
     remaining = sorted(nodes, key=est)
     cur = remaining.pop(0)
     cur_uids = {c.unique_id for c in cur.schema.columns}
@@ -275,32 +280,42 @@ def join_reorder(p: LogicalPlan, stats_of=None) -> LogicalPlan:
             pick = remaining[0]
         remaining.remove(pick)
         j = LogicalJoin(JOIN_INNER, cur, pick)
-        new_uids = cur_uids | {c.unique_id for c in pick.schema.columns}
-        still = []
-        for a, b in pending_eqs:
-            au = {c.unique_id for c in a.collect_columns()}
-            bu = {c.unique_id for c in b.collect_columns()}
-            if au <= new_uids and bu <= new_uids:
-                # orient: left side of the pair must come from j's left
-                left_uids = cur_uids
-                if au <= left_uids:
-                    j.eq_conditions.append((a, b))
-                else:
-                    j.eq_conditions.append((b, a))
-            else:
-                still.append((a, b))
-        pending_eqs = still
+        pick_uids = {c.unique_id for c in pick.schema.columns}
+        pending_eqs = _attach_eqs(j, cur_uids, pick_uids, pending_eqs)
         cur = j
-        cur_uids = new_uids
+        cur_uids = cur_uids | pick_uids
+    return _finish_reorder(cur, pending_eqs, others)
+
+
+def _attach_eqs(j: LogicalJoin, luids: Set[int], ruids: Set[int],
+                pending_eqs: List[tuple]) -> List[tuple]:
+    """Attach every pending equi condition whose two sides are now both
+    in scope, oriented left-side-first; returns the still-pending rest
+    (shared by the greedy and DP assemblies)."""
+    new_uids = luids | ruids
+    still = []
+    for a, b in pending_eqs:
+        au = {c.unique_id for c in a.collect_columns()}
+        bu = {c.unique_id for c in b.collect_columns()}
+        if au <= new_uids and bu <= new_uids:
+            if au <= luids:
+                j.eq_conditions.append((a, b))
+            else:
+                j.eq_conditions.append((b, a))
+        else:
+            still.append((a, b))
+    return still
+
+
+def _finish_reorder(cur: LogicalPlan, pending_eqs: List[tuple],
+                    others: List[Expression]) -> LogicalPlan:
     if others:
-        cur_join = cur
-        assert isinstance(cur_join, LogicalJoin)
-        cur_join.other_conditions.extend(others)
+        assert isinstance(cur, LogicalJoin)
+        cur.other_conditions.extend(others)
     # any unplaced equi conds (degenerate) become other conditions
     for a, b in pending_eqs:
-        eq = new_function("=", [a, b])
         if isinstance(cur, LogicalJoin):
-            cur.other_conditions.append(eq)
+            cur.other_conditions.append(new_function("=", [a, b]))
     return cur
 
 
@@ -393,3 +408,88 @@ def push_agg_through_join(p: LogicalPlan) -> LogicalPlan:
     j.schema = j.children[0].schema.merge(j.children[1].schema)
     p.agg_funcs = final_descs
     return p
+
+
+# ===== DP join reorder =====================================================
+
+DP_REORDER_LIMIT = 8  # exhaustive DP up to this many join nodes
+
+
+def _dp_best_tree(nodes, eqs, est):
+    """Exact join-order search over connected subsets (reference:
+    rule_join_reorder_dp.go — DP over bitmasks; TiDB bounds it with
+    tidb_opt_join_reorder_threshold, greedy beyond).  Returns a nested
+    (left_tree, right_tree) tuple of node indices; bushy shapes allowed.
+
+    Cost model (matches derive_stats): an equi-connected join yields
+    max(|L|,|R|) rows, a cartesian product |L|*|R|; plan cost = sum of
+    intermediate result sizes.  Cartesian cost dominance makes the DP
+    prefer any connected order before a product, which is the practical
+    win over the greedy's local choice."""
+    n = len(nodes)
+    uids = [frozenset(c.unique_id for c in nd.schema.columns)
+            for nd in nodes]
+    edge_sides = []
+    for a, b in eqs:
+        au = frozenset(c.unique_id for c in a.collect_columns())
+        bu = frozenset(c.unique_id for c in b.collect_columns())
+        edge_sides.append((au, bu))
+
+    def mask_uids(mask):
+        out = set()
+        for i in range(n):
+            if mask & (1 << i):
+                out |= uids[i]
+        return out
+
+    mu = {1 << i: set(uids[i]) for i in range(n)}
+
+    def connected(lmask, rmask):
+        lu, ru = mu[lmask], mu[rmask]
+        for au, bu in edge_sides:
+            if (au <= lu and bu <= ru) or (bu <= lu and au <= ru):
+                return True
+        return False
+
+    # best[mask] = (cost, rows, tree)
+    best = {1 << i: (0.0, max(est(nodes[i]), 1.0), i) for i in range(n)}
+    full = (1 << n) - 1
+    for mask in range(3, full + 1):
+        if mask & (mask - 1) == 0:  # single node
+            continue
+        if mask not in mu:
+            mu[mask] = mask_uids(mask)
+        cand = None
+        sub = (mask - 1) & mask
+        while sub > 0:
+            other = mask ^ sub
+            if sub < other:  # canonical split once
+                l, r = sub, other
+                if l in best and r in best:
+                    cl, rl, tl = best[l]
+                    cr, rr, tr = best[r]
+                    rows = (max(rl, rr) if connected(l, r)
+                            else rl * rr)
+                    cost = cl + cr + rows
+                    if cand is None or cost < cand[0]:
+                        cand = (cost, rows, (tl, tr))
+            sub = (sub - 1) & mask
+        if cand is not None:
+            best[mask] = cand
+    return best[full][2]
+
+
+def _build_join_tree(tree, nodes, pending_eqs):
+    """Materialize the DP tree into LogicalJoins, attaching each equi
+    condition at the first join where both sides are in scope (oriented
+    left-first, like the greedy assembly)."""
+    if isinstance(tree, int):
+        nd = nodes[tree]
+        return nd, {c.unique_id for c in nd.schema.columns}, pending_eqs
+    lplan, luids, pending_eqs = _build_join_tree(tree[0], nodes,
+                                                 pending_eqs)
+    rplan, ruids, pending_eqs = _build_join_tree(tree[1], nodes,
+                                                 pending_eqs)
+    j = LogicalJoin(JOIN_INNER, lplan, rplan)
+    still = _attach_eqs(j, luids, ruids, pending_eqs)
+    return j, luids | ruids, still
