@@ -171,6 +171,68 @@ def jnp():
 I64_MIN = -(1 << 63)
 
 
+# =========================================================================
+# packed device->host transfer
+# =========================================================================
+# The device link (axon tunnel on this host; PCIe/DCN generally) charges a
+# large fixed latency PER device->host transfer and is far slower D2H than
+# H2D.  Every kernel therefore returns ONE packed int64 buffer: float64
+# bitcasts losslessly, bools widen, and the host splits the single download
+# back into typed arrays.  Data-dependent result sizes are handled with a
+# two-phase protocol: phase 1 computes on device and syncs ONE scalar (the
+# live count), phase 2 compacts device-side to a static bucket and packs.
+
+def pack_arrays(schema: list, arrays) -> tuple:
+    """Inside jit: concat 1-D arrays into one int64 and one float64 stream
+    (f64<->i64 bitcast does not lower under the TPU X64-emulation rewrite,
+    so the two element classes ride separate buffers — at most two D2H
+    transfers per kernel).  Appends (dtype, length, stream) to `schema`
+    (cleared first) for unpack_flat; tracing runs once per compile-cache
+    entry, so the schema paired with the jitted fn is stable by the time
+    results are unpacked."""
+    jn = jnp()
+    del schema[:]
+    ints, floats = [], []
+    for a in arrays:
+        if a.dtype == jn.float64:
+            schema.append(("float64", int(a.shape[0]), "f"))
+            floats.append(a)
+        elif a.dtype in (jn.int64, jn.bool_, jn.int32):
+            schema.append((str(a.dtype), int(a.shape[0]), "i"))
+            ints.append(a if a.dtype == jn.int64 else a.astype(jn.int64))
+        else:  # float32 etc. would silently truncate through the int path
+            raise TypeError(f"pack_arrays: unsupported dtype {a.dtype}")
+    zi = jn.zeros(0, dtype=jn.int64)
+    zf = jn.zeros(0, dtype=jn.float64)
+    return (jn.concatenate(ints) if ints else zi,
+            jn.concatenate(floats) if floats else zf)
+
+
+def unpack_flat(pair, schema: list) -> List[np.ndarray]:
+    """At most two D2H transfers, then split per the recorded schema."""
+    dev_i, dev_f = pair
+    flat_i = np.asarray(dev_i) if any(s == "i" for _, _, s in schema) \
+        else None
+    flat_f = np.asarray(dev_f) if any(s == "f" for _, _, s in schema) \
+        else None
+    out = []
+    pi = pf = 0
+    for dt, ln, stream in schema:
+        if stream == "f":
+            out.append(flat_f[pf:pf + ln])
+            pf += ln
+        else:
+            seg = flat_i[pi:pi + ln]
+            pi += ln
+            if dt == "int64":
+                out.append(seg)
+            elif dt == "bool":
+                out.append(seg != 0)
+            else:
+                out.append(seg.astype(np.dtype(dt)))
+    return out
+
+
 def bucket(n: int) -> int:
     """Pad target: next power of two (min 16) — bounds recompiles to
     O(log n) distinct shapes."""
@@ -189,6 +251,51 @@ def pad1(a: np.ndarray, n: int, fill=0) -> np.ndarray:
         out = np.full(n, fill, dtype=a.dtype)
     out[: len(a)] = a
     return out
+
+
+# one-RTT threshold: below this many rows, downloading the FULL dense
+# arrays in one packed transfer beats a scalar sync + compacted transfer
+# (the link's per-transfer latency dwarfs the extra bytes)
+SMALL_PACK = 1 << 16
+
+_PACK_CACHE: Dict[tuple, tuple] = {}
+
+
+def _slice_pack(items, ob: int):
+    """Pack device arrays sliced to [:ob] — one download.  Returns host
+    arrays (still ob-long; callers slice to the live count)."""
+    key = ("slice_pack", ob, tuple(str(a.dtype) for a in items),
+           tuple(int(a.shape[0]) for a in items))
+    ent = _PACK_CACHE.get(key)
+    if ent is None:
+        schema: list = []
+
+        def kernel(arrs):
+            return pack_arrays(schema, [a[:ob] for a in arrs])
+        ent = _PACK_CACHE[key] = (jax().jit(kernel), schema)
+    fn, schema = ent
+    return unpack_flat(fn(items), schema)
+
+
+def _present_pack(presence, items, ob: int):
+    """Device-compact rows where presence>0 into a static ob bucket, pack,
+    one download.  Returns (ids, gathered items), each ob-long with
+    out-of-range id fill past the live count."""
+    jn_ = jnp()
+    ns = int(presence.shape[0])
+    key = ("present_pack", ob, ns, tuple(str(a.dtype) for a in items))
+    ent = _PACK_CACHE.get(key)
+    if ent is None:
+        schema: list = []
+
+        def kernel(pres, arrs):
+            idx = jn_.nonzero(pres > 0, size=ob, fill_value=ns)[0]
+            safe = jn_.minimum(idx, ns - 1)
+            return pack_arrays(schema, [idx] + [a[safe] for a in arrs])
+        ent = _PACK_CACHE[key] = (jax().jit(kernel), schema)
+    fn, schema = ent
+    vals = unpack_flat(fn(presence, items), schema)
+    return vals[0], vals[1:]
 
 
 # =========================================================================
@@ -310,10 +417,28 @@ def group_aggregate(key_cols: List[Tuple[np.ndarray, np.ndarray]],
         fn = _AGG_CACHE[key] = _group_agg_kernel(len(key_cols),
                                                  tuple(agg_specs))
     n_groups, first_orig, gkeys, outs = fn(kv, kn, jn.asarray(valid), av, an)
-    ng = int(n_groups)
-    out_keys = [(np.asarray(v)[:ng], np.asarray(m)[:ng]) for v, m in gkeys]
-    out_aggs = [(np.asarray(v)[:ng], np.asarray(m)[:ng]) for v, m in outs]
-    return out_keys, out_aggs, np.asarray(first_orig)[:ng]
+    items = [first_orig]
+    for v, m in gkeys:
+        items += [v, m]
+    for v, m in outs:
+        items += [v, m]
+    if nb <= SMALL_PACK:
+        # one RTT: download the full (small) dense arrays with n_groups
+        # packed in, slice on host
+        vals = _slice_pack([n_groups[None].astype(jn.int64)] + items, nb)
+        ng = int(vals[0][0])
+        vals = vals[1:]
+    else:
+        ng = int(n_groups)  # scalar sync, then one compacted download
+        ob = min(bucket(max(ng, 1)), nb)
+        vals = _slice_pack(items, ob)
+    first = vals[0][:ng]
+    rest = vals[1:]
+    nk = len(gkeys)
+    out_keys = [(rest[2 * i][:ng], rest[2 * i + 1][:ng]) for i in range(nk)]
+    out_aggs = [(rest[2 * nk + 2 * i][:ng], rest[2 * nk + 2 * i + 1][:ng])
+                for i in range(len(outs))]
+    return out_keys, out_aggs, first
 
 
 _SEGMENT_AGG_CACHE: Dict[tuple, Callable] = {}
@@ -328,14 +453,9 @@ def _segment_agg_kernel(specs: tuple, n_segments: int):
     jn = jnp()
 
     def kernel(gid, valid, arg_vals, arg_nulls):
-        ns = n_segments + 1  # +1 overflow bin for invalid rows
-        g = jn.where(valid, gid, n_segments)
-        presence = j.ops.segment_sum(valid.astype(jn.int64), g,
-                                     num_segments=ns)[:n_segments]
-        n = gid.shape[0]
-        first_orig = j.ops.segment_min(jn.arange(n), g,
-                                       num_segments=ns)[:n_segments]
-        first_orig = jn.minimum(first_orig, n - 1)
+        seg = _SegReduce(j, jn, gid, valid, n_segments)
+        presence, first_orig = seg.presence_first()
+        first_orig = jn.minimum(first_orig, gid.shape[0] - 1)
         outs = []
         ai = 0
         for func, has_arg in specs:
@@ -347,33 +467,17 @@ def _segment_agg_kernel(specs: tuple, n_segments: int):
                 outs.append((presence, jn.zeros(n_segments, dtype=bool)))
                 continue
             live = valid & ~an
-            gl = jn.where(live, gid, n_segments)
+            cnt = seg.sum(live.astype(jn.int64), live)
             if func == "count":
-                outs.append((j.ops.segment_sum(
-                    live.astype(jn.int64), gl,
-                    num_segments=ns)[:n_segments],
-                    jn.zeros(n_segments, dtype=bool)))
+                outs.append((cnt, jn.zeros(n_segments, dtype=bool)))
             elif func in ("sum", "sum_int"):
-                total = j.ops.segment_sum(jn.where(live, av, 0), gl,
-                                          num_segments=ns)[:n_segments]
-                cnt = j.ops.segment_sum(live.astype(jn.int64), gl,
-                                        num_segments=ns)[:n_segments]
-                outs.append((total, cnt == 0))
+                outs.append((seg.sum(av, live), cnt == 0))
             elif func in ("min", "max"):
-                op = j.ops.segment_min if func == "min" else j.ops.segment_max
-                if av.dtype == jn.int64:
-                    fill = (jn.iinfo(jn.int64).max if func == "min"
-                            else jn.iinfo(jn.int64).min)
-                else:
-                    fill = jn.inf if func == "min" else -jn.inf
-                r = op(jn.where(live, av, fill), gl,
-                       num_segments=ns)[:n_segments]
-                cnt = j.ops.segment_sum(live.astype(jn.int64), gl,
-                                        num_segments=ns)[:n_segments]
-                outs.append((r, cnt == 0))
+                outs.append((seg.minmax(av, live, func == "min"), cnt == 0))
             else:  # pragma: no cover
                 raise ValueError(func)
-        return presence, first_orig, outs
+        n_present = jn.sum((presence > 0).astype(jn.int64))
+        return presence, first_orig, outs, n_present
 
     return j.jit(kernel)
 
@@ -418,19 +522,126 @@ def segment_group_aggregate(gids: np.ndarray, n_segments: int,
     if fn is None:
         fn = _SEGMENT_AGG_CACHE[key] = _segment_agg_kernel(
             tuple(agg_specs), ns)
-    presence, first_orig, outs = fn(g, jn.asarray(valid), av, an)
-    present = np.nonzero(np.asarray(presence) > 0)[0]
-    out_aggs = [(np.asarray(v)[present], np.asarray(m)[present])
-                for v, m in outs]
-    return present, out_aggs, np.asarray(first_orig)[present]
+    presence, first_orig, outs, n_present = fn(g, jn.asarray(valid), av, an)
+    return _present_extract(presence, first_orig, outs, n_present, ns)
+
+
+def _present_extract(presence, first_orig, outs, n_present, ns: int,
+                     limit: int = None):
+    """Shared segment-table extraction: one packed download (small tables)
+    or scalar-sync + device compaction (large).  Returns
+    (present_ids, out_aggs, first_orig) host arrays."""
+    jn = jnp()
+    items = [first_orig]
+    for v, m in outs:
+        items += [v, m]
+    if ns <= SMALL_PACK:
+        vals = _slice_pack(items + [presence], ns)
+        pres = vals[-1]
+        rest = vals[:-1]
+        present = np.nonzero(pres > 0)[0]
+        first = rest[0][present]
+        out_aggs = [(rest[1 + 2 * i][present], rest[2 + 2 * i][present])
+                    for i in range(len(outs))]
+    else:
+        np_ = int(n_present)
+        ob = min(bucket(max(np_, 1)), ns)
+        ids, vals = _present_pack(presence, items, ob)
+        present = ids[:np_]
+        first = vals[0][:np_]
+        out_aggs = [(vals[1 + 2 * i][:np_], vals[2 + 2 * i][:np_])
+                    for i in range(len(outs))]
+    if limit is not None:
+        keep = present < limit
+        if not keep.all():
+            present = present[keep]
+            first = first[keep]
+            out_aggs = [(v[keep], m[keep]) for v, m in out_aggs]
+    return present, out_aggs, first
+
+
+def _unpack_scalar_agg(vals):
+    """Unpacked [n_valid, first_orig, v0, m0, ...] -> the scalar-aggregate
+    contract (out_aggs, first_orig) with zero or one output row."""
+    ng = 1 if int(vals[0][0]) > 0 else 0
+    first_orig = vals[1][:ng]
+    rest = vals[2:]
+    out_aggs = [(rest[2 * i][:ng], rest[2 * i + 1][:ng])
+                for i in range(len(rest) // 2)]
+    return out_aggs, first_orig
+
+
+# Below this many segments the kernels unroll per-segment masked
+# reductions instead of scatter-based segment ops: on TPU (esp. under the
+# X64-emulation rewrite) a scatter-add over millions of rows costs
+# hundreds of ms while ns full-array masked reductions fuse into a few
+# streaming passes (measured ~100x faster at ns<=64).
+SEG_UNROLL = 64
+
+
+class _SegReduce:
+    """Segment-reduction strategy: scatter-based (any ns) or unrolled
+    masked reductions (small ns).  gid/valid fixed at construction."""
+
+    def __init__(self, j, jn, gid, valid, ns: int):
+        self.j, self.jn, self.gid, self.valid, self.ns = j, jn, gid, valid, ns
+        self.unroll = ns <= SEG_UNROLL
+        if self.unroll:
+            # one bool mask per segment; XLA fuses these into streaming
+            # passes over gid without materializing ns x n
+            self.seg_masks = [(gid == s) & valid for s in range(ns)]
+
+    def sum(self, x, live):
+        jn = self.jn
+        if self.unroll:
+            lx = jn.where(live, x, jn.zeros((), dtype=x.dtype))
+            return jn.stack([jn.sum(jn.where(sm, lx, 0)) for sm in self.seg_masks])
+        gl = jn.where(self.valid & live, self.gid, self.ns)
+        return self.j.ops.segment_sum(
+            jn.where(live, x, 0), gl, num_segments=self.ns + 1)[:self.ns]
+
+    def minmax(self, x, live, is_min: bool):
+        jn = self.jn
+        if x.dtype == jn.int64:
+            fill = (jn.iinfo(jn.int64).max if is_min
+                    else jn.iinfo(jn.int64).min)
+        else:
+            fill = jn.inf if is_min else -jn.inf
+        if self.unroll:
+            red = jn.min if is_min else jn.max
+            return jn.stack([red(jn.where(sm & live, x, fill))
+                             for sm in self.seg_masks])
+        gl = jn.where(self.valid & live, self.gid, self.ns)
+        op = self.j.ops.segment_min if is_min else self.j.ops.segment_max
+        return op(jn.where(live, x, fill), gl,
+                  num_segments=self.ns + 1)[:self.ns]
+
+    def presence_first(self):
+        """(presence counts, first row id) per segment; empty segments
+        carry the sentinel n (callers clip or remap — the sharded kernel
+        must see the sentinel to keep pmin from picking a bogus shard)."""
+        j, jn = self.j, self.jn
+        n = self.gid.shape[0]
+        if self.unroll:
+            presence = jn.stack([jn.sum(sm.astype(jn.int64))
+                                 for sm in self.seg_masks])
+            idx = jn.arange(n)
+            first = jn.stack([jn.min(jn.where(sm, idx, n))
+                              for sm in self.seg_masks])
+            return presence, first
+        g = jn.where(self.valid, self.gid, self.ns)
+        presence = j.ops.segment_sum(self.valid.astype(jn.int64), g,
+                                     num_segments=self.ns + 1)[:self.ns]
+        first = j.ops.segment_min(jn.arange(n), g,
+                                  num_segments=self.ns + 1)[:self.ns]
+        return presence, jn.minimum(first, n)
 
 
 def _fused_agg_outs(j, jn, agg_specs, arg_fns, cols, gid, valid,
-                    ns, presence, merge_sum, merge_min, merge_max):
+                    ns, presence, merge_sum, merge_min, merge_max, seg):
     """Per-aggregate switch shared by the single-device and sharded fused
     kernels; merge_* combine per-shard partials (identity single-device,
     psum/pmin/pmax over the mesh axis)."""
-    nseg = ns + 1
     outs = []
     for (func, has_arg), af in zip(agg_specs, arg_fns):
         av = an = None
@@ -440,24 +651,14 @@ def _fused_agg_outs(j, jn, agg_specs, arg_fns, cols, gid, valid,
             outs.append((presence, jn.zeros(ns, dtype=bool)))
             continue
         live = valid & ~an
-        gl = jn.where(live, gid, ns)
-        cnt = merge_sum(j.ops.segment_sum(
-            live.astype(jn.int64), gl, num_segments=nseg)[:ns])
+        cnt = merge_sum(seg.sum(live.astype(jn.int64), live))
         if func == "count":
             outs.append((cnt, jn.zeros(ns, dtype=bool)))
         elif func == "sum":
-            total = merge_sum(j.ops.segment_sum(
-                jn.where(live, av, 0), gl, num_segments=nseg)[:ns])
+            total = merge_sum(seg.sum(av, live))
             outs.append((total, cnt == 0))
         elif func in ("min", "max"):
-            op = j.ops.segment_min if func == "min" else j.ops.segment_max
-            if av.dtype == jn.int64:
-                fill = (jn.iinfo(jn.int64).max if func == "min"
-                        else jn.iinfo(jn.int64).min)
-            else:
-                fill = jn.inf if func == "min" else -jn.inf
-            local = op(jn.where(live, av, fill), gl,
-                       num_segments=nseg)[:ns]
+            local = seg.minmax(av, live, func == "min")
             merged = merge_min(local) if func == "min" else merge_max(local)
             outs.append((merged, cnt == 0))
         else:  # pragma: no cover
@@ -495,26 +696,20 @@ def fused_segment_aggregate(dev_cols, gid_dev, n_segments: int,
                    for e in arg_exprs]
 
         def kernel(cols, gid, mask):
-            n = gid.shape[0]
             valid = mask  # mandatory: covers filter AND padding rows
-            g = jn.where(valid, gid, ns)
-            nseg = ns + 1
-            presence = j.ops.segment_sum(valid.astype(jn.int64), g,
-                                         num_segments=nseg)[:ns]
-            first_orig = j.ops.segment_min(jn.arange(n), g,
-                                           num_segments=nseg)[:ns]
-            first_orig = jn.minimum(first_orig, n - 1)
+            seg = _SegReduce(j, jn, gid, valid, ns)
+            presence, first_orig = seg.presence_first()
+            first_orig = jn.minimum(first_orig, gid.shape[0] - 1)
             ident = lambda x: x
             outs = _fused_agg_outs(j, jn, agg_specs, arg_fns, cols, gid,
-                                   valid, ns, presence, ident, ident, ident)
-            return presence, first_orig, outs
+                                   valid, ns, presence, ident, ident, ident,
+                                   seg=seg)
+            n_present = jn.sum((presence > 0).astype(jn.int64))
+            return presence, first_orig, outs, n_present
         fn = _FUSED_CACHE[key] = j.jit(kernel)
-    presence, first_orig, outs = fn(dev_cols, gid_dev, mask_dev)
-    present = np.nonzero(np.asarray(presence) > 0)[0]
-    present = present[present < n_segments]
-    out_aggs = [(np.asarray(v)[present], np.asarray(m)[present])
-                for v, m in outs]
-    return present, out_aggs, np.asarray(first_orig)[present]
+    presence, first_orig, outs, n_present = fn(dev_cols, gid_dev, mask_dev)
+    return _present_extract(presence, first_orig, outs, n_present, ns,
+                            limit=n_segments)
 
 
 def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
@@ -524,12 +719,13 @@ def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
     j = jax()
     jn = jnp()
     key = ("scalar", tuple(agg_specs), program_key, nb)
-    fn = _FUSED_CACHE.get(key)
-    if fn is None:
+    ent = _FUSED_CACHE.get(key)
+    if ent is None:
         from .exprjit import compile_expr
         arg_fns = [e if callable(e) else
                    (compile_expr(e) if e is not None else None)
                    for e in arg_exprs]
+        kernel_schema: list = []
 
         def kernel(cols, valid):
             outs = []
@@ -563,12 +759,13 @@ def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
                     raise ValueError(func)
             n_valid = jn.sum(valid.astype(jn.int64))
             first_orig = jn.argmax(valid)[None]
-            return n_valid, first_orig, outs
-        fn = _FUSED_CACHE[key] = j.jit(kernel)
-    n_valid, first_orig, outs = fn(dev_cols, mask_dev)
-    ng = 1 if int(n_valid) > 0 else 0
-    out_aggs = [(np.asarray(v)[:ng], np.asarray(m)[:ng]) for v, m in outs]
-    return out_aggs, np.asarray(first_orig)[:ng]
+            items = [n_valid[None], first_orig]
+            for v, m in outs:
+                items += [v, m]
+            return pack_arrays(kernel_schema, items)
+        ent = _FUSED_CACHE[key] = (j.jit(kernel), kernel_schema)
+    fn, schema = ent
+    return _unpack_scalar_agg(unpack_flat(fn(dev_cols, mask_dev), schema))
 
 
 def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
@@ -613,20 +810,21 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
             shard = j.lax.axis_index("shard")
             base = shard.astype(jn.int64) * rows_local
             valid = mask
-            g = jn.where(valid, gid, ns)
-            nseg = ns + 1
-            presence = j.lax.psum(j.ops.segment_sum(
-                valid.astype(jn.int64), g, num_segments=nseg)[:ns], "shard")
-            first_local = j.ops.segment_min(
-                jn.arange(rows_local) + base, g,
-                num_segments=nseg)[:ns]
-            first_orig = j.lax.pmin(
-                jn.minimum(first_local, nb - 1), "shard")
+            seg = _SegReduce(j, jn, gid, valid, ns)
+            presence_local, first_local = seg.presence_first()
+            presence = j.lax.psum(presence_local, "shard")
+            # local first indexes THIS shard; absent segments carry the
+            # sentinel rows_local, which must map to the global max (nb-1)
+            # or pmin would prefer an empty low shard over a real high one
+            first_global = jn.where(first_local >= rows_local, nb - 1,
+                                    first_local + base)
+            first_orig = j.lax.pmin(first_global, "shard")
             outs = _fused_agg_outs(
                 j, jn, agg_specs, arg_fns, cols, gid, valid, ns, presence,
                 merge_sum=lambda x: j.lax.psum(x, "shard"),
                 merge_min=lambda x: j.lax.pmin(x, "shard"),
-                merge_max=lambda x: j.lax.pmax(x, "shard"))
+                merge_max=lambda x: j.lax.pmax(x, "shard"),
+                seg=seg)
             return presence, first_orig, outs
 
         col_spec = tuple(
@@ -636,13 +834,24 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
         sm = shard_map(kernel, mesh=mesh,
                        in_specs=(col_spec, P("shard"), P("shard")),
                        out_specs=(P(), P(), [(P(), P())] * len(agg_specs)))
-        fn = _FUSED_CACHE[key] = j.jit(sm)
-    presence, first_orig, outs = fn(tuple(dev_cols), gid_dev, mask_dev)
-    present = np.nonzero(np.asarray(presence) > 0)[0]
+        kernel_schema: list = []
+
+        def packed(cols, gid, mask):
+            presence, first_orig, outs = sm(cols, gid, mask)
+            items = [presence, first_orig]
+            for v, m in outs:
+                items += [v, m]
+            return pack_arrays(kernel_schema, items)
+        fn = _FUSED_CACHE[key] = (j.jit(packed), kernel_schema)
+    pfn, schema = fn
+    vals = unpack_flat(pfn(tuple(dev_cols), gid_dev, mask_dev), schema)
+    presence, first_orig = vals[0], vals[1]
+    rest = vals[2:]
+    present = np.nonzero(presence > 0)[0]
     present = present[present < n_segments]
-    out_aggs = [(np.asarray(v)[present], np.asarray(m)[present])
-                for v, m in outs]
-    return present, out_aggs, np.asarray(first_orig)[present]
+    out_aggs = [(rest[2 * i][present], rest[2 * i + 1][present])
+                for i in range(len(rest) // 2)]
+    return present, out_aggs, first_orig[present]
 
 
 _SCALAR_AGG_CACHE: Dict[tuple, Callable] = {}
@@ -650,9 +859,11 @@ _SCALAR_AGG_CACHE: Dict[tuple, Callable] = {}
 
 def _scalar_agg_kernel(specs: tuple):
     """No-GROUP-BY aggregation: pure masked reductions — no sort at all
-    (the reference's stream-agg analogue for a single global group)."""
+    (the reference's stream-agg analogue for a single global group).
+    Returns (jitted fn, schema) with all outputs in one packed buffer."""
     j = jax()
     jn = jnp()
+    schema: list = []
 
     def kernel(valid, arg_vals, arg_nulls):
         outs = []
@@ -689,9 +900,12 @@ def _scalar_agg_kernel(specs: tuple):
                 raise ValueError(func)
         n_valid = jn.sum(valid.astype(jn.int64))
         first_orig = jn.argmax(valid)[None]  # first valid original row
-        return n_valid, first_orig, outs
+        items = [n_valid[None], first_orig]
+        for v, m in outs:
+            items += [v, m]
+        return pack_arrays(schema, items)
 
-    return j.jit(kernel)
+    return j.jit(kernel), schema
 
 
 def scalar_aggregate(agg_specs, arg_cols, n_rows: int,
@@ -709,13 +923,12 @@ def scalar_aggregate(agg_specs, arg_cols, n_rows: int,
     av = [jn.asarray(pad1(v, nb)) for v, _ in arg_cols]
     an = [jn.asarray(pad1(m, nb, True)) for _, m in arg_cols]
     key = (tuple(agg_specs), nb, tuple(str(v.dtype) for v in av))
-    fn = _SCALAR_AGG_CACHE.get(key)
-    if fn is None:
-        fn = _SCALAR_AGG_CACHE[key] = _scalar_agg_kernel(tuple(agg_specs))
-    n_valid, first_orig, outs = fn(jn.asarray(valid), av, an)
-    ng = 1 if int(n_valid) > 0 else 0
-    out_aggs = [(np.asarray(v)[:ng], np.asarray(m)[:ng]) for v, m in outs]
-    return out_aggs, np.asarray(first_orig)[:ng]
+    ent = _SCALAR_AGG_CACHE.get(key)
+    if ent is None:
+        ent = _SCALAR_AGG_CACHE[key] = _scalar_agg_kernel(tuple(agg_specs))
+    fn, schema = ent
+    return _unpack_scalar_agg(unpack_flat(fn(jn.asarray(valid), av, an),
+                                          schema))
 
 
 # =========================================================================
@@ -745,33 +958,37 @@ def _join_count_kernel():
         hi = jn.minimum(hi, n_r_live)
         l_live = lvalid & ~ln
         counts = jn.where(l_live, jn.maximum(hi - lo, 0), 0)
-        starts = jn.cumsum(counts) - counts  # exclusive prefix
         total = jn.sum(counts)
-        return counts, starts, lo, rperm, total
+        # outer-mode output size: unmatched VALID left rows emit one row
+        eff_total = total + jn.sum((lvalid & (counts == 0)).astype(jn.int64))
+        return counts, lo, rperm, jn.stack([total, eff_total])
 
     return j.jit(kernel)
 
 
-def _join_expand_kernel(outer: bool):
+def _join_expand_kernel(outer: bool, ob2: int):
+    """Expansion packed to the exact output bucket: the totals are synced
+    before this runs, so li/ri download exactly bucket(n_out) rows in ONE
+    transfer instead of three upper-bound-sized ones."""
     j = jax()
     jn = jnp()
+    schema: list = []
 
-    def kernel(counts, starts, lo, rperm, lvalid, out_idx):
+    def kernel(counts, lo, rperm, lvalid):
+        out_idx = jn.arange(ob2)
         # outer mode: unmatched live-left rows emit one row with ri = -1
         eff_counts = jn.where(outer & lvalid & (counts == 0), 1, counts) \
             if outer else counts
         eff_starts = jn.cumsum(eff_counts) - eff_counts
-        total = jn.sum(eff_counts)
         li = jn.searchsorted(eff_starts, out_idx, side="right") - 1
         li = jn.clip(li, 0, counts.shape[0] - 1)
         pos = out_idx - eff_starts[li]
         matched = counts[li] > 0
         ridx = jn.clip(lo[li] + pos, 0, rperm.shape[0] - 1)
         ri = jn.where(matched, rperm[ridx], -1)
-        valid_out = out_idx < total
-        return li, ri, valid_out
+        return pack_arrays(schema, [li, ri])
 
-    return j.jit(kernel)
+    return j.jit(kernel), schema
 
 
 def join_match(lkey: Tuple[np.ndarray, np.ndarray], n_left: int,
@@ -803,21 +1020,20 @@ def join_match(lkey: Tuple[np.ndarray, np.ndarray], n_left: int,
     cfn = _JOIN_COUNT_CACHE.get(ck)
     if cfn is None:
         cfn = _JOIN_COUNT_CACHE[ck] = _join_count_kernel()
-    counts, starts, lo, rperm, total = cfn(lk, ln, jn.asarray(lv),
-                                           rk, rn, jn.asarray(rv))
-    total = int(total)
-    out_n = total + int(np.sum(lv)) if outer else total  # upper bound
-    out_b = bucket(max(out_n, 1))
-    ek = ("expand", outer, nlb, nrb, out_b)
-    efn = _JOIN_EXPAND_CACHE.get(ek)
-    if efn is None:
-        efn = _JOIN_EXPAND_CACHE[ek] = _join_expand_kernel(outer)
-    li, ri, valid_out = efn(counts, starts, lo, rperm, jn.asarray(lv),
-                            jn.arange(out_b))
-    li = np.asarray(li)
-    ri = np.asarray(ri)
-    keep = np.asarray(valid_out)
-    return li[keep], ri[keep]
+    lv_dev = jn.asarray(lv)
+    counts, lo, rperm, totals = cfn(lk, ln, lv_dev, rk, rn, jn.asarray(rv))
+    totals = np.asarray(totals)  # ONE scalar-pair sync
+    n_out = int(totals[1]) if outer else int(totals[0])
+    if n_out == 0:
+        return (np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64))
+    ob2 = bucket(n_out)
+    ek = ("expand", outer, nlb, nrb, ob2)
+    ent = _JOIN_EXPAND_CACHE.get(ek)
+    if ent is None:
+        ent = _JOIN_EXPAND_CACHE[ek] = _join_expand_kernel(outer, ob2)
+    efn, schema = ent
+    li, ri = unpack_flat(efn(counts, lo, rperm, lv_dev), schema)
+    return li[:n_out], ri[:n_out]
 
 
 _UNIQUE_JOIN_CACHE: Dict[tuple, Callable] = {}
@@ -844,9 +1060,27 @@ def _unique_join_kernel():
         # a dead row's sentinel can collide with a LIVE max-valued key;
         # the candidate itself must be live, not just key-equal
         match = match & r_live[cand]
-        return match, cand
+        return match, cand, jn.sum(match.astype(jn.int64))
 
     return j.jit(kernel)
+
+
+def _unique_pick_kernel(ob: int, nlb: int, outer: bool):
+    """Phase 2 of the unique join: compact (inner: matched rows; outer:
+    all valid left rows) device-side to a static bucket and pack li/ri
+    into one download."""
+    j = jax()
+    jn = jnp()
+    schema: list = []
+
+    def kernel(match, cand, lvalid):
+        rows = lvalid if outer else match
+        li = jn.nonzero(rows, size=ob, fill_value=nlb)[0]
+        safe = jn.minimum(li, nlb - 1)
+        ri = jn.where(match[safe], cand[safe], -1)
+        return pack_arrays(schema, [li, ri])
+
+    return j.jit(kernel), schema
 
 
 def unique_join_match(lkey, n_left: int, rkey, n_right: int,
@@ -877,18 +1111,25 @@ def unique_join_match(lkey, n_left: int, rkey, n_right: int,
     fn = _UNIQUE_JOIN_CACHE.get(ck)
     if fn is None:
         fn = _UNIQUE_JOIN_CACHE[ck] = _unique_join_kernel()
-    match, cand = fn(lk, ln, jn.asarray(lv), rk, rn, jn.asarray(rv))
-    match = np.asarray(match)
-    cand = np.asarray(cand)
+    lv_dev = jn.asarray(lv)
+    match, cand, n_match = fn(lk, ln, lv_dev, rk, rn, jn.asarray(rv))
     if outer:
         # ALL valid left rows survive — NULL-key rows match nothing and
-        # null-extend (lv is host-side already; match is False for them)
-        li = np.nonzero(lv)[0]
-        ri = np.where(match[li], cand[li], -1)
+        # null-extend; the output size is host-known (lv is host-side),
+        # so no device sync at all
+        n_out = int(np.sum(lv))
     else:
-        li = np.nonzero(match)[0]
-        ri = cand[li]
-    return li, ri
+        n_out = int(n_match)  # one scalar sync
+    if n_out == 0:
+        return (np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64))
+    ob = min(bucket(n_out), nlb)
+    pk = ("unique_pick", ob, nlb, outer)
+    ent = _UNIQUE_JOIN_CACHE.get(pk)
+    if ent is None:
+        ent = _UNIQUE_JOIN_CACHE[pk] = _unique_pick_kernel(ob, nlb, outer)
+    pfn, schema = ent
+    li, ri = unpack_flat(pfn(match, cand, lv_dev), schema)
+    return li[:n_out], ri[:n_out]
 
 
 # =========================================================================
