@@ -299,3 +299,30 @@ def test_dp_join_reorder_e2e(tk):
         b = i % 7
         want += sum(1 for wk, _ in [(1, 10), (1, 11), (2, 20)] if wk == b)
     assert got == [[want]]
+
+
+def test_agg_elimination_unique_key_propagation(tk):
+    # uniqueness propagates through the join (u.k is the pk of u, so t
+    # rows are not duplicated): GROUP BY t.a (pk of t) above the join is
+    # eliminated into a projection — and results stay correct
+    tk.execute("create table pu (k int primary key, v int)")
+    tk.execute("insert into pu values (1, 10), (2, 20), (3, 30)")
+    tk.execute("create table pt (a int primary key, b int)")
+    tk.execute("insert into pt values (7, 1), (8, 2), (9, 2)")
+    q = ("select pt.a, count(*), sum(pu.v) from pt join pu on pt.b = pu.k "
+         "group by pt.a")
+    plan = "\n".join(r[0] + " " + r[3] for r in
+                     tk.query("explain " + q).rows)
+    assert "HashAgg" not in plan, plan
+    got = sorted(tk.query(q).rows)
+    assert got == [[7, 1, 10], [8, 1, 20], [9, 1, 20]], got
+
+
+def test_agg_not_eliminated_on_nullable_unique_index(tk):
+    # a NULLABLE unique index admits multiple NULLs; GROUP BY over it
+    # must keep the aggregation (NULLs group together)
+    tk.execute("create table nu (a int unique, b int)")
+    tk.execute("insert into nu values (null, 1), (null, 2), (3, 3)")
+    got = sorted(tk.query("select a, count(*) from nu group by a").rows,
+                 key=lambda r: (r[0] is not None, r[0]))
+    assert got == [[None, 2], [3, 1]], got

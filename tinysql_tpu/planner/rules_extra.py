@@ -98,23 +98,69 @@ def eliminate_aggregation(p: LogicalPlan) -> LogicalPlan:
     return proj
 
 
+def unique_key_sets(p: LogicalPlan) -> List[Set[int]]:
+    """Derive the unique keys (as column unique_id sets) of a logical
+    subtree — the reference's Schema.Keys maintained by buildKeyInfo
+    (rule_build_key_info.go).  Propagation through joins is what lets
+    aggregation elimination fire above an agg-pushdown join: a join whose
+    build side is unique on ALL its equi-key columns never duplicates the
+    probe side, so probe-side keys stay unique."""
+    if isinstance(p, LogicalDataSource):
+        keys: List[Set[int]] = []
+        pk = p.table_info.get_pk_handle_col()
+        for c in p.schema.columns:
+            if pk is not None and c.name == pk.name:
+                keys.append({c.unique_id})
+        for idx in p.table_info.public_indices():
+            if idx.unique and len(idx.columns) == 1:
+                name = idx.columns[0].name
+                for c in p.schema.columns:
+                    # a NULLABLE unique index admits multiple NULL rows
+                    # (catalog/table.py encodes NULL entries non-uniquely),
+                    # and GROUP BY groups NULLs together — only a NOT NULL
+                    # column is a true key (reference buildKeyInfo does the
+                    # same check)
+                    if c.name == name and c.ret_type.not_null:
+                        keys.append({c.unique_id})
+        return keys
+    if isinstance(p, (LogicalSelection, LogicalSort, LogicalTopN)):
+        return unique_key_sets(p.child(0))
+    if isinstance(p, LogicalProjection):
+        out_of = {}
+        for e, oc in zip(p.exprs, p.schema.columns):
+            if isinstance(e, Column):
+                out_of.setdefault(e.unique_id, oc.unique_id)
+        keys = []
+        for k in unique_key_sets(p.child(0)):
+            if all(u in out_of for u in k):
+                keys.append({out_of[u] for u in k})
+        return keys
+    if isinstance(p, LogicalAggregation):
+        gb_outs = getattr(p, "gb_out_cols", [])
+        if p.group_by and len(gb_outs) == len(p.group_by):
+            return [{c.unique_id for c in gb_outs}]
+        return []
+    if isinstance(p, LogicalJoin) and p.tp in (JOIN_INNER, JOIN_LEFT):
+        lkeys = unique_key_sets(p.child(0))
+        rkeys = unique_key_sets(p.child(1))
+        l_eq = {a.unique_id for a, _ in p.eq_conditions
+                if isinstance(a, Column)}
+        r_eq = {b.unique_id for _, b in p.eq_conditions
+                if isinstance(b, Column)}
+        r_unique = bool(p.eq_conditions) and any(k <= r_eq for k in rkeys)
+        l_unique = bool(p.eq_conditions) and any(k <= l_eq for k in lkeys)
+        out: List[Set[int]] = []
+        if r_unique:
+            out += lkeys  # every probe row matches at most one build row
+        if l_unique and p.tp == JOIN_INNER:
+            out += rkeys
+        return out
+    return []
+
+
 def _covers_unique_key(child: LogicalPlan, gb_uids: Set[int]) -> bool:
-    """Does some unique key of `child` sit inside the group-by columns?
-    (single-datasource case: the clustered pk)."""
-    ds = child
-    while ds.children and not isinstance(ds, LogicalDataSource):
-        if isinstance(ds, (LogicalJoin,)):
-            return False
-        ds = ds.child(0)
-    if not isinstance(ds, LogicalDataSource):
-        return False
-    pk = ds.table_info.get_pk_handle_col()
-    if pk is None:
-        return False
-    for c in ds.schema.columns:
-        if c.name == pk.name and c.unique_id in gb_uids:
-            return True
-    return False
+    """Does some unique key of `child` sit inside the group-by columns?"""
+    return any(k and k <= gb_uids for k in unique_key_sets(child))
 
 
 def _agg_output_source(agg: LogicalAggregation, col: Column):
@@ -129,11 +175,24 @@ def _agg_output_source(agg: LogicalAggregation, col: Column):
 
 def _per_row_equivalent(src) -> Optional[Expression]:
     """One-row-group equivalents (reference: rewriteExpr in
-    rule_aggregation_elimination.go)."""
+    rule_aggregation_elimination.go).  FINAL-mode descriptors consume
+    partial STATES (one state per row once groups are unique): the merge
+    of a single partial is the partial itself — except AVG, whose state is
+    a (sum, count) column pair."""
+    from ..expression.aggregation import AggMode
     if isinstance(src, Expression):
         return src  # group-by column passes through
     d: AggFuncDesc = src
     arg = d.args[0]
+    if d.mode is AggMode.FINAL:
+        if d.name == AGG_AVG:
+            # sum/count; x/0 is NULL, matching AVG of an all-NULL group
+            return new_function("/", [d.args[0], d.args[1]])
+        e = arg  # COUNT merges by SUM of one partial count = itself, etc.
+        if (d.ret_type.eval_type is not e.ret_type.eval_type
+                and d.ret_type.eval_type.name == "REAL"):
+            e = new_function("cast_real", [e])
+        return e
     if d.name in (AGG_MAX, AGG_MIN, AGG_FIRST_ROW, AGG_SUM, AGG_AVG):
         if d.distinct and d.name in (AGG_SUM, AGG_AVG):
             pass  # distinct over one row is the row itself
