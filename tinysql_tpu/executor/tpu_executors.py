@@ -67,6 +67,13 @@ def _take_replica_masked(ex: Executor, extra_conds=None):
     conds = list(filters) + list(extra_conds or [])
     if not conds:
         return chk, None, rep
+    return chk, _fold_filter_masks(ex, rep, chk, conds), rep
+
+
+def _fold_filter_masks(ex, rep, chk, conds):
+    """AND-fold host masks for `conds`: string compares ride dictionary
+    codes, the residual goes through vectorized_filter.  Shared by the
+    replica intake and the fused-agg host-mask fallback."""
     mask = None
     residual = []
     for c in conds:
@@ -78,10 +85,97 @@ def _take_replica_masked(ex: Executor, extra_conds=None):
     if residual:
         rm = vectorized_filter(residual, chk)
         mask = rm if mask is None else (mask & rm)
-    return chk, mask, rep
+    return mask
 
 
 _STR_CMP_OPS = {"=", "!=", "<", "<=", ">", ">="}
+
+
+def _parse_string_cmp(chk, cond):
+    """Recognize `string Column <op> string Constant` (either order).
+    Returns (col, op, value) with the op flipped for constant-first, or
+    None."""
+    from ..expression import Column as ExprColumn, Constant, ScalarFunction
+    from ..mytypes import EvalType as ET
+    if not (isinstance(cond, ScalarFunction)
+            and cond.name in _STR_CMP_OPS and len(cond.args) == 2):
+        return None
+    a, b = cond.args
+    flip = False
+    if isinstance(b, ExprColumn) and isinstance(a, Constant):
+        a, b = b, a
+        flip = True
+    if not (isinstance(a, ExprColumn) and isinstance(b, Constant)):
+        return None
+    if a.eval_type is not ET.STRING or not isinstance(b.value, str):
+        return None
+    if chk.columns[a.index].values().dtype.kind != "U":
+        return None
+    op = cond.name
+    if flip:
+        op = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+              "=": "=", "!=": "!="}[op]
+    return a, op, b.value
+
+
+def _code_cmp_fn(idx: int, op: str, lo_s: int, hi_s: int, card_s: int):
+    """Device closure: string compare as an int compare over the slot's
+    dictionary-code column, with per-query [lo, hi) bounds + NULL code as
+    runtime params."""
+    def f(cols, params):
+        jn = kernels.jnp()
+        code, null = cols[idx]
+        pi = params[0]
+        r = _code_cmp(jn, op, code, pi[lo_s], pi[hi_s], pi[card_s],
+                      null=null)
+        return r.astype(jn.int64), jn.zeros_like(null)
+    return f
+
+
+def _build_device_mask(ex, rep, chk, conds):
+    """Compile scan filters into an on-device mask program over the fused
+    kernels' dev_cols.  Returns (mask_fn, key, params, needed) — needed is
+    a set of (slot index, "codes" | "full") the program reads — or None
+    when some condition cannot run on device (host mask fallback).
+    Slot 0 of the int params is always the live row count (padding
+    guard); constants ride params so changing them never recompiles."""
+    from ..ops.exprjit import (ParamTable, compile_expr_params, is_jittable,
+                               stable_shape_key)
+    pt = ParamTable()
+    pt.add_int(chk.full_rows())
+    fns = []
+    keys = []
+    needed = set()
+    for cond in conds:
+        sc = _parse_string_cmp(chk, cond)
+        if sc is not None:
+            col, op, val = sc
+            idx = col.index
+            got = _rep_string_dict(rep, _slot_id(ex, idx), chk, idx)
+            if got is None:
+                return None
+            _codes, card, _, uniques = got
+            lo = int(np.searchsorted(uniques, val, side="left"))
+            hi = int(np.searchsorted(uniques, val, side="right"))
+            fns.append(_code_cmp_fn(idx, op, pt.add_int(lo),
+                                    pt.add_int(hi), pt.add_int(card)))
+            keys.append(f"strcmp@{idx}:{op}")
+            needed.add((idx, "codes"))
+        elif is_jittable(cond):
+            fns.append(compile_expr_params(cond, pt))
+            keys.append(stable_shape_key(cond))
+            for c in cond.collect_columns():
+                needed.add((c.index, "full"))
+        else:
+            return None
+
+    def mask_fn(cols, params, row_idx):
+        m = row_idx < params[0][0]
+        for f in fns:
+            v, null = f(cols, params)
+            m = m & (v != 0) & ~null
+        return m
+    return mask_fn, tuple(keys), pt.arrays(), needed
 
 
 def _rep_string_dict(rep, sid, chk, idx):
@@ -99,48 +193,47 @@ def _rep_string_dict(rep, sid, chk, idx):
     return rep.memo(("keycodes", sid, True, False), build)
 
 
+def _slot_id(ex, idx: int):
+    """Stable replica-memo id for a schema slot (column id or the
+    handle)."""
+    ci = ex._decode_cols[idx]
+    return ci.id if ci is not None else "handle"
+
+
+def _code_cmp(np_or_jnp, op: str, code, lo, hi, card, null=None):
+    """The dictionary-code compare ladder over [lo, hi) bounds — one
+    implementation serving both the host (numpy) and device (jnp traced)
+    paths."""
+    live = code != card  # NULL code = card: comparisons exclude it
+    if null is not None:
+        live = live & ~null
+    if op == "=":
+        r = (code >= lo) & (code < hi)
+    elif op == "!=":
+        r = (code < lo) | (code >= hi)
+    elif op == "<":
+        r = code < lo
+    elif op == "<=":
+        r = code < hi
+    elif op == ">":
+        r = code >= hi
+    else:  # >=
+        r = code >= lo
+    return r & live
+
+
 def _string_cmp_mask(ex, rep, chk, cond):
     """Try to evaluate `cond` (string Column vs string Constant compare)
     through dictionary codes; returns a bool mask or None."""
-    from ..expression import Column as ExprColumn, Constant, ScalarFunction
-    from ..mytypes import EvalType as ET
-    if not (isinstance(cond, ScalarFunction)
-            and cond.name in _STR_CMP_OPS and len(cond.args) == 2):
+    sc = _parse_string_cmp(chk, cond)
+    if sc is None:
         return None
-    a, b = cond.args
-    flip = False
-    if isinstance(b, ExprColumn) and isinstance(a, Constant):
-        a, b = b, a
-        flip = True
-    if not (isinstance(a, ExprColumn) and isinstance(b, Constant)):
-        return None
-    if a.eval_type is not ET.STRING or not isinstance(b.value, str):
-        return None
-    col = chk.columns[a.index]
-    if col.values().dtype.kind != "U":
-        return None
-    op = cond.name
-    if flip:
-        op = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
-              "=": "=", "!=": "!="}[op]
-    ci = ex._decode_cols[a.index]
-    sid = ci.id if ci is not None else "handle"
-    codes, card, _, uniques = _rep_string_dict(rep, sid, chk, a.index)
-    val = b.value
+    a, op, val = sc
+    codes, card, _, uniques = _rep_string_dict(rep, _slot_id(ex, a.index),
+                                               chk, a.index)
     lo = int(np.searchsorted(uniques, val, side="left"))
     hi = int(np.searchsorted(uniques, val, side="right"))
-    live = codes != card  # NULL code = card: comparisons exclude it
-    if op == "=":
-        return live & (codes >= lo) & (codes < hi)
-    if op == "!=":
-        return live & ((codes < lo) | (codes >= hi))
-    if op == "<":
-        return live & (codes < lo)
-    if op == "<=":
-        return live & (codes < hi)
-    if op == ">":
-        return live & (codes >= hi)
-    return live & (codes >= lo)  # >=
+    return _code_cmp(np, op, codes, lo, hi, card)
 
 
 def _compact_if_selective(chk: Chunk, mask):
@@ -372,7 +465,7 @@ class TPUHashAggExec(Executor):
             if not isinstance(e, ExprColumn):
                 return None
 
-        chk, fmask, rep = _take_replica_masked(child)
+        chk, filters, rep = child.take_raw_replica()
         if chk is None:
             return None  # nothing consumed: reader bails identically
         n = chk.full_rows()
@@ -382,6 +475,18 @@ class TPUHashAggExec(Executor):
         # different column pruning, so slot INDEXES must never key them
         slot_ids = [ci.id if ci is not None else "handle"
                     for ci in child._decode_cols]
+
+        # ---- filter mask: on-device program when every condition lowers
+        # (constants as runtime params — zero recompiles across constant
+        # changes, ~100-byte upload); host numpy + nb-bool upload otherwise
+        dev_mask = _build_device_mask(child, rep, chk, filters)
+        if dev_mask is None:
+            fmask = _fold_filter_masks(child, rep, chk, filters) \
+                if filters else None
+            mask_needed = set()
+        else:
+            mask_fn, mask_prog_key, mask_params, mask_needed = dev_mask
+            fmask = None
 
         # ---- per-key codes (memoized per replica) -----------------------
         key_layouts = []
@@ -399,7 +504,7 @@ class TPUHashAggExec(Executor):
             return None
 
         # ---- device columns (memoized per replica + bucket) -------------
-        needed = set()
+        needed = set(mask_needed)
         for a in arg_exprs:
             if isinstance(a, tuple):
                 needed.add((a[1], "mask"))
@@ -412,7 +517,14 @@ class TPUHashAggExec(Executor):
             v = col.values()
             m = col.null_mask()
             sid = slot_ids[idx]
-            if v.dtype == object or v.dtype.kind == "U":
+            if kind == "codes":
+                # string filter rides dictionary codes; the value half of
+                # the slot carries the code column
+                got = _rep_string_dict(rep, sid, chk, idx)
+                codes = got[0]
+                dv = rep.memo(("devcodes", sid, nb),
+                              lambda c=codes: jn.asarray(kernels.pad1(c, nb)))
+            elif v.dtype == object or v.dtype.kind == "U":
                 if kind == "full":
                     child._replica = rep
                     return None  # string values in a compute expr
@@ -422,7 +534,8 @@ class TPUHashAggExec(Executor):
                               lambda v=v: jn.asarray(kernels.pad1(v, nb)))
             dn = rep.memo(("devn", sid, nb),
                           lambda m=m: jn.asarray(kernels.pad1(m, nb, True)))
-            dev_cols[idx] = (dv, dn)
+            if dev_cols[idx] is None or dv is not None:
+                dev_cols[idx] = (dv, dn)
 
         # count-over-column programs read only the null mask
         progs = []
@@ -433,11 +546,13 @@ class TPUHashAggExec(Executor):
             else:
                 progs.append(a)
 
-        # ---- filter mask (the only per-query upload; string compares
-        # already rewritten to dictionary-code int compares) -------------
-        mask = np.zeros(nb, dtype=bool)
-        mask[:n] = fmask if fmask is not None else True
-        mask_dev = jn.asarray(mask)
+        # ---- mask spec for the kernels ----------------------------------
+        if dev_mask is not None:
+            mask_spec = ("dev", mask_fn, mask_prog_key, mask_params)
+        else:
+            mask = np.zeros(nb, dtype=bool)
+            mask[:n] = fmask if fmask is not None else True
+            mask_spec = ("host", jn.asarray(mask))
 
         program_key = tuple(
             f"mask@{a[1]}" if isinstance(a, tuple)
@@ -448,7 +563,7 @@ class TPUHashAggExec(Executor):
         if not plan.group_by:
             out_keys = []
             out_aggs, first_orig = kernels.fused_scalar_aggregate(
-                dev_cols, specs, progs, n, nb, mask_dev,
+                dev_cols, specs, progs, n, nb, mask_spec,
                 program_key=program_key)
         else:
             gid_dev = rep.memo(
@@ -461,12 +576,12 @@ class TPUHashAggExec(Executor):
                 present, out_aggs, first_orig = \
                     kernels.fused_segment_aggregate_sharded(
                         mesh, dev_cols, gid_dev, n_segments, specs, progs,
-                        n, mask_dev, program_key=program_key)
+                        n, mask_spec, program_key=program_key)
             else:
                 present, out_aggs, first_orig = \
                     kernels.fused_segment_aggregate(
                         dev_cols, gid_dev, n_segments, specs, progs, n,
-                        mask_dev, program_key=program_key)
+                        mask_spec, program_key=program_key)
             out_keys = self._decode_present(present, key_layouts)
         return self._assemble_output(chk, plan, slots, out_keys, out_aggs,
                                      first_orig,

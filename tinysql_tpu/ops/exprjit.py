@@ -93,14 +93,7 @@ def compile_expr(e: Expression) -> Callable[[Sequence[VV]], VV]:
             # broadcast length: first populated slot (sparse device-column
             # lists hold None for untouched columns; string slots may carry
             # only their null mask)
-            n = 1
-            for c in cols:
-                if c is None:
-                    continue
-                arr = c[0] if c[0] is not None else c[1]
-                if arr is not None:
-                    n = arr.shape[0]
-                    break
+            n = _broadcast_len(cols)
             return (j.full((n,), cval, dtype=dt),
                     j.full((n,), is_null, dtype=bool))
         return const_fn
@@ -300,6 +293,99 @@ def _apply(name: str, vals: List[VV], arg_types, ret_int: bool,
         v, nl = vals[0]
         return _to_real_u(v, arg_uns[0]), nl
     raise ValueError(f"not jittable: {name}")
+
+
+class ParamTable:
+    """Per-query runtime parameters for compiled device programs.
+    Constants lower to slot reads instead of baked literals, so a query
+    that differs only in its constants (date bounds, LIMIT thresholds)
+    reuses the SAME compiled XLA program.  compile_expr_params assigns
+    slots in deterministic traversal order and fills the values as it
+    walks; per query the caller re-runs it on the identically-shaped
+    expression (closure rebuild is cheap; the jit program is cached by
+    the shape key)."""
+
+    def __init__(self):
+        self.i64: list = []
+        self.f64: list = []
+
+    def add_int(self, v) -> int:
+        from ..mytypes import wrap_i64
+        self.i64.append(0 if v is None else wrap_i64(int(v)))
+        return len(self.i64) - 1
+
+    def add_real(self, v) -> int:
+        self.f64.append(0.0 if v is None else float(v))
+        return len(self.f64) - 1
+
+    def arrays(self):
+        return (np.asarray(self.i64, dtype=np.int64),
+                np.asarray(self.f64, dtype=np.float64))
+
+
+def compile_expr_params(e: Expression, pt: ParamTable) \
+        -> Callable[[Sequence[VV], tuple], VV]:
+    """Like compile_expr, but closures take (cols, (params_i64,
+    params_f64)) and Constants read their value from a param slot.
+    NULL-ness of a constant stays structural (baked)."""
+    j = jnp()
+    if isinstance(e, Column):
+        idx = e.index
+
+        def col_fn(cols, params):
+            return cols[idx]
+        return col_fn
+    if isinstance(e, Constant):
+        is_null = e.value is None
+        if e.eval_type is EvalType.INT:
+            slot = pt.add_int(e.value)
+
+            def const_fn(cols, params, slot=slot, is_null=is_null):
+                n = _broadcast_len(cols)
+                v = j.full((n,), 1, dtype=j.int64) * params[0][slot]
+                return v, j.full((n,), is_null, dtype=bool)
+        else:
+            slot = pt.add_real(e.value)
+
+            def const_fn(cols, params, slot=slot, is_null=is_null):
+                n = _broadcast_len(cols)
+                v = j.full((n,), 1.0, dtype=j.float64) * params[1][slot]
+                return v, j.full((n,), is_null, dtype=bool)
+        return const_fn
+    assert isinstance(e, ScalarFunction), e
+    args = [compile_expr_params(a, pt) for a in e.args]
+    arg_types = [a.eval_type for a in e.args]
+    arg_uns = [a.eval_type is EvalType.INT
+               and getattr(a.ret_type, "is_unsigned", False) for a in e.args]
+    name = e.name
+    ret_int = e.eval_type is EvalType.INT
+
+    def fn(cols, params):
+        vals = [a(cols, params) for a in args]
+        return _apply(name, vals, arg_types, ret_int, arg_uns)
+    return fn
+
+
+def _broadcast_len(cols) -> int:
+    for c in cols:
+        if c is None:
+            continue
+        arr = c[0] if c[0] is not None else c[1]
+        if arr is not None:
+            return arr.shape[0]
+    return 1
+
+
+def stable_shape_key(e: Expression) -> str:
+    """stable_key with constant VALUES erased — the program-cache key for
+    the params-compiled variant (same shape + types = same program)."""
+    if isinstance(e, Column):
+        return f"@{e.index}:{e.ret_type.tp}:{e.ret_type.flag & 32}"
+    if isinstance(e, Constant):
+        return f"c?({'N' if e.value is None else 'v'}:{e.ret_type.tp})"
+    if isinstance(e, ScalarFunction):
+        return f"{e.name}({','.join(stable_shape_key(a) for a in e.args)})"
+    return repr(e)
 
 
 def stable_key(e: Expression) -> str:

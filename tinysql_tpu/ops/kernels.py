@@ -585,7 +585,9 @@ class _SegReduce:
 
     def __init__(self, j, jn, gid, valid, ns: int):
         self.j, self.jn, self.gid, self.valid, self.ns = j, jn, gid, valid, ns
-        self.unroll = ns <= SEG_UNROLL
+        # XLA:CPU lowers scatter-adds to a tight loop (fast) and would pay
+        # ns full passes for the unroll; on TPU it's the reverse
+        self.unroll = ns <= SEG_UNROLL and j.default_backend() != "cpu"
         if self.unroll:
             # one bool mask per segment; XLA fuses these into streaming
             # passes over gid without materializing ns x n
@@ -669,25 +671,56 @@ def _fused_agg_outs(j, jn, agg_specs, arg_fns, cols, gid, valid,
 # ---- fully fused aggregation over device-resident columns -----------------
 # The flagship TPU path: raw table columns live padded in HBM (memoized on
 # the columnar replica), aggregate ARGUMENT expressions evaluate on device
-# through the exprjit lowering, the filter mask is the only per-query
-# upload, and the whole thing is ONE XLA program.
+# through the exprjit lowering, the whole thing is ONE XLA program, and the
+# FILTER MASK itself computes on device: scan conditions lower through
+# exprjit with constants as runtime params (exprjit.ParamTable), so the
+# per-query traffic is a ~100-byte param upload instead of an nb-bool mask.
+#
+# mask spec accepted by the fused entry points:
+#   ("host", bool_mask_dev)            — legacy: host-evaluated, uploaded
+#   ("dev", mask_fn, key, (pi64, pf64)) — mask_fn(cols, params, row_idx)
+#     traced into the kernel; `key` joins the program cache key; params
+#     are the per-query constant arrays.
 
 _FUSED_CACHE: Dict[tuple, Callable] = {}
+
+_EMPTY_I64 = None
+_EMPTY_F64 = None
+_EMPTY_MASK = None
+
+
+def _mask_parts(mask):
+    """Normalize a mask spec -> (mask_fn|None, cache key, runtime mask
+    array, params pair).  Absent runtime inputs ride 0-length arrays so
+    every variant shares one call signature."""
+    global _EMPTY_I64, _EMPTY_F64, _EMPTY_MASK
+    jn = jnp()
+    if _EMPTY_I64 is None:
+        _EMPTY_I64 = jn.zeros(0, dtype=jn.int64)
+        _EMPTY_F64 = jn.zeros(0, dtype=jn.float64)
+        _EMPTY_MASK = jn.zeros(0, dtype=bool)
+    if mask[0] == "host":
+        return None, ("hostmask",), mask[1], (_EMPTY_I64, _EMPTY_F64)
+    _, mask_fn, key, (pi, pf) = mask
+    return (mask_fn, ("devmask", key), _EMPTY_MASK,
+            (jn.asarray(pi), jn.asarray(pf)))
 
 
 def fused_segment_aggregate(dev_cols, gid_dev, n_segments: int,
                             agg_specs, arg_exprs, n_rows: int,
-                            mask_dev, program_key: tuple = ()):
+                            mask, program_key: tuple = ()):
     """dev_cols: per-schema-slot (values, null) device pairs padded to one
     bucket (None for slots no jittable expression touches); gid_dev:
     composite group ids padded with an out-of-range id; arg_exprs: the agg
-    argument expressions, lowered on device.  Returns the group_aggregate
-    contract (present_ids, out_aggs, first_orig)."""
+    argument expressions, lowered on device; mask: a mask spec (module
+    docstring above).  Returns the group_aggregate contract
+    (present_ids, out_aggs, first_orig)."""
     j = jax()
     jn = jnp()
     nb = int(gid_dev.shape[0])
     ns = bucket(max(n_segments, 1))
-    key = ("seg", tuple(agg_specs), program_key, ns, nb)
+    mask_fn, mask_key, mask_arr, params = _mask_parts(mask)
+    key = ("seg", tuple(agg_specs), program_key, mask_key, ns, nb)
     fn = _FUSED_CACHE.get(key)
     if fn is None:
         from .exprjit import compile_expr
@@ -695,8 +728,11 @@ def fused_segment_aggregate(dev_cols, gid_dev, n_segments: int,
                    (compile_expr(e) if e is not None else None)
                    for e in arg_exprs]
 
-        def kernel(cols, gid, mask):
-            valid = mask  # mandatory: covers filter AND padding rows
+        def kernel(cols, gid, mask_in, pr):
+            if mask_fn is not None:
+                valid = mask_fn(cols, pr, jn.arange(nb))
+            else:
+                valid = mask_in  # covers filter AND padding rows
             seg = _SegReduce(j, jn, gid, valid, ns)
             presence, first_orig = seg.presence_first()
             first_orig = jn.minimum(first_orig, gid.shape[0] - 1)
@@ -707,18 +743,20 @@ def fused_segment_aggregate(dev_cols, gid_dev, n_segments: int,
             n_present = jn.sum((presence > 0).astype(jn.int64))
             return presence, first_orig, outs, n_present
         fn = _FUSED_CACHE[key] = j.jit(kernel)
-    presence, first_orig, outs, n_present = fn(dev_cols, gid_dev, mask_dev)
+    presence, first_orig, outs, n_present = fn(dev_cols, gid_dev,
+                                               mask_arr, params)
     return _present_extract(presence, first_orig, outs, n_present, ns,
                             limit=n_segments)
 
 
 def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
-                           nb: int, mask_dev, program_key: tuple = ()):
+                           nb: int, mask, program_key: tuple = ()):
     """Global-group variant of the fused path: masked reductions with
     on-device argument evaluation."""
     j = jax()
     jn = jnp()
-    key = ("scalar", tuple(agg_specs), program_key, nb)
+    mask_fn, mask_key, mask_arr, params = _mask_parts(mask)
+    key = ("scalar", tuple(agg_specs), program_key, mask_key, nb)
     ent = _FUSED_CACHE.get(key)
     if ent is None:
         from .exprjit import compile_expr
@@ -727,7 +765,11 @@ def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
                    for e in arg_exprs]
         kernel_schema: list = []
 
-        def kernel(cols, valid):
+        def kernel(cols, mask_in, pr):
+            if mask_fn is not None:
+                valid = mask_fn(cols, pr, jn.arange(nb))
+            else:
+                valid = mask_in
             outs = []
             for (func, has_arg), af in zip(agg_specs, arg_fns):
                 av = an = None
@@ -765,12 +807,13 @@ def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
             return pack_arrays(kernel_schema, items)
         ent = _FUSED_CACHE[key] = (j.jit(kernel), kernel_schema)
     fn, schema = ent
-    return _unpack_scalar_agg(unpack_flat(fn(dev_cols, mask_dev), schema))
+    return _unpack_scalar_agg(unpack_flat(fn(dev_cols, mask_arr, params),
+                                          schema))
 
 
 def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
                                     n_segments: int, agg_specs, arg_exprs,
-                                    n_rows: int, mask_dev,
+                                    n_rows: int, mask,
                                     program_key: tuple = ()):
     """Multi-chip variant of the fused aggregate (SURVEY §2.11 P5: the
     partial/final split AS a reduce-scatter schema): rows shard over the
@@ -796,8 +839,9 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
     # mismatched spec
     dev_shape = tuple(0 if c is None else (1 if c[0] is None else 2)
                       for c in dev_cols)
-    key = ("seg_sharded", tuple(agg_specs), program_key, ns, nb, n_dev,
-           dev_shape)
+    mask_fn, mask_key, mask_arr, params = _mask_parts(mask)
+    key = ("seg_sharded", tuple(agg_specs), program_key, mask_key, ns, nb,
+           n_dev, dev_shape)
     fn = _FUSED_CACHE.get(key)
     if fn is None:
         from .exprjit import compile_expr
@@ -805,11 +849,14 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
                    (compile_expr(e) if e is not None else None)
                    for e in arg_exprs]
 
-        def kernel(cols, gid, mask):
+        def kernel(cols, gid, mask_in, pr):
             rows_local = gid.shape[0]
             shard = j.lax.axis_index("shard")
             base = shard.astype(jn.int64) * rows_local
-            valid = mask
+            if mask_fn is not None:
+                valid = mask_fn(cols, pr, jn.arange(rows_local) + base)
+            else:
+                valid = mask_in
             seg = _SegReduce(j, jn, gid, valid, ns)
             presence_local, first_local = seg.presence_first()
             presence = j.lax.psum(presence_local, "shard")
@@ -832,19 +879,21 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
              if c is not None else None)
             for c in dev_cols)
         sm = shard_map(kernel, mesh=mesh,
-                       in_specs=(col_spec, P("shard"), P("shard")),
+                       in_specs=(col_spec, P("shard"), P("shard"),
+                                 (P(), P())),
                        out_specs=(P(), P(), [(P(), P())] * len(agg_specs)))
         kernel_schema: list = []
 
-        def packed(cols, gid, mask):
-            presence, first_orig, outs = sm(cols, gid, mask)
+        def packed(cols, gid, mask_in, pr):
+            presence, first_orig, outs = sm(cols, gid, mask_in, pr)
             items = [presence, first_orig]
             for v, m in outs:
                 items += [v, m]
             return pack_arrays(kernel_schema, items)
         fn = _FUSED_CACHE[key] = (j.jit(packed), kernel_schema)
     pfn, schema = fn
-    vals = unpack_flat(pfn(tuple(dev_cols), gid_dev, mask_dev), schema)
+    vals = unpack_flat(pfn(tuple(dev_cols), gid_dev, mask_arr, params),
+                       schema)
     presence, first_orig = vals[0], vals[1]
     rest = vals[2:]
     present = np.nonzero(presence > 0)[0]
