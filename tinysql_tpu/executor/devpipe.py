@@ -1,0 +1,1084 @@
+"""Device-resident query pipelines.
+
+The TPU-first replacement for per-operator host round-trips: a supported
+physical subtree (scans -> filters -> partial aggregates -> joins ->
+topn/sort/limit/projection) compiles into a chain of jitted device
+programs that hand device arrays to each other.  Intermediates NEVER land
+on the host; the only device->host transfer of a query is the packed
+materialization of the final (usually tiny) result.  This replaces the
+reference's executor pipeline hot loops (probe loop executor/join.go:325,
+agg update aggregate.go:307+) with gather/segment kernels, and its
+row-at-a-time operator hand-off with masked static-shape device views.
+
+Key design points (why this maps well onto TPU + XLA):
+
+- **Static shapes everywhere.**  Every view is padded to a power-of-two
+  bucket with a validity mask; data-dependent sizes never force a host
+  sync or a recompile.  One program per (shape, structure) pair, reused
+  across queries and constants (constants ride exprjit.ParamTable).
+- **Group index** (sort once per replica version, not per query): the
+  high-cardinality GROUP BY path sorts the table by key ONCE, memoizes
+  the order/boundaries on the replica (the clustered-index analogue of
+  the reference's index access paths), and then a per-query aggregate is
+  mask -> gather-to-sorted-order -> cumsum -> boundary-diff: exact for
+  int64 (mod-2^64 wrap) and float64, with no per-query sort or scatter.
+- **Join = dense position table + gather** (SURVEY §2.4: "build via
+  scatter, probe via gather"): a unique build side keyed by a bounded
+  int64 key becomes a dense key->row table (memoized on the replica for
+  base-table keys; static per replica version for group-index keys);
+  probing is one gather + validity checks.  No sort, no expansion pass
+  for the unique-build case the planner proves (pk / partial-agg build).
+- Strings ride order-preserving dictionary codes on device (decode on
+  materialize only), so string group keys, sort keys, and equality
+  filters all stay on the TPU.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..chunk import Chunk, Column as CCol
+from ..expression import Column as ExprColumn, Constant
+from ..expression.aggregation import AGG_COUNT, AGG_SUM
+from ..mytypes import EvalType
+from ..ops import kernels
+from ..ops.exprjit import (ParamTable, compile_expr_params, is_jittable,
+                           stable_shape_key)
+from ..planner.physical import (PhysicalHashAgg, PhysicalHashJoin,
+                                PhysicalLimit, PhysicalMergeJoin,
+                                PhysicalProjection, PhysicalSelection,
+                                PhysicalSort, PhysicalTableReader,
+                                PhysicalTopN)
+
+MAX_DENSE_RANGE = 1 << 25   # dense key->pos tables up to 32M slots (128MB)
+
+_JIT_CACHE: Dict[tuple, tuple] = {}
+
+
+class DevCol:
+    """One device column of a view: values (int64/float64; dictionary
+    codes for strings), a null mask, and the host-side decode table for
+    string columns (None for numerics)."""
+    __slots__ = ("vals", "null", "decode", "ret_type")
+
+    def __init__(self, vals, null, ret_type, decode=None):
+        self.vals = vals
+        self.null = null
+        self.ret_type = ret_type
+        self.decode = decode
+
+
+class DevView:
+    """A device-resident row batch: columns padded to bucket `nb` with a
+    validity mask.  Invalid rows are garbage and must never influence
+    results."""
+    __slots__ = ("cols", "valid", "nb")
+
+    def __init__(self, cols: List[DevCol], valid, nb: int):
+        self.cols = cols
+        self.valid = valid
+        self.nb = nb
+
+    def pairs(self):
+        """(vals, null) pairs in exprjit's cols layout."""
+        return [(c.vals, c.null) for c in self.cols]
+
+
+# =========================================================================
+# group index: the sorted-replica clustered index
+# =========================================================================
+
+class GroupIndex:
+    """Per (replica version, column) sorted order + group boundaries.
+    order[i] = original row of sorted position i; groups are contiguous
+    runs; ends[g] = last sorted position of group g (host int64 [ng]);
+    gkeys[g] = the key value (NULL group last, flagged)."""
+    __slots__ = ("order", "ends", "gkeys", "gkey_null", "n_groups", "lo",
+                 "hi")
+
+    def __init__(self, vals: np.ndarray, nulls: np.ndarray):
+        order = np.lexsort((vals, nulls))  # non-null first, then by value
+        sv = vals[order]
+        sn = nulls[order]
+        n = len(sv)
+        if n == 0:
+            self.order = order
+            self.ends = np.empty(0, dtype=np.int64)
+            self.gkeys = np.empty(0, dtype=np.int64)
+            self.gkey_null = np.empty(0, dtype=bool)
+            self.n_groups = 0
+            self.lo = self.hi = 0
+            return
+        boundary = np.empty(n, dtype=bool)
+        boundary[0] = True
+        # a value diff only splits groups when NEITHER row is NULL: the
+        # stored values under a null mask are garbage, and all NULL keys
+        # form ONE group (kernels._group_agg_kernel applies the same
+        # ~(m & m) guard)
+        boundary[1:] = ((sv[1:] != sv[:-1]) & ~(sn[1:] & sn[:-1])) \
+            | (sn[1:] != sn[:-1])
+        starts = np.nonzero(boundary)[0]
+        ends = np.empty(len(starts), dtype=np.int64)
+        ends[:-1] = starts[1:] - 1
+        ends[-1] = n - 1
+        self.order = order
+        self.ends = ends
+        self.gkeys = sv[ends]
+        self.gkey_null = sn[ends]
+        self.n_groups = len(ends)
+        nn = self.gkeys[~self.gkey_null]
+        self.lo = int(nn.min()) if len(nn) else 0
+        self.hi = int(nn.max()) if len(nn) else 0
+
+    def pos_table(self) -> Optional[np.ndarray]:
+        """Dense key -> group index (int32), -1 for absent keys; None when
+        the key range is too wide for a dense table."""
+        rng = self.hi - self.lo + 1
+        if rng > MAX_DENSE_RANGE:
+            return None
+        tbl = np.full(rng, -1, dtype=np.int32)
+        live = ~self.gkey_null
+        tbl[self.gkeys[live] - self.lo] = np.nonzero(live)[0]
+        return tbl
+
+
+def _group_index(rep, sid, vals, nulls) -> GroupIndex:
+    return rep.memo(("groupindex", sid), lambda: GroupIndex(vals, nulls))
+
+
+def _col_bounds(rep, sid, vals, nulls):
+    """Host min/max of a replica int column's non-null values."""
+    def build():
+        nn = vals[~nulls]
+        if len(nn) == 0:
+            return None
+        return int(nn.min()), int(nn.max())
+    return rep.memo(("bounds", sid), build)
+
+
+def _rep_pos_table(rep, sid, vals, nulls):
+    """Dense key -> row index table for a UNIQUE replica column (the
+    planner proves uniqueness: pk / single-column unique index)."""
+    def build():
+        b = _col_bounds(rep, sid, vals, nulls)
+        if b is None:
+            return None
+        lo, hi = b
+        rng = hi - lo + 1
+        if rng > MAX_DENSE_RANGE:
+            return None
+        tbl = np.full(rng, -1, dtype=np.int32)
+        live = ~nulls
+        tbl[vals[live] - lo] = np.nonzero(live)[0].astype(np.int32)
+        return lo, hi, tbl
+    return rep.memo(("postable", sid), build)
+
+
+# =========================================================================
+# compiled nodes
+# =========================================================================
+
+class _Ctx:
+    """Per-query compile context."""
+
+    def __init__(self, exec_ctx):
+        self.exec_ctx = exec_ctx
+
+
+def _jn():
+    return kernels.jnp()
+
+
+def _dev_upload(rep, key, build_np):
+    jn = _jn()
+    return rep.memo(key, lambda: jn.asarray(build_np()))
+
+
+class _ReplicaLeaf:
+    """Full-table scan from the columnar replica: device columns are
+    version-memoized uploads; scan filters become the validity mask
+    (device program with params)."""
+
+    def __init__(self, reader_exec, plan):
+        self.ex = reader_exec
+        self.plan = plan
+        self._rep = None  # set at run(): take_raw_replica consumes the reader
+
+    @staticmethod
+    def compile(plan: PhysicalTableReader, ctx: _Ctx):
+        from .executors import TableReaderExec
+        scan = plan.scan
+        if scan.ranges is not None or scan.pushed_agg is not None \
+                or scan.pushed_topn is not None \
+                or scan.pushed_limit is not None:
+            return None
+        ex = TableReaderExec(plan)
+        ex.open(ctx.exec_ctx)
+        if ex._replica is None:
+            ex.close()
+            return None
+        return _ReplicaLeaf(ex, plan)
+
+    def run(self) -> Optional[DevView]:
+        from .tpu_executors import (_build_device_mask, _rep_string_dict,
+                                    _slot_id)
+        chk, filters, rep = self.ex.take_raw_replica()
+        if chk is None:
+            return None
+        self._rep = rep
+        n = chk.full_rows()
+        nb = kernels.bucket(max(n, 1))
+        jn = _jn()
+        dm = _build_device_mask(self.ex, rep, chk, filters)
+        if dm is None:
+            return None
+        mask_fn, mask_key, params, _needed = dm
+        cols: List[DevCol] = []
+        for idx, c in enumerate(chk.columns):
+            v = c.values()
+            m = c.null_mask()
+            sid = _slot_id(self.ex, idx)
+            dn = _dev_upload(rep, ("devn", sid, nb),
+                             lambda m=m: kernels.pad1(m, nb, True))
+            if v.dtype == object or v.dtype.kind == "U":
+                got = _rep_string_dict(rep, sid, chk, idx)
+                codes, _card, _, uniques = got
+                dv = _dev_upload(rep, ("devcodes", sid, nb),
+                                 lambda c=codes: kernels.pad1(c, nb))
+                cols.append(DevCol(dv, dn, c.ft, decode=uniques))
+            else:
+                dv = _dev_upload(rep, ("devv", sid, nb),
+                                 lambda v=v: kernels.pad1(v, nb))
+                cols.append(DevCol(dv, dn, c.ft))
+        key = ("leafmask", mask_key, nb)
+        ent = _JIT_CACHE.get(key)
+        if ent is None:
+            jx = kernels.jax()
+
+            def kernel(pairs, pr):
+                return mask_fn(pairs, pr, jn.arange(nb))
+            ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
+        fn, _ = ent
+        pi, pf = params
+        valid = fn([(c.vals, c.null) for c in cols],
+                   (jn.asarray(pi), jn.asarray(pf)))
+        return DevView(cols, valid, nb)
+
+    # host info the parent join/agg stages need (valid after run())
+    def replica(self):
+        return self._rep if self._rep is not None else self.ex._replica
+
+    def close(self):
+        self.ex.close()
+
+
+class _HostLeaf:
+    """Any unsupported subtree: run its regular executor, upload the
+    materialized chunk (H2D is cheap; this is the CPU->TPU boundary).
+    Numeric columns only — a string column here would need a per-query
+    dictionary build, which defeats the point."""
+
+    def __init__(self, child_exec, plan):
+        self.ex = child_exec
+        self.plan = plan
+
+    @staticmethod
+    def compile(plan, ctx: _Ctx):
+        for c in plan.schema.columns:
+            if c.ret_type.eval_type is EvalType.STRING:
+                return None
+        if _contains_join(plan):
+            # an unsupported JOIN subtree as a host leaf would nest
+            # another DevPipeExec inside (materialize + re-upload per
+            # layer); bail the whole pipeline instead — the per-operator
+            # executors handle that shape without the extra round trips
+            return None
+        from .executors import build_executor
+        ex = build_executor(plan, True)
+        if ex is None:
+            return None
+        ex.open(ctx.exec_ctx)
+        return _HostLeaf(ex, plan)
+
+    def run(self) -> Optional[DevView]:
+        from .tpu_executors import _drain_chunk
+        chk = _drain_chunk(self.ex, self.ex.field_types()).compact()
+        n = chk.num_rows()
+        nb = kernels.bucket(max(n, 1))
+        jn = _jn()
+        cols = []
+        for c, oc in zip(chk.columns, self.plan.schema.columns):
+            v = c.values()
+            m = c.null_mask()
+            cols.append(DevCol(jn.asarray(kernels.pad1(v, nb)),
+                               jn.asarray(kernels.pad1(m, nb, True)),
+                               oc.ret_type))
+        valid = jn.asarray(kernels.pad1(np.ones(n, dtype=bool), nb))
+        return DevView(cols, valid, nb)
+
+    def close(self):
+        self.ex.close()
+
+
+class _AggIndexNode:
+    """High-cardinality GROUP BY over a single int replica column, via
+    the group index: mask -> gather to sorted order -> cumsum ->
+    boundary diff.  Output view: one row per group (bucket(ng)), valid =
+    group has passing rows.  Replaces the reference's partial-agg hash
+    table (aggregate.go:355 shuffle) for the agg-pushdown build sides."""
+
+    def __init__(self, leaf: _ReplicaLeaf, plan, key_col: ExprColumn,
+                 specs, out_map):
+        self.leaf = leaf
+        self.plan = plan
+        self.key_col = key_col
+        self.specs = specs          # [("sum"|"count"|"count_star", expr|None)]
+        self.out_map = out_map      # schema slot -> ("agg", i) | ("gb",)
+        self.gidx: Optional[GroupIndex] = None
+
+    @staticmethod
+    def compile(plan: PhysicalHashAgg, ctx: _Ctx):
+        if not plan.group_by or len(plan.group_by) != 1:
+            return None
+        key = plan.group_by[0]
+        if not isinstance(key, ExprColumn) or key.eval_type is not EvalType.INT:
+            return None
+        if getattr(key.ret_type, "is_unsigned", False):
+            return None
+        child = plan.children[0]
+        if not isinstance(child, PhysicalTableReader):
+            return None
+        leaf = _ReplicaLeaf.compile(child, ctx)
+        if leaf is None:
+            return None
+        from ..expression.aggregation import AggMode
+        specs = []
+        for d in plan.aggs:
+            if d.distinct or d.mode is AggMode.FINAL:
+                # FINAL merges partial STATES (different count semantics);
+                # it never sits directly on a reader
+                leaf.close()
+                return None
+            if d.name == AGG_COUNT and isinstance(d.args[0], Constant) \
+                    and d.args[0].value is not None:
+                specs.append(("count_star", None))
+            elif d.name == AGG_COUNT and is_jittable(d.args[0]):
+                specs.append(("count", d.args[0]))
+            elif d.name == AGG_SUM and is_jittable(d.args[0]):
+                a = d.args[0]
+                if (d.ret_type.eval_type is EvalType.REAL
+                        and a.eval_type is not EvalType.REAL):
+                    from ..expression.builtins import new_function
+                    a = new_function("cast_real", [a])
+                specs.append(("sum", a))
+            else:
+                leaf.close()
+                return None
+        # schema slots: descriptor outputs then group key (output_map)
+        out_map = []
+        for src, i in getattr(plan, "output_map", []):
+            out_map.append(("agg", i) if src == "agg" else ("gb",))
+        if len(out_map) != len(plan.schema.columns):
+            leaf.close()
+            return None
+        return _AggIndexNode(leaf, plan, key, specs, out_map)
+
+    def run(self) -> Optional[DevView]:
+        view = self.leaf.run()
+        if view is None:
+            return None
+        rep = self.leaf.replica()
+        from .tpu_executors import _slot_id
+        idx = self.key_col.index
+        sid = _slot_id(self.leaf.ex, idx)
+        kv, km = rep.columns[sid] if sid != "handle" \
+            else (rep.handles, np.zeros(rep.n_rows, dtype=bool))
+        gidx = _group_index(rep, sid, kv, km)
+        self.gidx = gidx
+        ng = gidx.n_groups
+        ngb = kernels.bucket(max(ng, 1))
+        nb = view.nb
+        jn = _jn()
+        d_order = _dev_upload(rep, ("gi_order", sid, nb),
+                              lambda: kernels.pad1(gidx.order, nb))
+        d_ends = _dev_upload(rep, ("gi_ends", sid, ngb),
+                             lambda: kernels.pad1(
+                                 gidx.ends, ngb,
+                                 fill=max(rep.n_rows - 1, 0)))
+        d_gkeys = _dev_upload(rep, ("gi_gkeys", sid, ngb),
+                              lambda: kernels.pad1(gidx.gkeys, ngb))
+        d_gknull = _dev_upload(rep, ("gi_gknull", sid, ngb),
+                               lambda: kernels.pad1(gidx.gkey_null, ngb,
+                                                    True))
+        pt = ParamTable()
+        pt.add_int(ng)
+        pt.add_int(rep.n_rows)
+        arg_fns = []
+        keys = []
+        for kind, a in self.specs:
+            if a is None:
+                arg_fns.append(None)
+                keys.append(kind)
+            else:
+                arg_fns.append(compile_expr_params(a, pt))
+                keys.append(f"{kind}:{stable_shape_key(a)}")
+        key = ("aggindex", tuple(keys), nb, ngb)
+        ent = _JIT_CACHE.get(key)
+        if ent is None:
+            jx = kernels.jax()
+            spec_kinds = [k for k, _ in self.specs]
+
+            def kernel(pairs, valid, order, ends, pr):
+                # padded sorted positions map to row 0 via the padded
+                # order array — they MUST be masked or row 0 is counted
+                # once per padding slot
+                in_table = jn.arange(nb) < pr[0][1]
+                valid_s = valid[order] & in_table
+                prev = jn.concatenate([jn.full((1,), -1, dtype=jn.int64),
+                                       ends[:-1]])
+                prev_safe = jn.maximum(prev, 0)
+
+                def seg(x_s):
+                    c = jn.cumsum(x_s)
+                    hi = c[ends]
+                    lo = jn.where(prev >= 0, c[prev_safe],
+                                  jn.zeros((), dtype=x_s.dtype))
+                    return hi - lo
+                presence = seg(valid_s.astype(jn.int64))
+                outs = []
+                for kind, af in zip(spec_kinds, arg_fns):
+                    if kind == "count_star":
+                        outs.append((presence,
+                                     jn.zeros(ngb, dtype=bool)))
+                        continue
+                    av, an = af(pairs, pr)
+                    live_s = (valid & ~an)[order] & in_table
+                    cnt = seg(live_s.astype(jn.int64))
+                    if kind == "count":
+                        outs.append((cnt, jn.zeros(ngb, dtype=bool)))
+                    else:  # sum
+                        av_s = jn.where(live_s, av[order], 0)
+                        outs.append((seg(av_s), cnt == 0))
+                gvalid = (jn.arange(ngb) < pr[0][0]) & (presence > 0)
+                return gvalid, outs
+            ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
+        fn, _ = ent
+        pi, pf = pt.arrays()
+        gvalid, outs = fn(view.pairs(), view.valid, d_order, d_ends,
+                          (jn.asarray(pi), jn.asarray(pf)))
+        # assemble output view per plan schema
+        cols: List[DevCol] = []
+        for slot, oc in zip(self.out_map, self.plan.schema.columns):
+            if slot[0] == "agg":
+                v, m = outs[slot[1]]
+                cols.append(DevCol(v, m, oc.ret_type))
+            else:
+                cols.append(DevCol(d_gkeys, d_gknull, oc.ret_type))
+        return DevView(cols, gvalid, ngb)
+
+    def build_key_info(self):
+        """(lo, hi, pos_table np) for the parent join — static per
+        replica version."""
+        rep = self.leaf.replica()
+
+        def mk():
+            tbl = self.gidx.pos_table()
+            if tbl is None:
+                return None
+            return self.gidx.lo, self.gidx.hi, tbl
+        from .tpu_executors import _slot_id
+        sid = _slot_id(self.leaf.ex, self.key_col.index)
+        return rep.memo(("gi_postable", sid), mk)
+
+    def key_slot(self) -> int:
+        """Schema slot of the group key in the output view."""
+        for i, slot in enumerate(self.out_map):
+            if slot[0] == "gb":
+                return i
+        return -1
+
+    def close(self):
+        self.leaf.close()
+
+
+class _JoinNode:
+    """Equi-join with a planner-proven-unique build side: dense position
+    table + gather.  Output = probe-shaped view with build columns
+    gathered per match."""
+
+    def __init__(self, probe, build, probe_key, build_key, tp,
+                 probe_is_left, plan):
+        self.probe = probe
+        self.build = build
+        self.probe_key = probe_key
+        self.build_key = build_key
+        self.tp = tp
+        self.probe_is_left = probe_is_left
+        self.plan = plan
+
+    @staticmethod
+    def compile(plan: PhysicalHashJoin, ctx: _Ctx):
+        if isinstance(plan, PhysicalMergeJoin):
+            return None
+        if plan.tp not in ("inner", "left"):
+            return None
+        if len(plan.left_keys) != 1 or plan.other_conditions:
+            return None
+        lk, rk = plan.left_keys[0], plan.right_keys[0]
+        if not (isinstance(lk, ExprColumn) and isinstance(rk, ExprColumn)):
+            return None
+        for k in (lk, rk):
+            if k.eval_type is not EvalType.INT \
+                    or getattr(k.ret_type, "is_unsigned", False):
+                return None
+        if getattr(plan, "left_conditions", None) \
+                or getattr(plan, "right_conditions", None):
+            return None  # side conds live in Selections below by now
+        if getattr(plan, "right_unique", False):
+            build_side, probe_side = 1, 0
+            build_key, probe_key = rk, lk
+        elif getattr(plan, "left_unique", False) and plan.tp == "inner":
+            build_side, probe_side = 0, 1
+            build_key, probe_key = lk, rk
+        else:
+            return None
+        build = _compile_node(plan.children[build_side], ctx)
+        if build is None:
+            return None
+        if not _has_build_key_info(build, build_key):
+            _close_node(build)
+            return None
+        probe = _compile_node(plan.children[probe_side], ctx)
+        if probe is None:
+            _close_node(build)
+            return None
+        return _JoinNode(probe, build, probe_key, build_key, plan.tp,
+                         probe_side == 0, plan)
+
+    def run(self) -> Optional[DevView]:
+        bview = self.build.run()
+        if bview is None:
+            return None
+        info = _build_key_info(self.build, self.build_key, bview)
+        if info is None:
+            return None
+        lo, hi, d_tbl = info
+        pview = self.probe.run()
+        if pview is None:
+            return None
+        jn = _jn()
+        nb = pview.nb
+        tbl_len = int(d_tbl.shape[0])
+        nbb = bview.nb
+        pk_slot = self.probe_key.index
+        pt = ParamTable()
+        pt.add_int(lo)
+        pt.add_int(hi)
+        outer = self.tp == "left"
+        key = ("join", nb, nbb, tbl_len, pk_slot, outer,
+               len(bview.cols))
+        ent = _JIT_CACHE.get(key)
+        if ent is None:
+            jx = kernels.jax()
+
+            def kernel(ppairs, pvalid, bpairs, bvalid, tbl, pr):
+                kp, knull = ppairs[pk_slot]
+                lo_p, hi_p = pr[0][0], pr[0][1]
+                inr = (kp >= lo_p) & (kp <= hi_p) & ~knull
+                pos0 = jn.clip(kp - lo_p, 0, tbl_len - 1)
+                pos = jn.where(inr, tbl[pos0].astype(jn.int64), -1)
+                pos_safe = jn.clip(pos, 0, nbb - 1)
+                match = (pos >= 0) & bvalid[pos_safe]
+                if outer:
+                    valid_out = pvalid
+                else:
+                    valid_out = pvalid & match
+                gathered = []
+                for bv, bn in bpairs:
+                    gv = bv[pos_safe]
+                    gn = bn[pos_safe] | ~match
+                    gathered.append((gv, gn))
+                return valid_out, gathered
+            ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
+        fn, _ = ent
+        pi, pf = pt.arrays()
+        valid_out, gathered = fn(pview.pairs(), pview.valid,
+                                 bview.pairs(), bview.valid, d_tbl,
+                                 (jn.asarray(pi), jn.asarray(pf)))
+        bcols = [DevCol(v, m, c.ret_type, c.decode)
+                 for (v, m), c in zip(gathered, bview.cols)]
+        if self.probe_is_left:
+            cols = pview.cols + bcols
+        else:
+            cols = bcols + pview.cols
+        return DevView(cols, valid_out, nb)
+
+    def close(self):
+        _close_node(self.probe)
+        _close_node(self.build)
+
+
+def _has_build_key_info(node, build_key) -> bool:
+    if isinstance(node, _AggIndexNode):
+        return node.key_slot() == build_key.index
+    if isinstance(node, (_ReplicaLeaf,)):
+        return True  # bounds checked at run time
+    if isinstance(node, (_SelNode,)):
+        return _has_build_key_info(node.child, build_key)
+    return False
+
+
+def _build_key_info(node, build_key, bview):
+    """(lo, hi, device pos-table) mapping build-key value -> view row."""
+    jn = _jn()
+    if isinstance(node, _AggIndexNode):
+        got = node.build_key_info()
+        if got is None:
+            return None
+        lo, hi, tbl = got
+        rep = node.leaf.replica()
+        from .tpu_executors import _slot_id
+        sid = _slot_id(node.leaf.ex, node.key_col.index)
+        d = _dev_upload(rep, ("gi_postable_dev", sid), lambda: tbl)
+        return lo, hi, d
+    if isinstance(node, _SelNode):
+        return _build_key_info(node.child, build_key, bview)
+    if isinstance(node, _ReplicaLeaf):
+        rep = node.replica()
+        if rep is None:
+            return None
+        from .tpu_executors import _slot_id
+        sid = _slot_id(node.ex, build_key.index)
+        if sid == "handle":
+            kv, km = rep.handles, np.zeros(rep.n_rows, dtype=bool)
+        else:
+            kv, km = rep.columns[sid]
+        got = _rep_pos_table(rep, sid, kv, km)
+        if got is None:
+            return None
+        lo, hi, tbl = got
+        d = _dev_upload(rep, ("postable_dev", sid), lambda: tbl)
+        return lo, hi, d
+    return None
+
+
+class _SelNode:
+    """Filter over a device view: conditions AND into the validity mask."""
+
+    def __init__(self, child, conds, plan):
+        self.child = child
+        self.conds = conds
+        self.plan = plan
+
+    @staticmethod
+    def compile(plan: PhysicalSelection, ctx: _Ctx):
+        if not all(is_jittable(c) for c in plan.conditions):
+            return None
+        child = _compile_node(plan.children[0], ctx)
+        if child is None:
+            return None
+        return _SelNode(child, plan.conditions, plan)
+
+    def run(self) -> Optional[DevView]:
+        view = self.child.run()
+        if view is None:
+            return None
+        jn = _jn()
+        pt = ParamTable()
+        fns = [compile_expr_params(c, pt) for c in self.conds]
+        keys = tuple(stable_shape_key(c) for c in self.conds)
+        key = ("sel", keys, view.nb, len(view.cols))
+        ent = _JIT_CACHE.get(key)
+        if ent is None:
+            jx = kernels.jax()
+
+            def kernel(pairs, valid, pr):
+                m = valid
+                for f in fns:
+                    v, null = f(pairs, pr)
+                    m = m & (v != 0) & ~null
+                return m
+            ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
+        fn, _ = ent
+        pi, pf = pt.arrays()
+        valid = fn(view.pairs(), view.valid,
+                   (jn.asarray(pi), jn.asarray(pf)))
+        return DevView(view.cols, valid, view.nb)
+
+    def close(self):
+        _close_node(self.child)
+
+
+class _ProjNode:
+    """Projection over a device view; string columns pass through as
+    bare column references (codes + decode)."""
+
+    def __init__(self, child, exprs, plan):
+        self.child = child
+        self.exprs = exprs
+        self.plan = plan
+
+    @staticmethod
+    def compile(plan: PhysicalProjection, ctx: _Ctx):
+        for e in plan.exprs:
+            if is_jittable(e):
+                continue
+            if isinstance(e, ExprColumn) and e.eval_type is EvalType.STRING:
+                continue
+            return None
+        child = _compile_node(plan.children[0], ctx)
+        if child is None:
+            return None
+        return _ProjNode(child, plan.exprs, plan)
+
+    def run(self) -> Optional[DevView]:
+        view = self.child.run()
+        if view is None:
+            return None
+        jn = _jn()
+        pt = ParamTable()
+        fns = []
+        keys = []
+        for e in self.exprs:
+            if isinstance(e, ExprColumn):
+                fns.append(("col", e.index))
+                keys.append(f"@{e.index}")
+            else:
+                fns.append(("fn", compile_expr_params(e, pt)))
+                keys.append(stable_shape_key(e))
+        key = ("proj", tuple(keys), view.nb, len(view.cols))
+        ent = _JIT_CACHE.get(key)
+        if ent is None:
+            jx = kernels.jax()
+
+            def kernel(pairs, pr):
+                outs = []
+                for kind, f in fns:
+                    if kind == "col":
+                        outs.append(pairs[f])
+                    else:
+                        outs.append(f(pairs, pr))
+                return outs
+            ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
+        fn, _ = ent
+        pi, pf = pt.arrays()
+        outs = fn(view.pairs(), (jn.asarray(pi), jn.asarray(pf)))
+        cols = []
+        for (v, m), e, oc in zip(outs, self.exprs,
+                                 self.plan.schema.columns):
+            decode = None
+            if isinstance(e, ExprColumn):
+                decode = view.cols[e.index].decode
+            cols.append(DevCol(v, m, oc.ret_type, decode))
+        return DevView(cols, view.valid, view.nb)
+
+    def close(self):
+        _close_node(self.child)
+
+
+def _sort_ops(jn, keys, descs, valid):
+    """lexsort operand list: requested keys (NULL first asc / last desc),
+    invalid rows last.  keys = [(vals, null)] — ints/codes/floats."""
+    ops = []
+    for i in range(len(keys) - 1, -1, -1):
+        v, m = keys[i]
+        desc = descs[i]
+        vv = jn.where(m, 0, v)
+        if desc:
+            # ~v is the overflow-free order-reversing bijection on int64
+            vv = ~vv if vv.dtype == jn.int64 else -vv
+            rank = jn.where(m, 1, 0).astype(jn.int8)  # NULL last
+        else:
+            rank = jn.where(m, 0, 1).astype(jn.int8)  # NULL first
+        ops.append(vv)
+        ops.append(rank)
+    ops.append(jn.where(valid, 0, 1).astype(jn.int8))  # invalid last
+    return ops
+
+
+class _OrderNode:
+    """TopN (static offset/count slice after lexsort — valid rows sort
+    first, so perm[offset : offset+count_bucket] IS the answer) or full
+    Sort over a view."""
+
+    def __init__(self, child, by, offset, count, plan):
+        self.child = child
+        self.by = by
+        self.off = offset        # None = full sort
+        self.count = count
+        self.plan = plan
+
+    @staticmethod
+    def compile(plan, ctx: _Ctx):
+        by = plan.by
+        for e, _ in by:
+            if is_jittable(e):
+                continue
+            if isinstance(e, ExprColumn) and e.eval_type is EvalType.STRING:
+                continue
+            return None
+        child = _compile_node(plan.children[0], ctx)
+        if child is None:
+            return None
+        off = count = None
+        if isinstance(plan, PhysicalTopN):
+            off, count = plan.offset, plan.count
+        return _OrderNode(child, by, off, count, plan)
+
+    def run(self) -> Optional[DevView]:
+        view = self.child.run()
+        if view is None:
+            return None
+        jn = _jn()
+        pt = ParamTable()
+        fns = []
+        keys = []
+        for e, desc in self.by:
+            if isinstance(e, ExprColumn):
+                fns.append(("col", e.index))
+                keys.append(f"@{e.index}:{desc}")
+            else:
+                fns.append(("fn", compile_expr_params(e, pt)))
+                keys.append(f"{stable_shape_key(e)}:{desc}")
+        descs = tuple(d for _, d in self.by)
+        if self.off is None:
+            off, kb = 0, view.nb
+        else:
+            off = min(self.off, view.nb)
+            kb = min(kernels.bucket(max(self.count, 1)) + off, view.nb)
+        count = self.count
+        key = ("order", tuple(keys), off, kb, count, view.nb,
+               len(view.cols))
+        ent = _JIT_CACHE.get(key)
+        if ent is None:
+            jx = kernels.jax()
+
+            def kernel(pairs, valid, pr):
+                kvs = []
+                for kind, f in fns:
+                    if kind == "col":
+                        kvs.append(pairs[f])
+                    else:
+                        kvs.append(f(pairs, pr))
+                perm = jn.lexsort(_sort_ops(jn, kvs, descs, valid))
+                take = perm[off:kb]
+                out_valid = valid[take]
+                if count is not None:
+                    # valid rows sort first, so the taken valid rows are a
+                    # prefix; cap it at `count`
+                    out_valid = out_valid & (jn.arange(kb - off) < count)
+                outs = [(v[take], m[take]) for v, m in pairs]
+                return out_valid, outs
+            ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
+        fn, _ = ent
+        pi, pf = pt.arrays()
+        out_valid, outs = fn(view.pairs(), view.valid,
+                             (jn.asarray(pi), jn.asarray(pf)))
+        cols = [DevCol(v, m, c.ret_type, c.decode)
+                for (v, m), c in zip(outs, view.cols)]
+        return DevView(cols, out_valid, kb - off)
+
+    def close(self):
+        _close_node(self.child)
+
+
+class _LimitNode:
+    def __init__(self, child, plan):
+        self.child = child
+        self.plan = plan
+
+    @staticmethod
+    def compile(plan: PhysicalLimit, ctx: _Ctx):
+        child = _compile_node(plan.children[0], ctx)
+        if child is None:
+            return None
+        return _LimitNode(child, plan)
+
+    def run(self) -> Optional[DevView]:
+        view = self.child.run()
+        if view is None:
+            return None
+        jn = _jn()
+        pt = ParamTable()
+        pt.add_int(self.plan.offset)
+        pt.add_int(self.plan.offset + self.plan.count)
+        key = ("limit", view.nb)
+        ent = _JIT_CACHE.get(key)
+        if ent is None:
+            jx = kernels.jax()
+
+            def kernel(valid, pr):
+                rank = jn.cumsum(valid.astype(jn.int64))
+                return valid & (rank > pr[0][0]) & (rank <= pr[0][1])
+            ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
+        fn, _ = ent
+        pi, pf = pt.arrays()
+        valid = fn(view.valid, (jn.asarray(pi), jn.asarray(pf)))
+        return DevView(view.cols, valid, view.nb)
+
+    def close(self):
+        _close_node(self.child)
+
+
+def _close_node(node):
+    if node is not None and hasattr(node, "close"):
+        node.close()
+
+
+def _compile_node(plan, ctx: _Ctx):
+    """Compile a plan subtree to a device node, or wrap it as a host
+    leaf.  Returns None only for structural impossibilities at the
+    ROOT of the requested subtree (callers fall back entirely)."""
+    node = _compile_device(plan, ctx)
+    if node is not None:
+        return node
+    return _HostLeaf.compile(plan, ctx)
+
+
+def _compile_device(plan, ctx: _Ctx):
+    if isinstance(plan, PhysicalTableReader):
+        return _ReplicaLeaf.compile(plan, ctx)
+    if isinstance(plan, PhysicalHashAgg):
+        return _AggIndexNode.compile(plan, ctx)
+    if isinstance(plan, PhysicalHashJoin):
+        return _JoinNode.compile(plan, ctx)
+    if isinstance(plan, PhysicalSelection):
+        return _SelNode.compile(plan, ctx)
+    if isinstance(plan, PhysicalProjection):
+        return _ProjNode.compile(plan, ctx)
+    if isinstance(plan, (PhysicalTopN, PhysicalSort)):
+        return _OrderNode.compile(plan, ctx)
+    if isinstance(plan, PhysicalLimit):
+        return _LimitNode.compile(plan, ctx)
+    return None
+
+
+def _contains_join(plan) -> bool:
+    if isinstance(plan, PhysicalHashJoin) \
+            and not isinstance(plan, PhysicalMergeJoin):
+        return True
+    return any(_contains_join(c) for c in plan.children)
+
+
+# =========================================================================
+# materialization: the ONE device->host transfer of the pipeline
+# =========================================================================
+
+def materialize(view: DevView) -> Chunk:
+    jn = _jn()
+    nb = view.nb
+    items = []
+    for c in view.cols:
+        items.append(c.vals)
+        items.append(c.null)
+    if nb <= kernels.SMALL_PACK:
+        vals = kernels._slice_pack([view.valid] + items, nb)
+        keep = np.nonzero(vals[0])[0]
+        host = [(vals[1 + 2 * i][keep], vals[2 + 2 * i][keep])
+                for i in range(len(view.cols))]
+    else:
+        key = ("nvalid", nb)
+        ent = _JIT_CACHE.get(key)
+        if ent is None:
+            jx = kernels.jax()
+            ent = _JIT_CACHE[key] = (
+                jx.jit(lambda v: jn.sum(v.astype(jn.int64))), None)
+        n_valid = int(ent[0](view.valid))
+        if n_valid == 0:
+            host = [(np.empty(0, dtype=np.int64),
+                     np.empty(0, dtype=bool))] * len(view.cols)
+        else:
+            ob = min(kernels.bucket(n_valid), nb)
+            _ids, vals = kernels._present_pack(
+                view.valid.astype(jn.int64), items, ob)
+            host = [(vals[2 * i][:n_valid], vals[2 * i + 1][:n_valid])
+                    for i in range(len(view.cols))]
+    cols = []
+    for (v, m), c in zip(host, view.cols):
+        if c.decode is not None:
+            card = len(c.decode)
+            safe = np.where(m | (v < 0) | (v >= card), 0, v)
+            out = np.empty(len(v), dtype=object)
+            for r in range(len(v)):
+                out[r] = None if m[r] else str(c.decode[safe[r]])
+            cols.append(CCol.from_numpy(c.ret_type, out, m))
+        else:
+            vv = v
+            if c.ret_type.eval_type is EvalType.REAL \
+                    and vv.dtype != np.float64:
+                vv = vv.astype(np.float64)
+            cols.append(CCol.from_numpy(c.ret_type, vv, m))
+    return Chunk.from_columns(cols)
+
+
+# =========================================================================
+# executor wrapper
+# =========================================================================
+
+class DevPipeExec:
+    """Volcano-compatible wrapper: compiles the subtree at open(), runs
+    the device pipeline once at first next().  Falls back to the regular
+    TPU/CPU executors when compilation bails (structurally or at run
+    time)."""
+
+    def __init__(self, plan, fallback_builder: Callable):
+        self.plan = plan
+        self.schema = plan.schema
+        self.children = []
+        self._fallback_builder = fallback_builder
+        self._fallback = None
+        self._node = None
+        self._done = False
+
+    def field_types(self):
+        return [c.ret_type for c in self.plan.schema.columns]
+
+    def open(self, ctx):
+        self.ctx = ctx
+        self._done = False
+        cctx = _Ctx(ctx)
+        try:
+            self._node = _compile_device(self.plan, cctx)
+        except Exception:
+            self._node = None
+        if self._node is None:
+            self._open_fallback(ctx)
+
+    def _open_fallback(self, ctx):
+        self._fallback = self._fallback_builder(self.plan)
+        self._fallback.open(ctx)
+
+    def next(self) -> Optional[Chunk]:
+        if self._fallback is not None:
+            return self._fallback.next()
+        if self._done:
+            return None
+        self._done = True
+        try:
+            view = self._node.run()
+            out = materialize(view) if view is not None else None
+        except Exception:
+            view = out = None  # device died mid-run: fall back whole
+        if view is None:
+            # runtime bail (replica vanished, device error): rebuild on
+            # the per-operator executors, which carry their own fallbacks
+            _close_node(self._node)
+            self._node = None
+            self._open_fallback(self.ctx)
+            return self._fallback.next()
+        return out if out.num_rows() else None
+
+    def drain(self) -> List[list]:
+        rows = []
+        while True:
+            chk = self.next()
+            if chk is None:
+                break
+            rows.extend(chk.to_rows())
+        return rows
+
+    def close(self):
+        if self._fallback is not None:
+            self._fallback.close()
+        _close_node(self._node)

@@ -7,7 +7,8 @@ HashJoinExec (join.go — build :149 / probe :244 stubs implemented),
 HashAggExec (aggregate.go — shuffle :355 / consume :425 stubs implemented),
 SortExec/TopNExec (sort.go), ProjectionExec, LimitExec, TableDualExec.
 The numpy-vectorized inner loops are the CPU fallback tier; the TPU tier
-(executor/tpu.py) swaps in device kernels behind the same interface.
+(executor/tpu_executors.py per-operator kernels, executor/devpipe.py
+whole-subtree device pipelines) swaps in behind the same interface.
 """
 from __future__ import annotations
 
@@ -1193,9 +1194,9 @@ def build_executor(plan: PhysicalPlan, use_tpu: bool = False) -> Executor:
     """Physical plan -> executor tree (reference: executor/builder.go:69-117).
     With use_tpu, the big four operators come from the TPU tier when the
     plan's device enforcer marked them eligible."""
-    if use_tpu:
-        from .tpu import try_build_tpu
-        ex = try_build_tpu(plan)
+    if use_tpu and getattr(plan, "use_tpu", False):
+        from .tpu_executors import build_tpu_executor
+        ex = build_tpu_executor(plan)
         if ex is not None:
             return ex
     if isinstance(plan, PhysicalTableReader):

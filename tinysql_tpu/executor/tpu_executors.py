@@ -1142,6 +1142,17 @@ def _marshal(chk: Chunk):
 
 
 def build_tpu_executor(plan) -> Optional[Executor]:
+    """TPU-tier builder.  Subtrees containing a supported join compile
+    into a device-resident pipeline (devpipe) with the per-operator
+    executors as fallback; lone operators use the per-op executors
+    (whose fused paths are already single-program)."""
+    from .devpipe import DevPipeExec, _contains_join
+    if _contains_join(plan):
+        return DevPipeExec(plan, _build_tpu_op)
+    return _build_tpu_op(plan)
+
+
+def _build_tpu_op(plan) -> Optional[Executor]:
     if isinstance(plan, PhysicalHashAgg):
         return TPUHashAggExec(plan, build_executor(plan.children[0], True))
     if isinstance(plan, PhysicalHashJoin):
