@@ -17,8 +17,10 @@ def tk():
     s = new_session()
     s.execute("create database d")
     s.execute("use d")
-    # small fixtures must still route to the device tier under test
+    # small fixtures must still route to the device tier under test,
+    # and the CPU-backend CI mesh must still exercise the pipelines
     s.execute("set @@tidb_tpu_min_rows = 0")
+    s.execute("set @@tidb_devpipe = 1")
     yield s
 
 

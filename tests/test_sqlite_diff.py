@@ -144,6 +144,7 @@ def engines():
     s = new_session()
     s.execute("create database fuzz")
     s.execute("set @@tidb_tpu_min_rows = 0")
+    s.execute("set @@tidb_devpipe = 1")
     s.execute("use fuzz")
     s.execute("create table t (a int primary key, b int, c double, "
               "d varchar(12), key ib (b))")

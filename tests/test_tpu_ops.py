@@ -20,6 +20,7 @@ def tks():
     tpu.must_exec("create database test")
     tpu.must_exec("use test")
     tpu.must_exec("set @@tidb_tpu_min_rows = 0")  # tiny CI data on device
+    tpu.must_exec("set @@tidb_devpipe = 1")
     cpu = TestKit(storage, "test")
     cpu.must_exec("set @@tidb_use_tpu = 0")
 

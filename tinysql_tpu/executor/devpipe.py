@@ -1037,6 +1037,10 @@ class DevPipeExec:
     def open(self, ctx):
         self.ctx = ctx
         self._done = False
+        if not self._enabled(ctx):
+            self._node = None
+            self._open_fallback(ctx)
+            return
         cctx = _Ctx(ctx)
         try:
             self._node = _compile_device(self.plan, cctx)
@@ -1044,6 +1048,21 @@ class DevPipeExec:
             self._node = None
         if self._node is None:
             self._open_fallback(ctx)
+
+    @staticmethod
+    def _enabled(ctx) -> bool:
+        """Pipelines win where transfers dominate (real devices).  On the
+        XLA:CPU backend the compact numpy per-operator tier is faster, so
+        auto mode engages only off-cpu; tests force with tidb_devpipe=1."""
+        mode = int(ctx.session_vars.get("tidb_devpipe", -1) or -1)
+        if mode == 0:
+            return False
+        if mode == 1:
+            return True
+        try:
+            return kernels.jax().default_backend() != "cpu"
+        except Exception:
+            return False
 
     def _open_fallback(self, ctx):
         self._fallback = self._fallback_builder(self.plan)
