@@ -182,8 +182,14 @@ def _rep_pos_table(rep, sid, vals, nulls):
 class _Ctx:
     """Per-query compile context."""
 
-    def __init__(self, exec_ctx):
+    def __init__(self, exec_ctx, mesh=None):
         self.exec_ctx = exec_ctx
+        self.mesh = mesh  # multi-chip mesh (tidb_mesh_parallel) or None
+
+
+def mesh_if_enabled(session_vars):
+    from ..parallel import dist
+    return dist.session_mesh(session_vars)
 
 
 def _jn():
@@ -508,7 +514,7 @@ class _JoinNode:
     gathered per match."""
 
     def __init__(self, probe, build, probe_key, build_key, tp,
-                 probe_is_left, plan):
+                 probe_is_left, plan, mesh=None):
         self.probe = probe
         self.build = build
         self.probe_key = probe_key
@@ -516,6 +522,8 @@ class _JoinNode:
         self.tp = tp
         self.probe_is_left = probe_is_left
         self.plan = plan
+        self.mesh = mesh
+        self.n_mesh = int(mesh.devices.size) if mesh is not None else 0
 
     @staticmethod
     def compile(plan: PhysicalHashJoin, ctx: _Ctx):
@@ -554,7 +562,7 @@ class _JoinNode:
             _close_node(build)
             return None
         return _JoinNode(probe, build, probe_key, build_key, plan.tp,
-                         probe_side == 0, plan)
+                         probe_side == 0, plan, mesh=ctx.mesh)
 
     def run(self) -> Optional[DevView]:
         bview = self.build.run()
@@ -576,8 +584,14 @@ class _JoinNode:
         pt.add_int(lo)
         pt.add_int(hi)
         outer = self.tp == "left"
+        # multi-chip: shard the PROBE side over the mesh, broadcast the
+        # build table + build view (SURVEY §2.11 P4: partition one side,
+        # probe rides ICI-local gathers, no cross-chip traffic per row)
+        from ..parallel import dist
+        mesh = self.mesh if dist.shardable(nb, self.mesh) else None
         key = ("join", nb, nbb, tbl_len, pk_slot, outer,
-               len(bview.cols))
+               len(bview.cols), len(pview.cols),
+               self.n_mesh if mesh is not None else 0)
         ent = _JIT_CACHE.get(key)
         if ent is None:
             jx = kernels.jax()
@@ -600,7 +614,23 @@ class _JoinNode:
                     gn = bn[pos_safe] | ~match
                     gathered.append((gv, gn))
                 return valid_out, gathered
-            ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
+            if mesh is not None:
+                from jax.sharding import PartitionSpec as P
+                try:
+                    from jax import shard_map
+                except ImportError:  # older jax
+                    from jax.experimental.shard_map import shard_map
+                pspec = [(P("shard"), P("shard"))] * len(pview.cols)
+                bspec = [(P(), P())] * len(bview.cols)
+                fn = shard_map(
+                    kernel, mesh=mesh,
+                    in_specs=(pspec, P("shard"), bspec, P(), P(),
+                              (P(), P())),
+                    out_specs=(P("shard"),
+                               [(P("shard"), P("shard"))] * len(bview.cols)))
+                ent = _JIT_CACHE[key] = (jx.jit(fn), None)
+            else:
+                ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
         fn, _ = ent
         pi, pf = pt.arrays()
         valid_out, gathered = fn(pview.pairs(), pview.valid,
@@ -1041,7 +1071,7 @@ class DevPipeExec:
             self._node = None
             self._open_fallback(ctx)
             return
-        cctx = _Ctx(ctx)
+        cctx = _Ctx(ctx, mesh=mesh_if_enabled(ctx.session_vars))
         try:
             self._node = _compile_device(self.plan, cctx)
         except Exception:
@@ -1054,7 +1084,8 @@ class DevPipeExec:
         """Pipelines win where transfers dominate (real devices).  On the
         XLA:CPU backend the compact numpy per-operator tier is faster, so
         auto mode engages only off-cpu; tests force with tidb_devpipe=1."""
-        mode = int(ctx.session_vars.get("tidb_devpipe", -1) or -1)
+        raw = ctx.session_vars.get("tidb_devpipe", -1)
+        mode = -1 if raw is None else int(raw)
         if mode == 0:
             return False
         if mode == 1:

@@ -261,9 +261,6 @@ def _child_input(ex: Executor) -> Chunk:
     return _drain_chunk(ex, ex.field_types()).compact()
 
 
-_MESH_CACHE = None  # one mesh per device set (kernels close over it)
-
-
 def _count_mask_program(slot: int):
     """COUNT(col) consumes only the column's null mask; the value half of
     the device pair may be absent (string columns upload masks only)."""
@@ -591,17 +588,9 @@ class TPUHashAggExec(Executor):
         """Multi-chip mesh for the sharded aggregate when the session asks
         for it (SET @@tidb_mesh_parallel = 1) and the bucket divides over
         the devices (power-of-two buckets over power-of-two meshes)."""
-        if not bool(self.ctx.session_vars.get("tidb_mesh_parallel", 0)):
-            return None
-        jx = kernels.jax()
-        devs = jx.devices()
-        if len(devs) < 2 or nb % len(devs) != 0 or nb < len(devs) * 16:
-            return None
-        global _MESH_CACHE
-        if _MESH_CACHE is None or _MESH_CACHE.devices.size != len(devs):
-            from ..parallel import dist
-            _MESH_CACHE = dist.make_mesh(len(devs))
-        return _MESH_CACHE
+        from ..parallel import dist
+        mesh = dist.session_mesh(self.ctx.session_vars)
+        return mesh if dist.shardable(nb, mesh) else None
 
     @staticmethod
     def _rep_key_codes(rep, e, chk, slot_id):

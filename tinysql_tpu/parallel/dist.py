@@ -28,6 +28,33 @@ def make_mesh(n_devices: Optional[int] = None):
     return Mesh(np.array(devs[:n]), ("shard",))
 
 
+_SESSION_MESH = None
+
+
+def session_mesh(session_vars):
+    """The query-execution mesh when the session asks for multi-chip
+    (tidb_mesh_parallel) and >=2 devices exist; cached per device set.
+    Shared by every mesh-parallel tier (fused aggregate, devpipe join)."""
+    if not bool(session_vars.get("tidb_mesh_parallel", 0)):
+        return None
+    devs = kernels.jax().devices()
+    if len(devs) < 2:
+        return None
+    global _SESSION_MESH
+    if _SESSION_MESH is None or _SESSION_MESH.devices.size != len(devs):
+        _SESSION_MESH = make_mesh(len(devs))
+    return _SESSION_MESH
+
+
+def shardable(nb: int, mesh) -> bool:
+    """Row-bucket gate for sharding over `mesh`: divisible and big enough
+    to amortize the collectives."""
+    if mesh is None:
+        return False
+    n = int(mesh.devices.size)
+    return nb % n == 0 and nb >= 16 * n
+
+
 # =========================================================================
 # distributed partial/final aggregation (SURVEY §2.11 P5)
 # =========================================================================
