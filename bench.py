@@ -50,15 +50,42 @@ def main():
 
     lite = _sqlite_baseline(data)
 
+    profile_dir = os.environ.get("TPCH_PROFILE")
+
     def run(sql, tier):
         s.execute(f"set @@tidb_use_tpu = {1 if tier == 'tpu' else 0}")
         best = float("inf")
         rows = None
+        phases = {}
         for _ in range(3):
             t0 = time.time()
             rows = s.query(sql).rows
-            best = min(best, time.time() - t0)
+            dt = time.time() - t0
+            if dt < best:
+                best = dt
+                phases = dict(s.last_query_info)
+        if tier == "tpu":
+            print(f"[bench] phases parse={phases.get('parse_s', 0)*1e3:.1f}ms"
+                  f" plan={phases.get('plan_s', 0)*1e3:.1f}ms"
+                  f" exec={phases.get('exec_s', 0)*1e3:.1f}ms",
+                  file=sys.stderr)
         return best, rows
+
+    if profile_dir:
+        # one traced warm run per query: jax.profiler device trace
+        # (viewable with tensorboard / xprof) — the device-occupancy
+        # artifact; gated because the axon tunnel may not support it
+        try:
+            import jax
+            s.execute("set @@tidb_use_tpu = 1")
+            for name, sql in tpch.QUERIES.items():
+                s.query(sql)  # warm compile outside the trace
+                with jax.profiler.trace(os.path.join(profile_dir, name)):
+                    s.query(sql)
+            print(f"[bench] profiler traces in {profile_dir}",
+                  file=sys.stderr)
+        except Exception as e:  # pragma: no cover
+            print(f"[bench] profiler unavailable: {e}", file=sys.stderr)
 
     results = {}
     for name, sql in tpch.QUERIES.items():

@@ -8,6 +8,7 @@ sysvar get/set :464-523), executor/compiler.go, executor/adapter.go
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -188,17 +189,18 @@ class Session:
 
     # ---- entry -----------------------------------------------------------
     def execute(self, sql: str) -> List[Optional[ResultSet]]:
-        import time
         t0 = time.perf_counter()
         stmts = parse(sql)
         t_parse = time.perf_counter() - t0
         out = []
         for s in stmts:
             t1 = time.perf_counter()
+            self._plan_s = 0.0
             out.append(self._execute_stmt(s))
             t_exec = time.perf_counter() - t1
             self.last_query_info = {
                 "parse_s": t_parse / max(len(stmts), 1),
+                "plan_s": self._plan_s,
                 "exec_s": t_exec,
                 "total_s": t_parse / max(len(stmts), 1) + t_exec,
             }
@@ -289,11 +291,13 @@ class Session:
 
     # ---- SELECT ---------------------------------------------------------
     def _exec_select(self, stmt: ast.SelectStmt) -> ResultSet:
+        t0 = time.perf_counter()
         builder = PlanBuilder(self)
         logical = builder.build_select(stmt)
         columns = [c.name for c in logical.schema.columns]
         use_tpu = bool(self.get_sysvar("tidb_use_tpu"))
         phys = self._optimize(logical, use_tpu)
+        t_plan = time.perf_counter() - t0
         ex = build_executor(phys, use_tpu=use_tpu)
         ex.open(ExecContext(self.get_txn(), self.sysvars,
                             self.infoschema(), self.storage))
@@ -301,6 +305,9 @@ class Session:
             rows = ex.drain()
         finally:
             ex.close()
+        # compile/plan vs run split surfaces in last_query_info (the
+        # reference's DurationCompile analogue; exec_s wraps both)
+        self._plan_s = t_plan
         return ResultSet(columns, rows,
                          [c.ret_type for c in logical.schema.columns])
 
