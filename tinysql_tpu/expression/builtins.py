@@ -636,25 +636,158 @@ def _concat_vec(args, chk):
     return v, null
 
 
-def _substring_scalar(vals):
-    s = vals[0]
-    if s is None or vals[1] is None:
-        return None
-    s = to_string(s)
-    pos = to_int(vals[1])
-    ln = to_int(vals[2]) if len(vals) > 2 and vals[2] is not None else None
-    if len(vals) > 2 and vals[2] is None:
-        return None
+def _substr_of(s: str, pos: int, ln) -> str:
+    """MySQL SUBSTRING semantics: 1-based, 0 -> '', negative counts from
+    the end, length clamps at 0."""
     if pos == 0:
         return ""
     if pos < 0:
-        pos = max(len(s) + pos, 0)
+        pos = len(s) + pos
+        if pos < 0:
+            return ""
     else:
         pos -= 1
     if pos >= len(s):
         return ""
     end = len(s) if ln is None else min(pos + max(ln, 0), len(s))
     return s[pos:end]
+
+
+def _substring_scalar(vals):
+    s = vals[0]
+    if s is None or vals[1] is None:
+        return None
+    if len(vals) > 2 and vals[2] is None:
+        return None
+    ln = to_int(vals[2]) if len(vals) > 2 else None
+    return _substr_of(to_string(s), to_int(vals[1]), ln)
+
+
+def _substring_vec(args, chk):
+    """Vectorized SUBSTRING (the reference's builtin_string_vec.go:90
+    course stub, done for real): per-row pos/len may themselves be
+    vectors."""
+    s, ns = _cast_vv_to_str(args[0])
+    p, np_ = _cast_vv_to_int(args[1])
+    null = ns | np_
+    ln = lnn = None
+    if len(args) > 2:
+        ln, lnn = _cast_vv_to_int(args[2])
+        null = null | lnn
+    n = len(s)
+    v = np.empty(n, dtype=object)
+    for i in range(n):
+        if not null[i]:
+            v[i] = _substr_of(s[i], int(p[i]),
+                              None if ln is None else int(ln[i]))
+    return v, null
+
+
+def _str2(fn):
+    """Scalar + vec builders for a 2-string-arg builtin."""
+    def scalar(vals):
+        a, b = vals
+        if a is None or b is None:
+            return None
+        return fn(to_string(a), to_string(b))
+
+    def vec(args, chk):
+        a, na = _cast_vv_to_str(args[0])
+        b, nb = _cast_vv_to_str(args[1])
+        null = na | nb
+        n = len(a)
+        v = np.empty(n, dtype=object)
+        for i in range(n):
+            if not null[i]:
+                v[i] = fn(a[i], b[i])
+        return v, null
+    return scalar, vec
+
+
+def _vec_str2_int(fn):
+    def vec(args, chk):
+        a, na = _cast_vv_to_str(args[0])
+        b, nb = _cast_vv_to_str(args[1])
+        null = na | nb
+        n = len(a)
+        v = _ints(n)
+        for i in range(n):
+            if not null[i]:
+                v[i] = fn(a[i], b[i])
+        return v, null
+    return vec
+
+
+def _replace_scalar(vals):
+    s, frm, to = vals
+    if s is None or frm is None or to is None:
+        return None
+    s, frm, to = to_string(s), to_string(frm), to_string(to)
+    return s if frm == "" else s.replace(frm, to)
+
+
+def _replace_vec(args, chk):
+    s, ns = _cast_vv_to_str(args[0])
+    f, nf = _cast_vv_to_str(args[1])
+    t, nt = _cast_vv_to_str(args[2])
+    null = ns | nf | nt
+    n = len(s)
+    v = np.empty(n, dtype=object)
+    for i in range(n):
+        if not null[i]:
+            v[i] = s[i] if f[i] == "" else s[i].replace(f[i], t[i])
+    return v, null
+
+
+def _instr(s: str, sub: str) -> int:
+    return s.find(sub) + 1  # 1-based; 0 = absent ('' found at 1)
+
+
+def _locate_scalar(vals):
+    # LOCATE(substr, str[, pos]) — argument order flipped vs INSTR
+    sub, s = vals[0], vals[1]
+    if sub is None or s is None:
+        return None
+    sub, s = to_string(sub), to_string(s)
+    if len(vals) > 2:
+        if vals[2] is None:
+            return None
+        pos = to_int(vals[2])
+        if pos < 1:
+            return 0
+        found = s.find(sub, pos - 1)
+        return found + 1
+    return _instr(s, sub)
+
+
+def _pad_cut(side: str):
+    """LEFT/RIGHT(s, n)."""
+    def fn(s, n):
+        n = max(int(n), 0)
+        return s[:n] if side == "left" else (s[len(s) - n:] if n else "")
+    return fn
+
+
+def _left_right(name: str):
+    cut = _pad_cut(name)
+
+    def scalar(vals):
+        s, n = vals
+        if s is None or n is None:
+            return None
+        return cut(to_string(s), to_int(n))
+
+    def vec(args, chk):
+        s, ns = _cast_vv_to_str(args[0])
+        k, nk = _cast_vv_to_int(args[1])
+        null = ns | nk
+        n = len(s)
+        v = np.empty(n, dtype=object)
+        for i in range(n):
+            if not null[i]:
+                v[i] = cut(s[i], k[i])
+        return v, null
+    return scalar, vec
 
 
 # ===== registry / typed constructor =========================================
@@ -751,7 +884,36 @@ def new_function(name: str, args: List[Expression]) -> ScalarFunction:
         return ScalarFunction(name, args, new_string_type(),
                               _concat_scalar, _concat_vec)
     if name in ("substring", "substr", "mid"):
-        return ScalarFunction(name, args, new_string_type(), _substring_scalar)
+        return ScalarFunction(name, args, new_string_type(),
+                              _substring_scalar, _substring_vec)
+    if name == "trim":
+        return ScalarFunction(name, args, new_string_type(),
+                              _str1(lambda s: s.strip(" ")),
+                              _vec_str1(lambda s: s.strip(" ")))
+    if name == "ltrim":
+        return ScalarFunction(name, args, new_string_type(),
+                              _str1(lambda s: s.lstrip(" ")),
+                              _vec_str1(lambda s: s.lstrip(" ")))
+    if name == "rtrim":
+        return ScalarFunction(name, args, new_string_type(),
+                              _str1(lambda s: s.rstrip(" ")),
+                              _vec_str1(lambda s: s.rstrip(" ")))
+    if name == "reverse":
+        return ScalarFunction(name, args, new_string_type(),
+                              _str1(lambda s: s[::-1]),
+                              _vec_str1(lambda s: s[::-1]))
+    if name == "replace":
+        return ScalarFunction(name, args, new_string_type(),
+                              _replace_scalar, _replace_vec)
+    if name == "instr":
+        s, v = _str2(_instr)
+        return ScalarFunction(name, args, new_int_type(), s,
+                              _vec_str2_int(_instr))
+    if name in ("locate", "position"):
+        return ScalarFunction(name, args, new_int_type(), _locate_scalar)
+    if name in ("left", "right"):
+        s, v = _left_right(name)
+        return ScalarFunction(name, args, new_string_type(), s, v)
     if name == "abs":
         et = args[0].eval_type
         rt = new_int_type() if et is EvalType.INT else new_real_type()
@@ -807,4 +969,6 @@ KNOWN_SCALAR_FUNCS = {
     "length", "octet_length", "char_length", "upper", "ucase", "lower",
     "lcase", "strcmp", "concat", "substring", "substr", "mid", "abs",
     "if", "ifnull", "isnull",
+    "trim", "ltrim", "rtrim", "reverse", "replace", "instr", "locate",
+    "position", "left", "right",
 }

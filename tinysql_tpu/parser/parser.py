@@ -855,6 +855,13 @@ class Parser:
         if t.kind in (T_IDENT, T_QIDENT):
             word = t.value.lower() if t.kind == T_IDENT else None
             if word in RESERVED_NON_EXPR:
+                # LEFT( / RIGHT( / REPLACE( are function CALLS despite the
+                # words being reserved for joins/statements (MySQL allows
+                # them when directly followed by a parenthesis)
+                nxt = self._peek(1)
+                if word in ("left", "right", "replace") \
+                        and nxt.kind == T_OP and nxt.value == "(":
+                    return self._func_call()
                 raise ParseError(f"unexpected keyword {t.text!r} in expression",
                                  t.pos)
             if word == "null":
