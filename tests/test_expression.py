@@ -142,6 +142,17 @@ def test_string_builtins():
     assert new_function("substring", [Constant("hello", STR), Constant(-3, INT),
                                       Constant(2, INT)]).eval([]) == "ll"
     assert new_function("substring", [Constant("hello", STR), Constant(0, INT)]).eval([]) == ""
+    # LEFT/RIGHT with n > len(s) return the whole string (no slice wrap)
+    for fn, n, want in [("left", 2, "ab"), ("left", 5, "abc"),
+                        ("right", 2, "bc"), ("right", 5, "abc"),
+                        ("right", 0, ""), ("left", 0, "")]:
+        got = new_function(fn, [Constant("abc", STR),
+                                Constant(n, INT)]).eval([])
+        assert got == want, (fn, n, got)
+    check_vec_matches_scalar(new_function("right", [c, Constant(99, INT)]),
+                             chk)
+    check_vec_matches_scalar(new_function("left", [c, Constant(99, INT)]),
+                             chk)
 
 
 def test_div_mod_by_zero_null():

@@ -21,7 +21,10 @@ Key design points (why this maps well onto TPU + XLA):
   the order/boundaries on the replica (the clustered-index analogue of
   the reference's index access paths), and then a per-query aggregate is
   mask -> gather-to-sorted-order -> cumsum -> boundary-diff: exact for
-  int64 (mod-2^64 wrap) and float64, with no per-query sort or scatter.
+  int64 (mod-2^64 wrap); for float64 the boundary diff folds the running
+  prefix-sum's rounding into each group (error ~ eps x running total),
+  bounded by the 1e-6-relative result-equality tests.  No per-query sort
+  or scatter either way.
 - **Join = dense position table + gather** (SURVEY §2.4: "build via
   scatter, probe via gather"): a unique build side keyed by a bounded
   int64 key becomes a dense key->row table (memoized on the replica for
@@ -1029,9 +1032,8 @@ def materialize(view: DevView) -> Chunk:
         if c.decode is not None:
             card = len(c.decode)
             safe = np.where(m | (v < 0) | (v >= card), 0, v)
-            out = np.empty(len(v), dtype=object)
-            for r in range(len(v)):
-                out[r] = None if m[r] else str(c.decode[safe[r]])
+            out = np.asarray(c.decode)[safe].astype(object)
+            out[m] = None
             cols.append(CCol.from_numpy(c.ret_type, out, m))
         else:
             vv = v
@@ -1075,9 +1077,28 @@ class DevPipeExec:
         try:
             self._node = _compile_device(self.plan, cctx)
         except Exception:
+            self._bail(ctx, "compile")
             self._node = None
         if self._node is None:
             self._open_fallback(ctx)
+
+    @staticmethod
+    def _bail(ctx, stage: str):
+        """A devpipe exception degrades to the per-operator tier — loudly:
+        re-raise under tidb_devpipe=1 (tests force the pipeline and must
+        see kernel bugs), warn-log otherwise so the regression is visible
+        in the slow-query/debug log."""
+        if DevPipeExec._forced(ctx):
+            raise  # noqa: PLE0704 — re-raise the active exception
+        import logging
+        logging.getLogger("tinysql_tpu").warning(
+            "devpipe %s failed, per-operator fallback", stage,
+            exc_info=True)
+
+    @staticmethod
+    def _forced(ctx) -> bool:
+        raw = ctx.session_vars.get("tidb_devpipe", -1)
+        return raw is not None and int(raw) == 1
 
     @staticmethod
     def _enabled(ctx) -> bool:
@@ -1109,6 +1130,7 @@ class DevPipeExec:
             view = self._node.run()
             out = materialize(view) if view is not None else None
         except Exception:
+            self._bail(self.ctx, "run")
             view = out = None  # device died mid-run: fall back whole
         if view is None:
             # runtime bail (replica vanished, device error): rebuild on

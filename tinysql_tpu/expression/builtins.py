@@ -764,7 +764,8 @@ def _pad_cut(side: str):
     """LEFT/RIGHT(s, n)."""
     def fn(s, n):
         n = max(int(n), 0)
-        return s[:n] if side == "left" else (s[len(s) - n:] if n else "")
+        # clamp the start: RIGHT('abc', 5) is 'abc', not a wrapped slice
+        return s[:n] if side == "left" else s[max(len(s) - n, 0):]
     return fn
 
 
