@@ -69,14 +69,14 @@ def counters(monkeypatch):
                    (devpipe._ReplicaLeaf, "leaf"),
                    (devpipe._HostLeaf, "host"),
                    (devpipe._OrderNode, "order")]:
-        orig = cls.run
+        orig = cls.prepare
 
         def mk(orig, k):
-            def run(self):
+            def prepare(self, pb):
                 runs[k] += 1
-                return orig(self)
-            return run
-        monkeypatch.setattr(cls, "run", mk(orig, k))
+                return orig(self, pb)
+            return prepare
+        monkeypatch.setattr(cls, "prepare", mk(orig, k))
     return runs
 
 
@@ -213,6 +213,64 @@ def test_randomized_join_battery(tk, counters):
         sql = (f"select {cols} from t {jt} u on t.fk = u.k "
                f"where {pt}" + ("" if jt == "left join" else f" and {pu}"))
         assert_match(tk, sql)
+
+
+def _dup_tables(tk, n=2500, m=600, seed=7):
+    """Probe table p, build table d where d.k has DUPLICATES (and NULLs):
+    the CSR multiplicity path, not the unique pos-table path."""
+    rng = np.random.default_rng(seed)
+    _load(tk, "p", "a bigint primary key, fk bigint, x double",
+          {"a": (np.arange(1, n + 1, dtype=np.int64), None),
+           "fk": (rng.integers(1, 80, n).astype(np.int64),
+                  rng.random(n) < 0.05),
+           "x": (rng.random(n) * 100, None)})
+    _load(tk, "dup", "k bigint, v bigint, w double",
+          {"k": (rng.integers(1, 100, m).astype(np.int64),
+                 rng.random(m) < 0.05),
+           "v": (rng.integers(0, 1000, m).astype(np.int64), None),
+           "w": (rng.random(m) * 10, rng.random(m) < 0.1)})
+
+
+def test_join_nonunique_build_inner(tk, counters):
+    _dup_tables(tk)
+    assert_match(tk, "select p.a, dup.v, dup.w from p join dup "
+                     "on p.fk = dup.k where p.x < 60")
+    assert counters["join"] >= 1 and counters["host"] == 0
+    assert any(k[0] == "joinm" for k in devpipe.COMPILED_NODE_KEYS), \
+        "CSR multiplicity join never compiled into a fused pipeline"
+
+
+def test_join_nonunique_build_left_null_extend(tk, counters):
+    _dup_tables(tk)
+    # fk NULL rows and fk values with no dup.k match must null-extend once
+    assert_match(tk, "select p.a, dup.v from p left join dup "
+                     "on p.fk = dup.k")
+    assert counters["join"] >= 1 and counters["host"] == 0
+
+
+def test_join_nonunique_build_filter_on_build(tk, counters):
+    _dup_tables(tk)
+    # build-side filter shrinks per-group multiplicity: valid-count CSR
+    assert_match(tk, "select p.a, dup.v from p join dup on p.fk = dup.k "
+                     "where dup.v > 500 and p.x > 20")
+
+
+def test_join_nonunique_then_topn(tk, counters):
+    _dup_tables(tk)
+    assert_match(tk, "select p.a, dup.v, p.x from p join dup "
+                     "on p.fk = dup.k order by p.x desc, p.a, dup.v "
+                     "limit 9")
+    assert counters["order"] >= 1 and counters["host"] == 0
+
+
+def test_join_sides_swapped_no_cache_collision(tk, counters):
+    # same structural shape, opposite probe/build orientation: the fused
+    # program cache must not replay the first query's column order
+    _fixture_tables(tk)
+    assert_match(tk, "select t.a, t.fk, u.k, u.v from t join u "
+                     "on t.fk = u.k where t.b > 0")
+    assert_match(tk, "select u.k, u.v, t.a, t.fk from u join t "
+                     "on u.k = t.fk where t.b > 0")
 
 
 def test_group_index_single_null_group():

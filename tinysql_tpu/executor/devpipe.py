@@ -1,21 +1,32 @@
-"""Device-resident query pipelines.
+"""Device-resident query pipelines, fused into ONE XLA program.
 
 The TPU-first replacement for per-operator host round-trips: a supported
 physical subtree (scans -> filters -> partial aggregates -> joins ->
-topn/sort/limit/projection) compiles into a chain of jitted device
-programs that hand device arrays to each other.  Intermediates NEVER land
-on the host; the only device->host transfer of a query is the packed
-materialization of the final (usually tiny) result.  This replaces the
-reference's executor pipeline hot loops (probe loop executor/join.go:325,
-agg update aggregate.go:307+) with gather/segment kernels, and its
-row-at-a-time operator hand-off with masked static-shape device views.
+topn/sort/limit/projection) compiles into a SINGLE jitted device program.
+Intermediates never exist outside the XLA program (they are fusion
+candidates for the compiler, not buffers); the only device->host transfer
+of a query is the packed materialization of the final (usually tiny)
+result, and for small results even the packing runs inside the same
+program — one dispatch, one download.  This replaces the reference's
+executor pipeline hot loops (probe loop executor/join.go:325, agg update
+aggregate.go:307+) with gather/segment kernels, and its row-at-a-time
+operator hand-off with masked static-shape device views.
+
+Why fusion matters here: the device link bills ~40-70ms per program
+dispatch (PROFILE.md §1); round 2 ran Q3 as five chained programs and
+paid that five times.  Round 3 splits every node into host-side
+``prepare`` (replica uploads, group indexes, position tables, parameter
+tables — all memoized per replica version) and a pure traced ``emit``;
+DevPipeExec composes the emits and jits the whole pipeline once per
+(structure, shape) key.
 
 Key design points (why this maps well onto TPU + XLA):
 
 - **Static shapes everywhere.**  Every view is padded to a power-of-two
   bucket with a validity mask; data-dependent sizes never force a host
   sync or a recompile.  One program per (shape, structure) pair, reused
-  across queries and constants (constants ride exprjit.ParamTable).
+  across queries and constants (constants ride exprjit.ParamTable slots
+  passed as runtime inputs).
 - **Group index** (sort once per replica version, not per query): the
   high-cardinality GROUP BY path sorts the table by key ONCE, memoizes
   the order/boundaries on the replica (the clustered-index analogue of
@@ -29,8 +40,13 @@ Key design points (why this maps well onto TPU + XLA):
   scatter, probe via gather"): a unique build side keyed by a bounded
   int64 key becomes a dense key->row table (memoized on the replica for
   base-table keys; static per replica version for group-index keys);
-  probing is one gather + validity checks.  No sort, no expansion pass
-  for the unique-build case the planner proves (pk / partial-agg build).
+  probing is one gather + validity checks.  Non-unique build sides ride
+  the same group index as a CSR layout (sorted order + group boundaries,
+  reference join.go:244 / util/mvmap multiplicity semantics): probe maps
+  key -> group, per-group valid counts come from one cumsum, and a
+  two-phase expansion (scatter row starts + running-max fill) lands the
+  variable-multiplicity output in a static bucket sized by a host-side
+  upper bound.
 - Strings ride order-preserving dictionary codes on device (decode on
   materialize only), so string group keys, sort keys, and equality
   filters all stay on the TPU.
@@ -55,37 +71,48 @@ from ..planner.physical import (PhysicalHashAgg, PhysicalHashJoin,
                                 PhysicalTopN)
 
 MAX_DENSE_RANGE = 1 << 25   # dense key->pos tables up to 32M slots (128MB)
+MAX_EXPAND = 1 << 23        # CSR-join output bucket cap (8M rows)
 
 _JIT_CACHE: Dict[tuple, tuple] = {}
 
-
-class DevCol:
-    """One device column of a view: values (int64/float64; dictionary
-    codes for strings), a null mask, and the host-side decode table for
-    string columns (None for numerics)."""
-    __slots__ = ("vals", "null", "decode", "ret_type")
-
-    def __init__(self, vals, null, ret_type, decode=None):
-        self.vals = vals
-        self.null = null
-        self.ret_type = ret_type
-        self.decode = decode
+# structural node keys that have actually been compiled into some fused
+# pipeline — introspection surface for tests and the multichip dryrun
+COMPILED_NODE_KEYS: set = set()
 
 
-class DevView:
-    """A device-resident row batch: columns padded to bucket `nb` with a
-    validity mask.  Invalid rows are garbage and must never influence
-    results."""
-    __slots__ = ("cols", "valid", "nb")
+class _PipeBuilder:
+    """Collects the fused program's runtime inputs and structural cache
+    key while the node tree prepares.  Input ORDER is deterministic for a
+    given key (prepare is a deterministic tree walk), so a cache-hit
+    pipeline can re-bind fresh inputs positionally."""
+    __slots__ = ("inputs", "kparts")
 
-    def __init__(self, cols: List[DevCol], valid, nb: int):
-        self.cols = cols
-        self.valid = valid
+    def __init__(self):
+        self.inputs: List = []
+        self.kparts: List = []
+
+    def add(self, arr) -> int:
+        self.inputs.append(arr)
+        return len(self.inputs) - 1
+
+    def params(self, pt: ParamTable):
+        pi, pf = pt.arrays()
+        return self.add(pi), self.add(pf)
+
+    def key(self, part) -> None:
+        self.kparts.append(part)
+
+
+class _TView:
+    """Trace-time view: ``emit(args) -> (valid, [(vals, null), ...])``
+    over the fused program's positional inputs, plus the host-side
+    column metadata (ret_type, string decode table) and bucket size."""
+    __slots__ = ("emit", "nb", "meta")
+
+    def __init__(self, emit: Callable, nb: int, meta: List[tuple]):
+        self.emit = emit
         self.nb = nb
-
-    def pairs(self):
-        """(vals, null) pairs in exprjit's cols layout."""
-        return [(c.vals, c.null) for c in self.cols]
+        self.meta = meta
 
 
 # =========================================================================
@@ -144,6 +171,14 @@ class GroupIndex:
         live = ~self.gkey_null
         tbl[self.gkeys[live] - self.lo] = np.nonzero(live)[0]
         return tbl
+
+    def raw_counts(self) -> np.ndarray:
+        """Rows per group (host int64 [ng]) — the pre-filter group sizes
+        the CSR join uses for its expansion upper bound."""
+        if self.n_groups == 0:
+            return np.empty(0, dtype=np.int64)
+        prev = np.concatenate(([np.int64(-1)], self.ends[:-1]))
+        return self.ends - prev
 
 
 def _group_index(rep, sid, vals, nulls) -> GroupIndex:
@@ -207,12 +242,12 @@ def _dev_upload(rep, key, build_np):
 class _ReplicaLeaf:
     """Full-table scan from the columnar replica: device columns are
     version-memoized uploads; scan filters become the validity mask
-    (device program with params)."""
+    (traced inline into the fused program)."""
 
     def __init__(self, reader_exec, plan):
         self.ex = reader_exec
         self.plan = plan
-        self._rep = None  # set at run(): take_raw_replica consumes the reader
+        self._rep = None  # set at prepare(): take_raw_replica consumes
 
     @staticmethod
     def compile(plan: PhysicalTableReader, ctx: _Ctx):
@@ -229,7 +264,7 @@ class _ReplicaLeaf:
             return None
         return _ReplicaLeaf(ex, plan)
 
-    def run(self) -> Optional[DevView]:
+    def prepare(self, pb: _PipeBuilder) -> Optional[_TView]:
         from .tpu_executors import (_build_device_mask, _rep_string_dict,
                                     _slot_id)
         chk, filters, rep = self.ex.take_raw_replica()
@@ -243,7 +278,9 @@ class _ReplicaLeaf:
         if dm is None:
             return None
         mask_fn, mask_key, params, _needed = dm
-        cols: List[DevCol] = []
+        slots = []
+        meta: List[tuple] = []
+        dts = []
         for idx, c in enumerate(chk.columns):
             v = c.values()
             m = c.null_mask()
@@ -254,27 +291,27 @@ class _ReplicaLeaf:
                 got = _rep_string_dict(rep, sid, chk, idx)
                 codes, _card, _, uniques = got
                 dv = _dev_upload(rep, ("devcodes", sid, nb),
-                                 lambda c=codes: kernels.pad1(c, nb))
-                cols.append(DevCol(dv, dn, c.ft, decode=uniques))
+                                 lambda c_=codes: kernels.pad1(c_, nb))
+                meta.append((c.ft, uniques))
+                dts.append("s")
             else:
                 dv = _dev_upload(rep, ("devv", sid, nb),
                                  lambda v=v: kernels.pad1(v, nb))
-                cols.append(DevCol(dv, dn, c.ft))
-        key = ("leafmask", mask_key, nb)
-        ent = _JIT_CACHE.get(key)
-        if ent is None:
-            jx = kernels.jax()
-
-            def kernel(pairs, pr):
-                return mask_fn(pairs, pr, jn.arange(nb))
-            ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
-        fn, _ = ent
+                meta.append((c.ft, None))
+                dts.append("f" if v.dtype == np.float64 else "i")
+            slots.append((pb.add(dv), pb.add(dn)))
         pi, pf = params
-        valid = fn([(c.vals, c.null) for c in cols],
-                   (jn.asarray(pi), jn.asarray(pf)))
-        return DevView(cols, valid, nb)
+        ip = pb.add(np.asarray(pi))
+        fp = pb.add(np.asarray(pf))
+        pb.key(("leaf", mask_key, nb, tuple(dts)))
 
-    # host info the parent join/agg stages need (valid after run())
+        def emit(args):
+            pairs = [(args[iv], args[im]) for iv, im in slots]
+            valid = mask_fn(pairs, (args[ip], args[fp]), jn.arange(nb))
+            return valid, pairs
+        return _TView(emit, nb, meta)
+
+    # host info the parent join/agg stages need (valid after prepare())
     def replica(self):
         return self._rep if self._rep is not None else self.ex._replica
 
@@ -310,21 +347,27 @@ class _HostLeaf:
         ex.open(ctx.exec_ctx)
         return _HostLeaf(ex, plan)
 
-    def run(self) -> Optional[DevView]:
+    def prepare(self, pb: _PipeBuilder) -> Optional[_TView]:
         from .tpu_executors import _drain_chunk
         chk = _drain_chunk(self.ex, self.ex.field_types()).compact()
         n = chk.num_rows()
         nb = kernels.bucket(max(n, 1))
-        jn = _jn()
-        cols = []
+        slots = []
+        meta = []
+        dts = []
         for c, oc in zip(chk.columns, self.plan.schema.columns):
             v = c.values()
             m = c.null_mask()
-            cols.append(DevCol(jn.asarray(kernels.pad1(v, nb)),
-                               jn.asarray(kernels.pad1(m, nb, True)),
-                               oc.ret_type))
-        valid = jn.asarray(kernels.pad1(np.ones(n, dtype=bool), nb))
-        return DevView(cols, valid, nb)
+            slots.append((pb.add(kernels.pad1(v, nb)),
+                          pb.add(kernels.pad1(m, nb, True))))
+            meta.append((oc.ret_type, None))
+            dts.append("f" if v.dtype == np.float64 else "i")
+        vi = pb.add(kernels.pad1(np.ones(n, dtype=bool), nb))
+        pb.key(("host", nb, tuple(dts)))
+
+        def emit(args):
+            return args[vi], [(args[a], args[b]) for a, b in slots]
+        return _TView(emit, nb, meta)
 
     def close(self):
         self.ex.close()
@@ -393,9 +436,9 @@ class _AggIndexNode:
             return None
         return _AggIndexNode(leaf, plan, key, specs, out_map)
 
-    def run(self) -> Optional[DevView]:
-        view = self.leaf.run()
-        if view is None:
+    def prepare(self, pb: _PipeBuilder) -> Optional[_TView]:
+        tv = self.leaf.prepare(pb)
+        if tv is None:
             return None
         rep = self.leaf.replica()
         from .tpu_executors import _slot_id
@@ -407,19 +450,19 @@ class _AggIndexNode:
         self.gidx = gidx
         ng = gidx.n_groups
         ngb = kernels.bucket(max(ng, 1))
-        nb = view.nb
+        nb = tv.nb
         jn = _jn()
-        d_order = _dev_upload(rep, ("gi_order", sid, nb),
-                              lambda: kernels.pad1(gidx.order, nb))
-        d_ends = _dev_upload(rep, ("gi_ends", sid, ngb),
-                             lambda: kernels.pad1(
-                                 gidx.ends, ngb,
-                                 fill=max(rep.n_rows - 1, 0)))
-        d_gkeys = _dev_upload(rep, ("gi_gkeys", sid, ngb),
-                              lambda: kernels.pad1(gidx.gkeys, ngb))
-        d_gknull = _dev_upload(rep, ("gi_gknull", sid, ngb),
-                               lambda: kernels.pad1(gidx.gkey_null, ngb,
-                                                    True))
+        io = pb.add(_dev_upload(rep, ("gi_order", sid, nb),
+                                lambda: kernels.pad1(gidx.order, nb)))
+        ie = pb.add(_dev_upload(rep, ("gi_ends", sid, ngb),
+                                lambda: kernels.pad1(
+                                    gidx.ends, ngb,
+                                    fill=max(rep.n_rows - 1, 0))))
+        ik = pb.add(_dev_upload(rep, ("gi_gkeys", sid, ngb),
+                                lambda: kernels.pad1(gidx.gkeys, ngb)))
+        ikn = pb.add(_dev_upload(rep, ("gi_gknull", sid, ngb),
+                                 lambda: kernels.pad1(gidx.gkey_null, ngb,
+                                                      True)))
         pt = ParamTable()
         pt.add_int(ng)
         pt.add_int(rep.n_rows)
@@ -432,59 +475,55 @@ class _AggIndexNode:
             else:
                 arg_fns.append(compile_expr_params(a, pt))
                 keys.append(f"{kind}:{stable_shape_key(a)}")
-        key = ("aggindex", tuple(keys), nb, ngb)
-        ent = _JIT_CACHE.get(key)
-        if ent is None:
-            jx = kernels.jax()
-            spec_kinds = [k for k, _ in self.specs]
+        ip, fp = pb.params(pt)
+        pb.key(("aggindex", tuple(keys), nb, ngb))
+        spec_kinds = [k for k, _ in self.specs]
+        out_map = self.out_map
+        schema_cols = self.plan.schema.columns
 
-            def kernel(pairs, valid, order, ends, pr):
-                # padded sorted positions map to row 0 via the padded
-                # order array — they MUST be masked or row 0 is counted
-                # once per padding slot
-                in_table = jn.arange(nb) < pr[0][1]
-                valid_s = valid[order] & in_table
-                prev = jn.concatenate([jn.full((1,), -1, dtype=jn.int64),
-                                       ends[:-1]])
-                prev_safe = jn.maximum(prev, 0)
+        def emit(args):
+            valid, pairs = tv.emit(args)
+            order, ends = args[io], args[ie]
+            pr = (args[ip], args[fp])
+            # padded sorted positions map to row 0 via the padded order
+            # array — they MUST be masked or row 0 is counted once per
+            # padding slot
+            in_table = jn.arange(nb) < pr[0][1]
+            valid_s = valid[order] & in_table
+            prev = jn.concatenate([jn.full((1,), -1, dtype=jn.int64),
+                                   ends[:-1]])
+            prev_safe = jn.maximum(prev, 0)
 
-                def seg(x_s):
-                    c = jn.cumsum(x_s)
-                    hi = c[ends]
-                    lo = jn.where(prev >= 0, c[prev_safe],
-                                  jn.zeros((), dtype=x_s.dtype))
-                    return hi - lo
-                presence = seg(valid_s.astype(jn.int64))
-                outs = []
-                for kind, af in zip(spec_kinds, arg_fns):
-                    if kind == "count_star":
-                        outs.append((presence,
-                                     jn.zeros(ngb, dtype=bool)))
-                        continue
-                    av, an = af(pairs, pr)
-                    live_s = (valid & ~an)[order] & in_table
-                    cnt = seg(live_s.astype(jn.int64))
-                    if kind == "count":
-                        outs.append((cnt, jn.zeros(ngb, dtype=bool)))
-                    else:  # sum
-                        av_s = jn.where(live_s, av[order], 0)
-                        outs.append((seg(av_s), cnt == 0))
-                gvalid = (jn.arange(ngb) < pr[0][0]) & (presence > 0)
-                return gvalid, outs
-            ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
-        fn, _ = ent
-        pi, pf = pt.arrays()
-        gvalid, outs = fn(view.pairs(), view.valid, d_order, d_ends,
-                          (jn.asarray(pi), jn.asarray(pf)))
-        # assemble output view per plan schema
-        cols: List[DevCol] = []
-        for slot, oc in zip(self.out_map, self.plan.schema.columns):
-            if slot[0] == "agg":
-                v, m = outs[slot[1]]
-                cols.append(DevCol(v, m, oc.ret_type))
-            else:
-                cols.append(DevCol(d_gkeys, d_gknull, oc.ret_type))
-        return DevView(cols, gvalid, ngb)
+            def seg(x_s):
+                c = jn.cumsum(x_s)
+                hi = c[ends]
+                lo = jn.where(prev >= 0, c[prev_safe],
+                              jn.zeros((), dtype=x_s.dtype))
+                return hi - lo
+            presence = seg(valid_s.astype(jn.int64))
+            outs = []
+            for kind, af in zip(spec_kinds, arg_fns):
+                if kind == "count_star":
+                    outs.append((presence, jn.zeros(ngb, dtype=bool)))
+                    continue
+                av, an = af(pairs, pr)
+                live_s = (valid & ~an)[order] & in_table
+                cnt = seg(live_s.astype(jn.int64))
+                if kind == "count":
+                    outs.append((cnt, jn.zeros(ngb, dtype=bool)))
+                else:  # sum
+                    av_s = jn.where(live_s, av[order], 0)
+                    outs.append((seg(av_s), cnt == 0))
+            gvalid = (jn.arange(ngb) < pr[0][0]) & (presence > 0)
+            cols = []
+            for slot in out_map:
+                if slot[0] == "agg":
+                    cols.append(outs[slot[1]])
+                else:
+                    cols.append((args[ik], args[ikn]))
+            return gvalid, cols
+        meta = [(oc.ret_type, None) for oc in schema_cols]
+        return _TView(emit, ngb, meta)
 
     def build_key_info(self):
         """(lo, hi, pos_table np) for the parent join — static per
@@ -512,12 +551,20 @@ class _AggIndexNode:
 
 
 class _JoinNode:
-    """Equi-join with a planner-proven-unique build side: dense position
-    table + gather.  Output = probe-shaped view with build columns
-    gathered per match."""
+    """Equi-join on a single int key.  Two device layouts:
+
+    - unique build (planner-proven pk/unique, or a group-index partial
+      agg): dense key -> row position table + one gather per build
+      column — probe-shaped output, no expansion.
+    - general multiplicity (reference join.go:244 / util/mvmap): the
+      build replica's group index is a CSR layout; probe maps key ->
+      group through the dense table, per-group VALID counts come from a
+      cumsum over the sorted validity, and the variable-size output
+      lands in a static bucket via scatter-starts + running-max fill.
+    """
 
     def __init__(self, probe, build, probe_key, build_key, tp,
-                 probe_is_left, plan, mesh=None):
+                 probe_is_left, plan, mesh=None, mult=False):
         self.probe = probe
         self.build = build
         self.probe_key = probe_key
@@ -526,6 +573,7 @@ class _JoinNode:
         self.probe_is_left = probe_is_left
         self.plan = plan
         self.mesh = mesh
+        self.mult = mult
         self.n_mesh = int(mesh.devices.size) if mesh is not None else 0
 
     @staticmethod
@@ -546,6 +594,7 @@ class _JoinNode:
         if getattr(plan, "left_conditions", None) \
                 or getattr(plan, "right_conditions", None):
             return None  # side conds live in Selections below by now
+        mult = False
         if getattr(plan, "right_unique", False):
             build_side, probe_side = 1, 0
             build_key, probe_key = rk, lk
@@ -553,11 +602,18 @@ class _JoinNode:
             build_side, probe_side = 0, 1
             build_key, probe_key = lk, rk
         else:
-            return None
+            # general multiplicity: build stays the right child (the
+            # probe must stay the outer side of a LEFT join), CSR over
+            # the build replica's group index
+            build_side, probe_side = 1, 0
+            build_key, probe_key = rk, lk
+            mult = True
         build = _compile_node(plan.children[build_side], ctx)
         if build is None:
             return None
-        if not _has_build_key_info(build, build_key):
+        ok = _leafish(build) is not None if mult \
+            else _has_build_key_info(build, build_key)
+        if not ok:
             _close_node(build)
             return None
         probe = _compile_node(plan.children[probe_side], ctx)
@@ -565,106 +621,265 @@ class _JoinNode:
             _close_node(build)
             return None
         return _JoinNode(probe, build, probe_key, build_key, plan.tp,
-                         probe_side == 0, plan, mesh=ctx.mesh)
+                         probe_side == 0, plan, mesh=ctx.mesh, mult=mult)
 
-    def run(self) -> Optional[DevView]:
-        bview = self.build.run()
-        if bview is None:
+    def prepare(self, pb: _PipeBuilder) -> Optional[_TView]:
+        btv = self.build.prepare(pb)
+        if btv is None:
             return None
-        info = _build_key_info(self.build, self.build_key, bview)
+        ptv = self.probe.prepare(pb)
+        if ptv is None:
+            return None
+        if self.mult:
+            return self._prepare_mult(pb, btv, ptv)
+        return self._prepare_unique(pb, btv, ptv)
+
+    # ---- unique build side: dense pos table + gather -------------------
+
+    def _prepare_unique(self, pb, btv, ptv) -> Optional[_TView]:
+        info = _prepare_build_key_info(self.build, self.build_key, pb)
         if info is None:
             return None
-        lo, hi, d_tbl = info
-        pview = self.probe.run()
-        if pview is None:
-            return None
+        lo, hi, it, tbl_len = info
         jn = _jn()
-        nb = pview.nb
-        tbl_len = int(d_tbl.shape[0])
-        nbb = bview.nb
+        nb = ptv.nb
+        nbb = btv.nb
         pk_slot = self.probe_key.index
         pt = ParamTable()
         pt.add_int(lo)
         pt.add_int(hi)
+        ip, fp = pb.params(pt)
         outer = self.tp == "left"
         # multi-chip: shard the PROBE side over the mesh, broadcast the
         # build table + build view (SURVEY §2.11 P4: partition one side,
         # probe rides ICI-local gathers, no cross-chip traffic per row)
         from ..parallel import dist
         mesh = self.mesh if dist.shardable(nb, self.mesh) else None
-        key = ("join", nb, nbb, tbl_len, pk_slot, outer,
-               len(bview.cols), len(pview.cols),
-               self.n_mesh if mesh is not None else 0)
-        ent = _JIT_CACHE.get(key)
-        if ent is None:
-            jx = kernels.jax()
+        n_mesh = self.n_mesh if mesh is not None else 0
+        probe_is_left = self.probe_is_left
+        pb.key(("join", nb, nbb, tbl_len, pk_slot, outer, probe_is_left,
+                len(btv.meta), len(ptv.meta), n_mesh))
 
-            def kernel(ppairs, pvalid, bpairs, bvalid, tbl, pr):
-                kp, knull = ppairs[pk_slot]
-                lo_p, hi_p = pr[0][0], pr[0][1]
-                inr = (kp >= lo_p) & (kp <= hi_p) & ~knull
-                pos0 = jn.clip(kp - lo_p, 0, tbl_len - 1)
-                pos = jn.where(inr, tbl[pos0].astype(jn.int64), -1)
-                pos_safe = jn.clip(pos, 0, nbb - 1)
-                match = (pos >= 0) & bvalid[pos_safe]
-                if outer:
-                    valid_out = pvalid
-                else:
-                    valid_out = pvalid & match
-                gathered = []
-                for bv, bn in bpairs:
-                    gv = bv[pos_safe]
-                    gn = bn[pos_safe] | ~match
-                    gathered.append((gv, gn))
-                return valid_out, gathered
-            if mesh is not None:
-                from jax.sharding import PartitionSpec as P
-                try:
-                    from jax import shard_map
-                except ImportError:  # older jax
-                    from jax.experimental.shard_map import shard_map
-                pspec = [(P("shard"), P("shard"))] * len(pview.cols)
-                bspec = [(P(), P())] * len(bview.cols)
-                fn = shard_map(
-                    kernel, mesh=mesh,
-                    in_specs=(pspec, P("shard"), bspec, P(), P(),
-                              (P(), P())),
-                    out_specs=(P("shard"),
-                               [(P("shard"), P("shard"))] * len(bview.cols)))
-                ent = _JIT_CACHE[key] = (jx.jit(fn), None)
+        def kernel(ppairs, pvalid, bpairs, bvalid, tbl, pr):
+            kp, knull = ppairs[pk_slot]
+            lo_p, hi_p = pr[0][0], pr[0][1]
+            inr = (kp >= lo_p) & (kp <= hi_p) & ~knull
+            pos0 = jn.clip(kp - lo_p, 0, tbl_len - 1)
+            pos = jn.where(inr, tbl[pos0].astype(jn.int64), -1)
+            pos_safe = jn.clip(pos, 0, nbb - 1)
+            match = (pos >= 0) & bvalid[pos_safe]
+            if outer:
+                valid_out = pvalid
             else:
-                ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
-        fn, _ = ent
-        pi, pf = pt.arrays()
-        valid_out, gathered = fn(pview.pairs(), pview.valid,
-                                 bview.pairs(), bview.valid, d_tbl,
-                                 (jn.asarray(pi), jn.asarray(pf)))
-        bcols = [DevCol(v, m, c.ret_type, c.decode)
-                 for (v, m), c in zip(gathered, bview.cols)]
-        if self.probe_is_left:
-            cols = pview.cols + bcols
+                valid_out = pvalid & match
+            gathered = []
+            for bv, bn in bpairs:
+                gv = bv[pos_safe]
+                gn = bn[pos_safe] | ~match
+                gathered.append((gv, gn))
+            return valid_out, gathered
+
+        if mesh is not None:
+            from jax.sharding import PartitionSpec as P
+            try:
+                from jax import shard_map
+            except ImportError:  # older jax
+                from jax.experimental.shard_map import shard_map
+            pspec = [(P("shard"), P("shard"))] * len(ptv.meta)
+            bspec = [(P(), P())] * len(btv.meta)
+            sharded = shard_map(
+                kernel, mesh=mesh,
+                in_specs=(pspec, P("shard"), bspec, P(), P(),
+                          (P(), P())),
+                out_specs=(P("shard"),
+                           [(P("shard"), P("shard"))] * len(btv.meta)))
         else:
-            cols = bcols + pview.cols
-        return DevView(cols, valid_out, nb)
+            sharded = kernel
+
+        def emit(args):
+            bvalid, bpairs = btv.emit(args)
+            pvalid, ppairs = ptv.emit(args)
+            valid_out, gathered = sharded(ppairs, pvalid, bpairs, bvalid,
+                                          args[it],
+                                          (args[ip], args[fp]))
+            if probe_is_left:
+                return valid_out, list(ppairs) + gathered
+            return valid_out, gathered + list(ppairs)
+        if probe_is_left:
+            meta = ptv.meta + btv.meta
+        else:
+            meta = btv.meta + ptv.meta
+        return _TView(emit, nb, meta)
+
+    # ---- general multiplicity: CSR over the build group index ----------
+
+    def _prepare_mult(self, pb, btv, ptv) -> Optional[_TView]:
+        from .tpu_executors import _slot_id
+        leaf = _leafish(self.build)
+        rep = leaf.replica()
+        if rep is None:
+            return None
+        sid = _slot_id(leaf.ex, self.build_key.index)
+        if sid == "handle":
+            kv, km = rep.handles, np.zeros(rep.n_rows, dtype=bool)
+        else:
+            kv, km = rep.columns[sid]
+        gidx = _group_index(rep, sid, kv, km)
+
+        def mk():
+            tbl = gidx.pos_table()
+            return None if tbl is None else (gidx.lo, gidx.hi, tbl)
+        got = rep.memo(("gi_postable", sid), mk)
+        if got is None:
+            return None
+        lo, hi, tbl = got
+        raw = gidx.raw_counts()
+        outer = self.tp == "left"
+        ob = self._expand_bucket(raw, gidx, tbl, lo, hi, ptv, outer)
+        if ob is None:
+            return None
+        jn = _jn()
+        nb = ptv.nb           # probe bucket
+        nbb = btv.nb          # build bucket == leaf bucket (sel keeps nb)
+        ng = gidx.n_groups
+        ngb = kernels.bucket(max(ng, 1))
+        tbl_len = int(tbl.shape[0])
+        pk_slot = self.probe_key.index
+        io = pb.add(_dev_upload(rep, ("gi_order", sid, nbb),
+                                lambda: kernels.pad1(gidx.order, nbb)))
+        ie = pb.add(_dev_upload(rep, ("gi_ends", sid, ngb),
+                                lambda: kernels.pad1(
+                                    gidx.ends, ngb,
+                                    fill=max(rep.n_rows - 1, 0))))
+        it = pb.add(_dev_upload(rep, ("gi_postable_dev", sid),
+                                lambda: tbl))
+        pt = ParamTable()
+        pt.add_int(ng)
+        pt.add_int(rep.n_rows)
+        pt.add_int(lo)
+        pt.add_int(hi)
+        ip, fp = pb.params(pt)
+        probe_is_left = self.probe_is_left
+        pb.key(("joinm", nb, nbb, ngb, ob, tbl_len, pk_slot, outer,
+                probe_is_left, len(btv.meta), len(ptv.meta)))
+
+        def emit(args):
+            from jax import lax
+            bvalid, bpairs = btv.emit(args)
+            pvalid, ppairs = ptv.emit(args)
+            order, ends, tbl_d = args[io], args[ie], args[it]
+            pr = (args[ip], args[fp])
+            ng_p, nrows_p, lo_p, hi_p = (pr[0][0], pr[0][1], pr[0][2],
+                                         pr[0][3])
+            # per-group VALID counts from one cumsum over sorted validity
+            in_table = jn.arange(nbb) < nrows_p
+            vs = bvalid[order] & in_table
+            c = jn.cumsum(vs.astype(jn.int64))
+            gmask = jn.arange(ngb) < ng_p
+            prev = jn.concatenate([jn.full((1,), -1, dtype=jn.int64),
+                                   ends[:-1]])
+            prev_safe = jn.maximum(prev, 0)
+            start_c = jn.where(prev >= 0, c[prev_safe], 0)
+            vcnt = jn.where(gmask, c[ends] - start_c, 0)
+            # compacted sorted order: comp[j] = row of j-th valid entry
+            vidx = jn.nonzero(vs, size=nbb, fill_value=0)[0]
+            comp = order[vidx]
+            # probe -> group -> multiplicity
+            kp, knull = ppairs[pk_slot]
+            inr = (kp >= lo_p) & (kp <= hi_p) & ~knull & pvalid
+            pos0 = jn.clip(kp - lo_p, 0, tbl_len - 1)
+            g = jn.where(inr, tbl_d[pos0].astype(jn.int64), -1)
+            gsafe = jn.clip(g, 0, ngb - 1)
+            m = jn.where(g >= 0, vcnt[gsafe], 0)
+            if outer:
+                cnt = jn.where(pvalid, jn.maximum(m, 1), 0)
+            else:
+                cnt = m
+            offs = jn.cumsum(cnt) - cnt   # exclusive prefix
+            total = offs[-1] + cnt[-1]
+            # two-phase expansion: scatter each probe row's id at its
+            # output start, running-max fill assigns every output slot
+            tgt = jn.where(cnt > 0, offs, ob)  # ob = dropped (OOB)
+            base = jn.zeros(ob, dtype=jn.int64).at[tgt].set(
+                jn.arange(nb) + 1, mode="drop")
+            pidx = lax.cummax(base, axis=0) - 1
+            valid_out = (pidx >= 0) & (jn.arange(ob) < total)
+            ps = jn.clip(pidx, 0, nb - 1)
+            k = jn.arange(ob) - offs[ps]
+            gj = g[ps]
+            gjs = jn.clip(gj, 0, ngb - 1)
+            matched = (gj >= 0) & (k < m[ps]) & valid_out
+            brow = comp[jn.clip(start_c[gjs] + k, 0, nbb - 1)]
+            pcols = [(pv[ps], pn[ps]) for pv, pn in ppairs]
+            bcols = [(bv[brow], bn[brow] | ~matched) for bv, bn in bpairs]
+            if probe_is_left:
+                return valid_out, pcols + bcols
+            return valid_out, bcols + pcols
+        if probe_is_left:
+            meta = ptv.meta + btv.meta
+        else:
+            meta = btv.meta + ptv.meta
+        return _TView(emit, ob, meta)
+
+    def _expand_bucket(self, raw, gidx, tbl, lo, hi, ptv, outer):
+        """Static output bucket for the CSR expansion, from a host-side
+        UPPER bound on match count (pre-filter group sizes; filters only
+        shrink).  None = too large, fall off the device pipeline."""
+        from .tpu_executors import _slot_id
+        bound = None
+        pleaf = _leafish(self.probe)
+        if pleaf is not None:
+            prep = pleaf.replica()
+            if prep is not None:
+                psid = _slot_id(pleaf.ex, self.probe_key.index)
+                if psid == "handle":
+                    pkv = prep.handles
+                    pkm = np.zeros(prep.n_rows, dtype=bool)
+                else:
+                    pkv, pkm = prep.columns[psid]
+                inr = (~pkm) & (pkv >= lo) & (pkv <= hi)
+                gsafe = np.where(inr, pkv - lo, 0)
+                g = np.where(inr, tbl[gsafe], -1)
+                per = np.where(g >= 0, raw[np.clip(g, 0, max(len(raw) - 1,
+                                                             0))], 0)
+                if outer:
+                    per = np.maximum(per, 1)
+                bound = int(per.sum())
+        if bound is None:
+            mx = int(raw.max()) if len(raw) else 0
+            bound = ptv.nb * max(mx, 1 if outer else 0)
+        if bound > MAX_EXPAND:
+            return None
+        return kernels.bucket(max(bound, 1))
 
     def close(self):
         _close_node(self.probe)
         _close_node(self.build)
 
 
+def _leafish(node) -> Optional[_ReplicaLeaf]:
+    """The underlying replica leaf of a leaf/selection chain (selection
+    preserves the schema, so column offsets map straight through)."""
+    if isinstance(node, _ReplicaLeaf):
+        return node
+    if isinstance(node, _SelNode):
+        return _leafish(node.child)
+    return None
+
+
 def _has_build_key_info(node, build_key) -> bool:
     if isinstance(node, _AggIndexNode):
         return node.key_slot() == build_key.index
     if isinstance(node, (_ReplicaLeaf,)):
-        return True  # bounds checked at run time
+        return True  # bounds checked at prepare time
     if isinstance(node, (_SelNode,)):
         return _has_build_key_info(node.child, build_key)
     return False
 
 
-def _build_key_info(node, build_key, bview):
-    """(lo, hi, device pos-table) mapping build-key value -> view row."""
-    jn = _jn()
+def _prepare_build_key_info(node, build_key, pb: _PipeBuilder):
+    """(lo, hi, input index of the device pos-table, table length) mapping
+    build-key value -> build view row."""
     if isinstance(node, _AggIndexNode):
         got = node.build_key_info()
         if got is None:
@@ -674,9 +889,9 @@ def _build_key_info(node, build_key, bview):
         from .tpu_executors import _slot_id
         sid = _slot_id(node.leaf.ex, node.key_col.index)
         d = _dev_upload(rep, ("gi_postable_dev", sid), lambda: tbl)
-        return lo, hi, d
+        return lo, hi, pb.add(d), int(tbl.shape[0])
     if isinstance(node, _SelNode):
-        return _build_key_info(node.child, build_key, bview)
+        return _prepare_build_key_info(node.child, build_key, pb)
     if isinstance(node, _ReplicaLeaf):
         rep = node.replica()
         if rep is None:
@@ -692,7 +907,7 @@ def _build_key_info(node, build_key, bview):
             return None
         lo, hi, tbl = got
         d = _dev_upload(rep, ("postable_dev", sid), lambda: tbl)
-        return lo, hi, d
+        return lo, hi, pb.add(d), int(tbl.shape[0])
     return None
 
 
@@ -713,31 +928,25 @@ class _SelNode:
             return None
         return _SelNode(child, plan.conditions, plan)
 
-    def run(self) -> Optional[DevView]:
-        view = self.child.run()
-        if view is None:
+    def prepare(self, pb: _PipeBuilder) -> Optional[_TView]:
+        tv = self.child.prepare(pb)
+        if tv is None:
             return None
-        jn = _jn()
         pt = ParamTable()
         fns = [compile_expr_params(c, pt) for c in self.conds]
         keys = tuple(stable_shape_key(c) for c in self.conds)
-        key = ("sel", keys, view.nb, len(view.cols))
-        ent = _JIT_CACHE.get(key)
-        if ent is None:
-            jx = kernels.jax()
+        ip, fp = pb.params(pt)
+        pb.key(("sel", keys, tv.nb, len(tv.meta)))
 
-            def kernel(pairs, valid, pr):
-                m = valid
-                for f in fns:
-                    v, null = f(pairs, pr)
-                    m = m & (v != 0) & ~null
-                return m
-            ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
-        fn, _ = ent
-        pi, pf = pt.arrays()
-        valid = fn(view.pairs(), view.valid,
-                   (jn.asarray(pi), jn.asarray(pf)))
-        return DevView(view.cols, valid, view.nb)
+        def emit(args):
+            valid, pairs = tv.emit(args)
+            pr = (args[ip], args[fp])
+            m = valid
+            for f in fns:
+                v, null = f(pairs, pr)
+                m = m & (v != 0) & ~null
+            return m, pairs
+        return _TView(emit, tv.nb, tv.meta)
 
     def close(self):
         _close_node(self.child)
@@ -765,46 +974,37 @@ class _ProjNode:
             return None
         return _ProjNode(child, plan.exprs, plan)
 
-    def run(self) -> Optional[DevView]:
-        view = self.child.run()
-        if view is None:
+    def prepare(self, pb: _PipeBuilder) -> Optional[_TView]:
+        tv = self.child.prepare(pb)
+        if tv is None:
             return None
-        jn = _jn()
         pt = ParamTable()
         fns = []
         keys = []
-        for e in self.exprs:
+        meta = []
+        for e, oc in zip(self.exprs, self.plan.schema.columns):
             if isinstance(e, ExprColumn):
                 fns.append(("col", e.index))
                 keys.append(f"@{e.index}")
+                meta.append((oc.ret_type, tv.meta[e.index][1]))
             else:
                 fns.append(("fn", compile_expr_params(e, pt)))
                 keys.append(stable_shape_key(e))
-        key = ("proj", tuple(keys), view.nb, len(view.cols))
-        ent = _JIT_CACHE.get(key)
-        if ent is None:
-            jx = kernels.jax()
+                meta.append((oc.ret_type, None))
+        ip, fp = pb.params(pt)
+        pb.key(("proj", tuple(keys), tv.nb, len(tv.meta)))
 
-            def kernel(pairs, pr):
-                outs = []
-                for kind, f in fns:
-                    if kind == "col":
-                        outs.append(pairs[f])
-                    else:
-                        outs.append(f(pairs, pr))
-                return outs
-            ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
-        fn, _ = ent
-        pi, pf = pt.arrays()
-        outs = fn(view.pairs(), (jn.asarray(pi), jn.asarray(pf)))
-        cols = []
-        for (v, m), e, oc in zip(outs, self.exprs,
-                                 self.plan.schema.columns):
-            decode = None
-            if isinstance(e, ExprColumn):
-                decode = view.cols[e.index].decode
-            cols.append(DevCol(v, m, oc.ret_type, decode))
-        return DevView(cols, view.valid, view.nb)
+        def emit(args):
+            valid, pairs = tv.emit(args)
+            pr = (args[ip], args[fp])
+            outs = []
+            for kind, f in fns:
+                if kind == "col":
+                    outs.append(pairs[f])
+                else:
+                    outs.append(f(pairs, pr))
+            return valid, outs
+        return _TView(emit, tv.nb, meta)
 
     def close(self):
         _close_node(self.child)
@@ -859,9 +1059,9 @@ class _OrderNode:
             off, count = plan.offset, plan.count
         return _OrderNode(child, by, off, count, plan)
 
-    def run(self) -> Optional[DevView]:
-        view = self.child.run()
-        if view is None:
+    def prepare(self, pb: _PipeBuilder) -> Optional[_TView]:
+        tv = self.child.prepare(pb)
+        if tv is None:
             return None
         jn = _jn()
         pt = ParamTable()
@@ -876,41 +1076,34 @@ class _OrderNode:
                 keys.append(f"{stable_shape_key(e)}:{desc}")
         descs = tuple(d for _, d in self.by)
         if self.off is None:
-            off, kb = 0, view.nb
+            off, kb = 0, tv.nb
         else:
-            off = min(self.off, view.nb)
-            kb = min(kernels.bucket(max(self.count, 1)) + off, view.nb)
+            off = min(self.off, tv.nb)
+            kb = min(kernels.bucket(max(self.count, 1)) + off, tv.nb)
         count = self.count
-        key = ("order", tuple(keys), off, kb, count, view.nb,
-               len(view.cols))
-        ent = _JIT_CACHE.get(key)
-        if ent is None:
-            jx = kernels.jax()
+        ip, fp = pb.params(pt)
+        pb.key(("order", tuple(keys), off, kb, count, tv.nb,
+                len(tv.meta)))
 
-            def kernel(pairs, valid, pr):
-                kvs = []
-                for kind, f in fns:
-                    if kind == "col":
-                        kvs.append(pairs[f])
-                    else:
-                        kvs.append(f(pairs, pr))
-                perm = jn.lexsort(_sort_ops(jn, kvs, descs, valid))
-                take = perm[off:kb]
-                out_valid = valid[take]
-                if count is not None:
-                    # valid rows sort first, so the taken valid rows are a
-                    # prefix; cap it at `count`
-                    out_valid = out_valid & (jn.arange(kb - off) < count)
-                outs = [(v[take], m[take]) for v, m in pairs]
-                return out_valid, outs
-            ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
-        fn, _ = ent
-        pi, pf = pt.arrays()
-        out_valid, outs = fn(view.pairs(), view.valid,
-                             (jn.asarray(pi), jn.asarray(pf)))
-        cols = [DevCol(v, m, c.ret_type, c.decode)
-                for (v, m), c in zip(outs, view.cols)]
-        return DevView(cols, out_valid, kb - off)
+        def emit(args):
+            valid, pairs = tv.emit(args)
+            pr = (args[ip], args[fp])
+            kvs = []
+            for kind, f in fns:
+                if kind == "col":
+                    kvs.append(pairs[f])
+                else:
+                    kvs.append(f(pairs, pr))
+            perm = jn.lexsort(_sort_ops(jn, kvs, descs, valid))
+            take = perm[off:kb]
+            out_valid = valid[take]
+            if count is not None:
+                # valid rows sort first, so the taken valid rows are a
+                # prefix; cap it at `count`
+                out_valid = out_valid & (jn.arange(kb - off) < count)
+            outs = [(v[take], m[take]) for v, m in pairs]
+            return out_valid, outs
+        return _TView(emit, kb - off, tv.meta)
 
     def close(self):
         _close_node(self.child)
@@ -928,27 +1121,23 @@ class _LimitNode:
             return None
         return _LimitNode(child, plan)
 
-    def run(self) -> Optional[DevView]:
-        view = self.child.run()
-        if view is None:
+    def prepare(self, pb: _PipeBuilder) -> Optional[_TView]:
+        tv = self.child.prepare(pb)
+        if tv is None:
             return None
         jn = _jn()
         pt = ParamTable()
         pt.add_int(self.plan.offset)
         pt.add_int(self.plan.offset + self.plan.count)
-        key = ("limit", view.nb)
-        ent = _JIT_CACHE.get(key)
-        if ent is None:
-            jx = kernels.jax()
+        ip, fp = pb.params(pt)
+        pb.key(("limit", tv.nb))
 
-            def kernel(valid, pr):
-                rank = jn.cumsum(valid.astype(jn.int64))
-                return valid & (rank > pr[0][0]) & (rank <= pr[0][1])
-            ent = _JIT_CACHE[key] = (jx.jit(kernel), None)
-        fn, _ = ent
-        pi, pf = pt.arrays()
-        valid = fn(view.valid, (jn.asarray(pi), jn.asarray(pf)))
-        return DevView(view.cols, valid, view.nb)
+        def emit(args):
+            valid, pairs = tv.emit(args)
+            pr = (args[ip], args[fp])
+            rank = jn.cumsum(valid.astype(jn.int64))
+            return valid & (rank > pr[0][0]) & (rank <= pr[0][1]), pairs
+        return _TView(emit, tv.nb, tv.meta)
 
     def close(self):
         _close_node(self.child)
@@ -995,52 +1184,24 @@ def _contains_join(plan) -> bool:
 
 
 # =========================================================================
-# materialization: the ONE device->host transfer of the pipeline
+# materialization: host chunk from the packed download
 # =========================================================================
 
-def materialize(view: DevView) -> Chunk:
-    jn = _jn()
-    nb = view.nb
-    items = []
-    for c in view.cols:
-        items.append(c.vals)
-        items.append(c.null)
-    if nb <= kernels.SMALL_PACK:
-        vals = kernels._slice_pack([view.valid] + items, nb)
-        keep = np.nonzero(vals[0])[0]
-        host = [(vals[1 + 2 * i][keep], vals[2 + 2 * i][keep])
-                for i in range(len(view.cols))]
-    else:
-        key = ("nvalid", nb)
-        ent = _JIT_CACHE.get(key)
-        if ent is None:
-            jx = kernels.jax()
-            ent = _JIT_CACHE[key] = (
-                jx.jit(lambda v: jn.sum(v.astype(jn.int64))), None)
-        n_valid = int(ent[0](view.valid))
-        if n_valid == 0:
-            host = [(np.empty(0, dtype=np.int64),
-                     np.empty(0, dtype=bool))] * len(view.cols)
-        else:
-            ob = min(kernels.bucket(n_valid), nb)
-            _ids, vals = kernels._present_pack(
-                view.valid.astype(jn.int64), items, ob)
-            host = [(vals[2 * i][:n_valid], vals[2 * i + 1][:n_valid])
-                    for i in range(len(view.cols))]
+def _to_chunk(host_pairs, meta) -> Chunk:
     cols = []
-    for (v, m), c in zip(host, view.cols):
-        if c.decode is not None:
-            card = len(c.decode)
+    for (v, m), (ret_type, decode) in zip(host_pairs, meta):
+        if decode is not None:
+            card = len(decode)
             safe = np.where(m | (v < 0) | (v >= card), 0, v)
-            out = np.asarray(c.decode)[safe].astype(object)
+            out = np.asarray(decode)[safe].astype(object)
             out[m] = None
-            cols.append(CCol.from_numpy(c.ret_type, out, m))
+            cols.append(CCol.from_numpy(ret_type, out, m))
         else:
             vv = v
-            if c.ret_type.eval_type is EvalType.REAL \
+            if ret_type.eval_type is EvalType.REAL \
                     and vv.dtype != np.float64:
                 vv = vv.astype(np.float64)
-            cols.append(CCol.from_numpy(c.ret_type, vv, m))
+            cols.append(CCol.from_numpy(ret_type, vv, m))
     return Chunk.from_columns(cols)
 
 
@@ -1050,9 +1211,9 @@ def materialize(view: DevView) -> Chunk:
 
 class DevPipeExec:
     """Volcano-compatible wrapper: compiles the subtree at open(), runs
-    the device pipeline once at first next().  Falls back to the regular
-    TPU/CPU executors when compilation bails (structurally or at run
-    time)."""
+    the fused device program once at first next().  Falls back to the
+    regular TPU/CPU executors when compilation bails (structurally or at
+    run time)."""
 
     def __init__(self, plan, fallback_builder: Callable):
         self.plan = plan
@@ -1083,6 +1244,11 @@ class DevPipeExec:
             self._open_fallback(ctx)
 
     @staticmethod
+    def _forced(ctx) -> bool:
+        raw = ctx.session_vars.get("tidb_devpipe", -1)
+        return raw is not None and int(raw) == 1
+
+    @staticmethod
     def _bail(ctx, stage: str):
         """A devpipe exception degrades to the per-operator tier — loudly:
         re-raise under tidb_devpipe=1 (tests force the pipeline and must
@@ -1094,11 +1260,6 @@ class DevPipeExec:
         logging.getLogger("tinysql_tpu").warning(
             "devpipe %s failed, per-operator fallback", stage,
             exc_info=True)
-
-    @staticmethod
-    def _forced(ctx) -> bool:
-        raw = ctx.session_vars.get("tidb_devpipe", -1)
-        return raw is not None and int(raw) == 1
 
     @staticmethod
     def _enabled(ctx) -> bool:
@@ -1127,12 +1288,11 @@ class DevPipeExec:
             return None
         self._done = True
         try:
-            view = self._node.run()
-            out = materialize(view) if view is not None else None
+            out = self._run_pipeline()
         except Exception:
             self._bail(self.ctx, "run")
-            view = out = None  # device died mid-run: fall back whole
-        if view is None:
+            out = None  # device died mid-run: fall back whole
+        if out is None:
             # runtime bail (replica vanished, device error): rebuild on
             # the per-operator executors, which carry their own fallbacks
             _close_node(self._node)
@@ -1140,6 +1300,71 @@ class DevPipeExec:
             self._open_fallback(self.ctx)
             return self._fallback.next()
         return out if out.num_rows() else None
+
+    def _run_pipeline(self) -> Optional[Chunk]:
+        """Prepare the node tree (host work + input collection), then run
+        the WHOLE pipeline as one jitted program.  Small outputs fold the
+        result packing into the same program: one dispatch, one D2H."""
+        pb = _PipeBuilder()
+        tv = self._node.prepare(pb)
+        if tv is None:
+            return None
+        jn = _jn()
+        nb = tv.nb
+        ncols = len(tv.meta)
+        small = nb <= kernels.SMALL_PACK
+        key = ("pipe", small, tuple(pb.kparts))
+        ent = _JIT_CACHE.get(key)
+        if small:
+            if ent is None:
+                jx = kernels.jax()
+                schema: list = []
+                emit = tv.emit
+
+                def mega(args):
+                    valid, cols = emit(args)
+                    flat = [valid]
+                    for v, m in cols:
+                        flat.append(v)
+                        flat.append(m)
+                    return kernels.pack_arrays(schema, flat)
+                ent = _JIT_CACHE[key] = (jx.jit(mega), schema)
+                COMPILED_NODE_KEYS.update(pb.kparts)
+            fn, schema = ent
+            vals = kernels.unpack_flat(fn(pb.inputs), schema)
+            keep = np.nonzero(vals[0])[0]
+            host = [(vals[1 + 2 * i][keep], vals[2 + 2 * i][keep])
+                    for i in range(ncols)]
+        else:
+            if ent is None:
+                jx = kernels.jax()
+                emit = tv.emit
+
+                def mega(args):
+                    valid, cols = emit(args)
+                    return [valid] + [x for vm in cols for x in vm]
+                ent = _JIT_CACHE[key] = (jx.jit(mega), None)
+                COMPILED_NODE_KEYS.update(pb.kparts)
+            fn, _ = ent
+            res = fn(pb.inputs)
+            valid, items = res[0], list(res[1:])
+            ckey = ("nvalid", nb)
+            cent = _JIT_CACHE.get(ckey)
+            if cent is None:
+                jx = kernels.jax()
+                cent = _JIT_CACHE[ckey] = (
+                    jx.jit(lambda v: jn.sum(v.astype(jn.int64))), None)
+            n_valid = int(cent[0](valid))
+            if n_valid == 0:
+                host = [(np.empty(0, dtype=np.int64),
+                         np.empty(0, dtype=bool))] * ncols
+            else:
+                ob = min(kernels.bucket(n_valid), nb)
+                _ids, vals = kernels._present_pack(
+                    valid.astype(jn.int64), items, ob)
+                host = [(vals[2 * i][:n_valid], vals[2 * i + 1][:n_valid])
+                        for i in range(ncols)]
+        return _to_chunk(host, tv.meta)
 
     def drain(self) -> List[list]:
         rows = []
