@@ -100,24 +100,29 @@ def _analyze_partial(req: DAGRequest, chk: Chunk) -> list:
     return [{"rows": n, "cols": out_cols}]
 
 
-def _partial_agg(agg_pb: dict, chk: Chunk) -> list:
-    """Per-region PARTIAL1 aggregation (reference: mocktikv/aggregate.go),
-    numpy-vectorized: factorize group keys, then bincount/reduce-at per
-    aggregate; row-at-a-time only for shapes numpy cannot reduce
-    (DISTINCT, string-valued min/max)."""
-    import numpy as np
+def _parse_agg_pb(agg_pb: dict):
     gb = [pb_to_expr(d) for d in agg_pb["group_by"]]
-    descs = []
-    for a in agg_pb["aggs"]:
-        descs.append(AggFuncDesc(a["name"], [pb_to_expr(x) for x in a["args"]],
-                                 AggMode.PARTIAL1, a["distinct"],
-                                 _ft_from_pb(a["ret"]) if "ret" in a
-                                 else None))
+    descs = [AggFuncDesc(a["name"], [pb_to_expr(x) for x in a["args"]],
+                         AggMode.PARTIAL1, a["distinct"],
+                         _ft_from_pb(a["ret"]) if "ret" in a else None)
+             for a in agg_pb["aggs"]]
+    return gb, descs
+
+
+def _partial_agg_pairs(agg_pb: dict, chk: Chunk):
+    """Columnar per-region PARTIAL1 aggregation: factorize group keys,
+    bincount/reduce-at per aggregate.  Returns (pairs, uns_flags) where
+    pairs = [(np values, np null)] per output column (group keys then
+    partial-state columns, RAW int64 representation for wrapped unsigned)
+    and uns_flags marks which columns hold wrapped unsigned ints — or
+    None for shapes numpy cannot reduce (DISTINCT, string min/max)."""
+    import numpy as np
+    gb, descs = _parse_agg_pb(agg_pb)
     n = chk.num_rows()
     if n == 0:
-        return []
+        return [], []
     if any(d.distinct for d in descs):
-        return _partial_agg_rows(gb, descs, chk)
+        return None
 
     # ---- factorize the group keys -------------------------------------
     codes = np.zeros(n, dtype=np.int64)
@@ -125,6 +130,7 @@ def _partial_agg(agg_pb: dict, chk: Chunk) -> list:
     total = 1
     for e in gb:
         v, null = e.vec_eval(chk)
+        raw = v
         if v.dtype == object:
             v = np.where(null, "", v).astype(str)
         kc, inv = np.unique(v, return_inverse=True)
@@ -132,32 +138,78 @@ def _partial_agg(agg_pb: dict, chk: Chunk) -> list:
         inv = np.where(null, len(kc), inv)
         total *= len(kc) + 1
         if total > (1 << 62):  # composite code would overflow int64
-            return _partial_agg_rows(gb, descs, chk)
+            return None
         codes = codes * (len(kc) + 1) + inv
-        key_cols.append((v, null))
+        key_cols.append((raw, null))
     uniq, gid, counts = np.unique(codes, return_inverse=True,
                                   return_counts=True)
     ng = len(uniq)
     first_idx = np.full(ng, n, dtype=np.int64)
     np.minimum.at(first_idx, gid, np.arange(n))
 
-    out_cols = []  # one list per output column, each length ng
+    pairs = []
+    uns_flags = []
     for v, null in key_cols:
-        vals = v[first_idx]
-        out_cols.append([None if null[first_idx[g]] else _sem(vals[g])
-                         for g in range(ng)])
-
+        pairs.append((v[first_idx], null[first_idx]))
+        uns_flags.append(False)
     for d in descs:
         cols = _vector_partial(d, chk, gid, ng, first_idx)
         if cols is None:
-            return _partial_agg_rows(gb, descs, chk)
-        out_cols.extend(cols)
-    return [[c[g] for c in out_cols] for g in range(ng)]
+            return None
+        for v, nl, uns in cols:
+            pairs.append((v, nl))
+            uns_flags.append(uns)
+    return pairs, uns_flags
+
+
+def _partial_agg(agg_pb: dict, chk: Chunk) -> list:
+    """Per-region PARTIAL1 aggregation as rows (the wire-path shape,
+    reference mocktikv/aggregate.go); row-at-a-time only for shapes
+    numpy cannot reduce."""
+    import numpy as np
+    got = _partial_agg_pairs(agg_pb, chk)
+    if got is None:
+        gb, descs = _parse_agg_pb(agg_pb)
+        return _partial_agg_rows(gb, descs, chk)
+    pairs, uns_flags = got
+    if not pairs:
+        return []
+    cols_py = []
+    for (v, nl), uns in zip(pairs, uns_flags):
+        lst = v.tolist()
+        if uns:
+            lst = [x + (1 << 64) if x < 0 else x for x in lst]
+        for i in np.nonzero(nl)[0]:
+            lst[i] = None
+        cols_py.append(lst)
+    return [list(t) for t in zip(*cols_py)]
+
+
+def partial_agg_chunk(agg_pb: dict, chk: Chunk,
+                      fts: List[FieldType]) -> Optional[Chunk]:
+    """Columnar partial aggregation straight into a Chunk — the
+    in-process replica fast path (no per-row marshalling).  Wrapped
+    unsigned values stay raw; `fts` carries the unsigned flags.  Falls
+    back to the row interpreter for unsupported shapes."""
+    from ..chunk import Column as CCol
+    got = _partial_agg_pairs(agg_pb, chk)
+    if got is None:
+        rows = _partial_agg(agg_pb, chk)
+        out = Chunk(fts, cap=max(len(rows), 1))
+        for r in rows:
+            out.append_row(r)
+        return out
+    pairs, _uns = got
+    if not pairs:
+        return Chunk(fts, cap=1)
+    return Chunk.from_columns(
+        [CCol.from_numpy(ft, v, nl) for ft, (v, nl) in zip(fts, pairs)])
 
 
 def _vector_partial(d: AggFuncDesc, chk: Chunk, gid, ng, first_idx):
-    """Vectorized partial state columns for one descriptor, or None when
-    the shape needs the row fallback."""
+    """Vectorized partial state columns for one descriptor as
+    [(values, null, is_wrapped_unsigned)], or None when the shape needs
+    the row fallback."""
     import numpy as np
     from ..expression import Constant
     name = d.name
@@ -171,7 +223,7 @@ def _vector_partial(d: AggFuncDesc, chk: Chunk, gid, ng, first_idx):
             live = ~null
         cnt = np.bincount(gid, weights=live.astype(np.float64),
                           minlength=ng).astype(np.int64)
-        return [list(cnt)]
+        return [(cnt, np.zeros(ng, dtype=bool), False)]
     if name == "sum":
         v, null = d.args[0].vec_eval(chk)
         if v.dtype == object or v.dtype.kind == "U":
@@ -186,22 +238,12 @@ def _vector_partial(d: AggFuncDesc, chk: Chunk, gid, ng, first_idx):
             if uns:
                 w = np.where(live & (v < 0), w + 2.0**64, w)
             s = np.bincount(gid, weights=w, minlength=ng)
-            return [[None if cnt[g] == 0 else float(s[g])
-                     for g in range(ng)]]
+            return [(s, cnt == 0, False)]
         # int sums: exact mod-2^64 accumulation via int64 reduce-at
         s = np.zeros(ng, dtype=np.int64)
         with np.errstate(over="ignore"):
             np.add.at(s, gid[live], v[live])
-        out = []
-        for g in range(ng):
-            if cnt[g] == 0:
-                out.append(None)
-            else:
-                x = int(s[g])
-                if uns and x < 0:
-                    x += 1 << 64
-                out.append(x)
-        return [out]
+        return [(s, cnt == 0, uns)]
     if name in ("max", "min"):
         v, null = d.args[0].vec_eval(chk)
         if v.dtype == object or v.dtype.kind == "U":
@@ -219,24 +261,12 @@ def _vector_partial(d: AggFuncDesc, chk: Chunk, gid, ng, first_idx):
         op.at(acc, gid[live], work[live])
         cnt = np.bincount(gid, weights=live.astype(np.float64),
                           minlength=ng).astype(np.int64)
-        out = []
-        for g in range(ng):
-            if cnt[g] == 0:
-                out.append(None)
-            else:
-                x = acc[g]
-                if uns:
-                    x = int(x) ^ -(2**63)
-                    if x < 0:
-                        x += 1 << 64
-                    out.append(x)
-                else:
-                    out.append(_sem(x))
-        return [out]
+        if uns:
+            acc = acc ^ np.int64(-2**63)  # back to the raw wrapped form
+        return [(acc, cnt == 0, uns)]
     if name == "first_row":
         v, null = d.args[0].vec_eval(chk)
-        return [[None if null[first_idx[g]] else _sem(v[first_idx[g]])
-                 for g in range(ng)]]
+        return [(v[first_idx], null[first_idx], False)]
     return None  # avg never appears: split() emits sum+count partials
 
 

@@ -243,10 +243,11 @@ class TableReaderExec(Executor):
 
     def _next_local_agg(self) -> Optional[Chunk]:
         """Local partial aggregation over raw chunks — from the columnar
-        replica or (dirty txn) the union-store scan.  One output batch per
-        raw batch: partials merge at the root FINAL agg, so per-batch
-        groups are sound."""
-        from ..distsql.copr import _partial_agg
+        replica or (dirty txn) the union-store scan.  Each slice gets one
+        columnar pass (factorize + bincount straight into a Chunk, no
+        per-row marshalling); per-slice partial groups merge at the root
+        FINAL agg, which is also vectorized."""
+        from ..distsql.copr import partial_agg_chunk
         limit = max(self.ctx.max_chunk_size, 4096)
         scan_fts = [c.ret_type for c in self.scan.schema.columns]
         while True:
@@ -262,15 +263,13 @@ class TableReaderExec(Executor):
                     self._iter = None
                     return None
             if self.scan.filters:
-                mask = vectorized_filter(self.scan.filters, raw)
+                mask = self._filter_mask(raw, self.scan.filters)
                 raw.set_sel(np.nonzero(mask)[0])
                 raw = raw.compact()
-            rows = _partial_agg(self.scan.pushed_agg, raw)
-            if not rows:
+            out = partial_agg_chunk(self.scan.pushed_agg, raw,
+                                    self.field_types())
+            if out is None or out.num_rows() == 0:
                 continue
-            out = Chunk(self.field_types(), cap=len(rows))
-            for r in rows:
-                out.append_row(r)
             return out
 
     def take_raw_replica(self):
@@ -297,17 +296,21 @@ class TableReaderExec(Executor):
         """Next unfiltered slice of the columnar replica."""
         rep = self._replica
         if self._pos >= rep.n_rows:
+            self._slice_range = None
             return None
         lo, hi = self._pos, min(self._pos + self.FAST_CHUNK, rep.n_rows)
         self._pos = hi
+        self._slice_range = (lo, hi)
         from ..chunk import Column as CCol
         cols = []
         for c, ci in zip(self.scan.schema.columns, self._decode_cols):
             if ci is None:
-                cols.append(CCol.from_numpy(c.ret_type, rep.handles[lo:hi]))
+                cols.append(CCol.wrap_raw(c.ret_type, rep.handles[lo:hi]))
             else:
                 v, m = rep.columns[ci.id]
-                cols.append(CCol.from_numpy(c.ret_type, v[lo:hi], m[lo:hi]))
+                # zero-copy views: keeps <U string dtype so filters
+                # compare in C (from_numpy would object-convert per batch)
+                cols.append(CCol.wrap_raw(c.ret_type, v[lo:hi], m[lo:hi]))
         return Chunk.from_columns(cols)
 
     def _fill_from_iter(self, chk: Chunk, limit: int) -> int:
@@ -341,10 +344,43 @@ class TableReaderExec(Executor):
 
     def _apply_filters(self, chk: Chunk) -> Chunk:
         if self.scan.filters:
-            mask = vectorized_filter(self.scan.filters, chk)
+            mask = self._filter_mask(chk, self.scan.filters)
             chk.set_sel(np.nonzero(mask)[0])
             chk = chk.compact()
         return chk
+
+    def _filter_mask(self, chk: Chunk, conds) -> np.ndarray:
+        """Filter mask over a replica slice or plain chunk.  On the
+        replica path, `string Column <op> string Constant` conditions run
+        as int compares over the replica's memoized dictionary codes
+        (order-preserving; the SAME memo the TPU tier's _code_cmp uses) —
+        the CPU analogue of the reference's storage-side selection."""
+        rng = getattr(self, "_slice_range", None)
+        rep = self._replica
+        if rep is None or rng is None:
+            return vectorized_filter(conds, chk)
+        from .tpu_executors import (_code_cmp, _parse_string_cmp, _slot_id,
+                                    rep_string_codes)
+        lo_r, hi_r = rng
+        mask = None
+        residual = []
+        for cond in conds:
+            sc = _parse_string_cmp(chk, cond)
+            if sc is None:
+                residual.append(cond)
+                continue
+            col, op, val = sc
+            sid = _slot_id(self, col.index)
+            v, nl = rep.columns[sid]
+            codes, card, _, uniques = rep_string_codes(rep, sid, v, nl)
+            klo = int(np.searchsorted(uniques, val, side="left"))
+            khi = int(np.searchsorted(uniques, val, side="right"))
+            m = _code_cmp(np, op, codes[lo_r:hi_r], klo, khi, card)
+            mask = m if mask is None else (mask & m)
+        if residual:
+            m = vectorized_filter(residual, chk)
+            mask = m if mask is None else (mask & m)
+        return mask
 
     def _finish_hydrate(self) -> None:
         """A completed full scan hydrates the columnar replica so the next
@@ -639,11 +675,181 @@ class HashAggExec(Executor):
         super().open(ctx)
         self._done = False
 
+    def _vec_gate(self) -> bool:
+        """All-numpy aggregation path: COMPLETE-mode, non-distinct
+        count/sum/avg/min/max/first_row.  Accumulation order matches the
+        row loop bit-for-bit (bincount adds in row order), so results are
+        identical, just without the per-row Python."""
+        from ..expression.aggregation import (AGG_AVG, AGG_COUNT,
+                                              AGG_FIRST_ROW, AGG_MAX,
+                                              AGG_MIN, AGG_SUM)
+        ok = {AGG_COUNT, AGG_SUM, AGG_AVG, AGG_MIN, AGG_MAX, AGG_FIRST_ROW}
+        for d in self.plan.aggs:
+            # FINAL merges are vectorizable too: count/sum merge = add,
+            # avg merges (sum, count) partial columns, min/max/first_row
+            # merge = update
+            if d.distinct:
+                return False
+            if d.name not in ok:
+                return False
+            if d.name in (AGG_MIN, AGG_MAX):
+                a = d.args[0]
+                # string / wrapped-unsigned compare orders need the
+                # row-path semantics
+                if a.eval_type is EvalType.STRING or _uns_of(a):
+                    return False
+        return True
+
+    def _vec_agg(self) -> Optional[Chunk]:
+        from ..chunk import Column as CCol
+        from ..expression.aggregation import (AGG_AVG, AGG_COUNT,
+                                              AGG_FIRST_ROW, AGG_MAX,
+                                              AGG_MIN, AGG_SUM)
+        plan = self.plan
+        child = self.children[0]
+        chunks = []
+        while True:
+            chk = child.next()
+            if chk is None:
+                break
+            chk = chk.compact()
+            if chk.num_rows():
+                chunks.append(chk)
+        total = sum(c.num_rows() for c in chunks)
+        if total == 0:
+            if plan.group_by:
+                return None
+            # COUNT()=0 / SUM()=NULL single row over empty input
+            states = [new_state(d) for d in plan.aggs]
+            out = Chunk(self.field_types(), cap=1)
+            out.append_row([states[i].result() if src == "agg" else None
+                            for src, i in plan.output_map])
+            return out
+
+        def cat(expr):
+            vs, ns = [], []
+            for c in chunks:
+                v, nl = expr.vec_eval(c)
+                vs.append(np.asarray(v))
+                ns.append(np.asarray(nl))
+            return np.concatenate(vs), np.concatenate(ns)
+
+        # ---- group ids: factorize each key column, combine, relabel in
+        # first-occurrence order (matches the dict path's insertion order)
+        kdata = [cat(e) for e in plan.group_by]
+        gid = np.zeros(total, dtype=np.int64)
+        for v, nl in kdata:
+            if v.dtype == object or v.dtype.kind == "U":
+                sv = np.where(nl, "", v).astype(str)
+            else:
+                sv = np.where(nl, v[0], v)
+            _, inv = np.unique(sv, return_inverse=True)
+            inv = inv.astype(np.int64)
+            card = int(inv.max()) + 1
+            code = np.where(nl, card, inv)
+            _, gid = np.unique(gid * (card + 1) + code,
+                               return_inverse=True)
+            gid = gid.astype(np.int64)
+        ug, first_idx, inv2 = np.unique(gid, return_index=True,
+                                        return_inverse=True)
+        order = np.argsort(first_idx, kind="stable")
+        relabel = np.empty(len(ug), dtype=np.int64)
+        relabel[order] = np.arange(len(ug), dtype=np.int64)
+        gid = relabel[inv2.astype(np.int64)]
+        first_idx = first_idx[order]
+        ng = len(ug)
+
+        def to_real(v, uns):
+            fv = v.astype(np.float64)
+            if uns and v.dtype == np.int64:
+                fv = np.where(v < 0, fv + 2.0**64, fv)
+            return fv
+
+        from ..expression.aggregation import AggMode
+        out_aggs = []
+        for d in plan.aggs:
+            name = d.name
+            final = d.mode is AggMode.FINAL
+            if name == AGG_COUNT:
+                if final:
+                    # merge: sum the partial counts (None partials skip)
+                    v, nl = cat(d.args[0])
+                    m = ~nl
+                    acc = np.zeros(ng, dtype=np.int64)
+                    np.add.at(acc, gid[m], v[m].astype(np.int64))
+                    out_aggs.append((acc, np.zeros(ng, dtype=bool)))
+                    continue
+                m = np.ones(total, dtype=bool)
+                for a in d.args:
+                    _, nl = cat(a)
+                    m &= ~nl
+                cnt = np.bincount(gid[m], minlength=ng).astype(np.int64)
+                out_aggs.append((cnt, np.zeros(ng, dtype=bool)))
+            elif name == AGG_AVG and final:
+                # FINAL avg over (sum, count) partial columns
+                sm, snl = cat(d.args[0])
+                cn, cnl = cat(d.args[1])
+                m = ~cnl & (cn != 0)
+                n_acc = np.zeros(ng, dtype=np.int64)
+                np.add.at(n_acc, gid[m], cn[m].astype(np.int64))
+                w = np.where(snl, 0.0, to_real(sm, False))
+                s = np.bincount(gid[m], weights=w[m], minlength=ng)
+                out_aggs.append((s / np.maximum(n_acc, 1), n_acc == 0))
+            elif name in (AGG_SUM, AGG_AVG):
+                # sum merge (FINAL) = sum update: one shared path
+                a = d.args[0]
+                v, nl = cat(a)
+                m = ~nl
+                cnt = np.bincount(gid[m], minlength=ng).astype(np.int64)
+                if name == AGG_SUM \
+                        and d.ret_type.eval_type is EvalType.INT:
+                    acc = np.zeros(ng, dtype=np.int64)
+                    np.add.at(acc, gid[m], v[m].astype(np.int64))
+                    out_aggs.append((acc, cnt == 0))
+                else:
+                    s = np.bincount(gid[m], weights=to_real(v, _uns_of(a))[m],
+                                    minlength=ng)
+                    if name == AGG_AVG:
+                        s = s / np.maximum(cnt, 1)
+                    out_aggs.append((s, cnt == 0))
+            elif name in (AGG_MIN, AGG_MAX):
+                a = d.args[0]
+                v, nl = cat(a)
+                m = ~nl
+                g2, v2 = gid[m], v[m]
+                res = np.zeros(ng, dtype=v.dtype)
+                rnull = np.ones(ng, dtype=bool)
+                if len(g2):
+                    o = np.argsort(g2, kind="stable")
+                    g2s, v2s = g2[o], v2[o]
+                    starts = np.nonzero(
+                        np.r_[True, g2s[1:] != g2s[:-1]])[0]
+                    red = (np.maximum if name == AGG_MAX
+                           else np.minimum).reduceat(v2s, starts)
+                    present = g2s[starts]
+                    res[present] = red
+                    rnull[present] = False
+                out_aggs.append((res, rnull))
+            else:  # AGG_FIRST_ROW
+                v, nl = cat(d.args[0])
+                out_aggs.append((v[first_idx], nl[first_idx]))
+
+        out_cols = []
+        for (src, idx), oc in zip(plan.output_map, self.schema.columns):
+            if src == "agg":
+                v, nl = out_aggs[idx]
+            else:
+                v, nl = kdata[idx][0][first_idx], kdata[idx][1][first_idx]
+            out_cols.append(CCol.from_numpy(oc.ret_type, v, nl))
+        return Chunk.from_columns(out_cols)
+
     def next(self) -> Optional[Chunk]:
         if self._done:
             return None
         self._done = True
         plan = self.plan
+        if self._vec_gate():
+            return self._vec_agg()
         groups: Dict[tuple, list] = {}
         gb_vals: Dict[tuple, list] = {}
         child = self.children[0]
@@ -722,7 +928,14 @@ class HashJoinExec(Executor):
         self._build_rows: List[list] = []
         self._table: Dict[tuple, List[int]] = {}
         self._ht = None
+        self._build_chunk: Optional[Chunk] = None
         use_native = self._native_fast_ok() and native.lib() is not None
+        # fully-columnar path: native table + no per-row residual conds
+        # means build AND probe stay vectorized end to end
+        self._vec_ok = use_native and not plan.other_conditions
+        if self._vec_ok:
+            self._build_chunk = Chunk(
+                [c.ret_type for c in self.children[1].schema.columns])
         nat_keys: List[np.ndarray] = []
         while True:
             chk = build.next()
@@ -737,8 +950,13 @@ class HashJoinExec(Executor):
                 v, null = plan.right_keys[0].vec_eval(chk)
                 keep = np.nonzero(~null)[0]  # NULL keys never equi-match
                 nat_keys.append(np.asarray(v, dtype=np.int64)[keep])
-                for i in keep:
-                    self._build_rows.append(chk.get_row(int(i)))
+                if self._vec_ok:
+                    for dst, src in zip(self._build_chunk.columns,
+                                        chk.columns):
+                        dst.extend_take(src, keep)
+                else:
+                    for i in keep:
+                        self._build_rows.append(chk.get_row(int(i)))
                 continue
             keys = [(*e.vec_eval(chk), _uns_of(e)) for e in plan.right_keys]
             for i in range(chk.num_rows()):
@@ -761,6 +979,8 @@ class HashJoinExec(Executor):
             self._build()
         plan = self.plan
         left = self.children[0]
+        if self._ht is not None and self._vec_ok:
+            return self._next_vec(left, plan)
         out_limit = self.ctx.max_chunk_size
         out = Chunk(self.field_types(), cap=out_limit)
         while True:
@@ -810,6 +1030,73 @@ class HashJoinExec(Executor):
             if out.num_rows() >= out_limit:
                 return out
         return out if out.num_rows() else None
+
+    def _next_vec(self, left, plan) -> Optional[Chunk]:
+        """Fully vectorized probe (the hot path the reference runs in its
+        probe workers, join.go:325): native hash probe gives per-row match
+        ids/counts; the joined chunk assembles by columnar fancy-indexing
+        — np.repeat(probe) x gather(build) — with LEFT-join null extension
+        appended as a block.  No per-row Python."""
+        from ..chunk import Column as CCol
+        fields = self.field_types()
+        bcols = self._build_chunk.columns
+        outer = plan.tp == "left"
+        while True:
+            chk = left.next()
+            if chk is None:
+                return None
+            chk = chk.compact()
+            n = chk.num_rows()
+            if n == 0:
+                continue
+            lmask = None
+            if plan.left_conditions:
+                mask = vectorized_filter(plan.left_conditions, chk)
+                if outer:
+                    # ON-clause left conds decide matching — a failing
+                    # outer row null-extends instead of dropping
+                    lmask = mask
+                else:
+                    chk.set_sel(np.nonzero(mask)[0])
+                    chk = chk.compact()
+                    n = chk.num_rows()
+                    if n == 0:
+                        continue
+            v, null = plan.left_keys[0].vec_eval(chk)
+            ids, counts = self._ht.probe(np.asarray(v, dtype=np.int64),
+                                         ~null)
+            ids = np.asarray(ids, dtype=np.int64)
+            counts = np.asarray(counts, dtype=np.int64)
+            if lmask is not None:
+                ids = ids[np.repeat(lmask, counts)]
+                counts = np.where(lmask, counts, 0)
+            pidx = np.repeat(np.arange(n, dtype=np.int64), counts)
+            un = np.nonzero(counts == 0)[0] if outer \
+                else np.empty(0, dtype=np.int64)
+            n_un = len(un)
+            if len(pidx) == 0 and n_un == 0:
+                continue
+            pairs = []
+            for c in chk.columns:
+                vv, mm = c.values(), c.null_mask()
+                if n_un:
+                    pairs.append((np.concatenate([vv[pidx], vv[un]]),
+                                  np.concatenate([mm[pidx], mm[un]])))
+                else:
+                    pairs.append((vv[pidx], mm[pidx]))
+            for c in bcols:
+                vv, mm = c.values(), c.null_mask()
+                va, ma = vv[ids], mm[ids]
+                if n_un:
+                    filler = (np.full(n_un, None, dtype=object)
+                              if vv.dtype == object
+                              else np.zeros(n_un, dtype=vv.dtype))
+                    va = np.concatenate([va, filler])
+                    ma = np.concatenate([ma, np.ones(n_un, dtype=bool)])
+                pairs.append((va, ma))
+            return Chunk.from_columns(
+                [CCol.from_numpy(ft, va, ma)
+                 for ft, (va, ma) in zip(fields, pairs)])
 
     def _others_ok(self, joined_row) -> bool:
         return _eval_other_conds(self.plan.other_conditions, joined_row)

@@ -178,19 +178,23 @@ def _build_device_mask(ex, rep, chk, conds):
     return mask_fn, tuple(keys), pt.arrays(), needed
 
 
-def _rep_string_dict(rep, sid, chk, idx):
+def rep_string_codes(rep, sid, v, null):
     """Ordered dictionary codes for a string replica column, memoized per
-    replica version in the SAME slot the group-key path uses:
-    (codes int64 [n] with NULL -> card, card, base=0, uniques)."""
+    replica version: (codes int64 [n] with NULL -> card, card, base=0,
+    uniques).  ONE builder for every consumer of the ("keycodes", ...)
+    memo slot (TPU group keys, device masks, CPU string filters) so the
+    cached tuple shape can never drift between tiers."""
     def build():
-        col = chk.columns[idx]
-        v = col.values()
-        null = col.null_mask()
         safe = np.where(null, "", v)
         uniques, codes = np.unique(safe.astype(str), return_inverse=True)
         codes = np.where(null, len(uniques), codes).astype(np.int64)
         return codes, len(uniques), 0, uniques
     return rep.memo(("keycodes", sid, True, False), build)
+
+
+def _rep_string_dict(rep, sid, chk, idx):
+    col = chk.columns[idx]
+    return rep_string_codes(rep, sid, col.values(), col.null_mask())
 
 
 def _slot_id(ex, idx: int):
