@@ -33,12 +33,58 @@ def _ensure_live_backend():
     print(f"[bench] jax backend: {plat}", file=sys.stderr)
 
 
+def _link_probe() -> dict:
+    """Measure the device link at bench start so the JSON alone answers
+    'was that wall number the engine or the tunnel' (VERDICT r2 weak-3):
+    per-dispatch RTT (tiny program + scalar D2H, 5 samples), D2H and H2D
+    bandwidth on a 32MB buffer."""
+    import numpy as np
+    out = {}
+    try:
+        from tinysql_tpu.ops import kernels
+        jn = kernels.jnp()
+        jx = kernels.jax()
+        fn = jx.jit(lambda a, b: jn.sum(a) + jn.sum(b))
+        small = jn.zeros(16, dtype=jn.int64)
+        float(np.asarray(fn(small, small)))  # warm compile
+        rtts = []
+        for _ in range(5):
+            t0 = time.time()
+            float(np.asarray(fn(small, small)))
+            rtts.append(round(time.time() - t0, 4))
+        mb = 32
+        host = np.zeros(mb * 131072, dtype=np.float64)  # 32MB
+        t0 = time.time()
+        dev = jn.asarray(host)
+        dev.block_until_ready()
+        h2d_s = time.time() - t0
+        big = jx.jit(lambda a: a + 1.0)(dev)
+        np.asarray(big[:8])  # force execution before timing the download
+        t0 = time.time()
+        np.asarray(big)
+        d2h_s = time.time() - t0
+        out = {
+            "backend": jx.devices()[0].platform,
+            "rtt_s": rtts,
+            "rtt_median_s": sorted(rtts)[len(rtts) // 2],
+            "h2d_mb_s": round(mb / max(h2d_s, 1e-9), 1),
+            "d2h_mb_s": round(mb / max(d2h_s, 1e-9), 1),
+        }
+    except Exception as e:  # pragma: no cover
+        out = {"error": str(e)}
+    print(f"[bench] link probe: {out}", file=sys.stderr)
+    return out
+
+
 def main():
     t_start = time.time()
     _ensure_live_backend()
     sf = float(os.environ.get("TPCH_SF", "1"))
     from tinysql_tpu.session.session import new_session
     from tinysql_tpu.bench import tpch
+    from tinysql_tpu.ops import kernels
+
+    link = _link_probe()
 
     s = new_session()
     print(f"[bench] generating + loading TPC-H SF={sf} ...", file=sys.stderr)
@@ -51,24 +97,33 @@ def main():
     lite = _sqlite_baseline(data)
 
     profile_dir = os.environ.get("TPCH_PROFILE")
+    run_stats = {}
 
     def run(sql, tier):
         s.execute(f"set @@tidb_use_tpu = {1 if tier == 'tpu' else 0}")
         best = float("inf")
         rows = None
         phases = {}
+        walls = []
+        stats = {}
         for _ in range(3):
+            snap = kernels.stats_snapshot()
             t0 = time.time()
             rows = s.query(sql).rows
             dt = time.time() - t0
+            walls.append(round(dt, 4))
             if dt < best:
                 best = dt
                 phases = dict(s.last_query_info)
+                stats = kernels.stats_delta(snap)
         if tier == "tpu":
             print(f"[bench] phases parse={phases.get('parse_s', 0)*1e3:.1f}ms"
                   f" plan={phases.get('plan_s', 0)*1e3:.1f}ms"
-                  f" exec={phases.get('exec_s', 0)*1e3:.1f}ms",
-                  file=sys.stderr)
+                  f" exec={phases.get('exec_s', 0)*1e3:.1f}ms "
+                  f"programs={stats.get('dispatches')} "
+                  f"d2h={stats.get('d2h_transfers')}x/"
+                  f"{stats.get('d2h_bytes')}B", file=sys.stderr)
+            run_stats[sql] = {"runs_s": walls, **stats}
         return best, rows
 
     if profile_dir:
@@ -112,9 +167,11 @@ def main():
         "detail": {
             name: {"tpu_s": round(t, 4), "cpu_s": round(c, 4),
                    "sqlite_cpu_s": round(l, 4),
-                   "speedup_vs_sqlite": round(l / t, 3), "match": ok}
+                   "speedup_vs_sqlite": round(l / t, 3), "match": ok,
+                   **run_stats.get(tpch.QUERIES[name], {})}
             for name, (t, c, l, ok) in results.items()
         },
+        "link": link,
         "correct": all(ok for _, _, _, ok in results.values()),
         "total_bench_seconds": round(time.time() - t_start, 1),
     }

@@ -1317,7 +1317,6 @@ class DevPipeExec:
         ent = _JIT_CACHE.get(key)
         if small:
             if ent is None:
-                jx = kernels.jax()
                 schema: list = []
                 emit = tv.emit
 
@@ -1328,7 +1327,7 @@ class DevPipeExec:
                         flat.append(v)
                         flat.append(m)
                     return kernels.pack_arrays(schema, flat)
-                ent = _JIT_CACHE[key] = (jx.jit(mega), schema)
+                ent = _JIT_CACHE[key] = (kernels.counted_jit(mega), schema)
                 COMPILED_NODE_KEYS.update(pb.kparts)
             fn, schema = ent
             vals = kernels.unpack_flat(fn(pb.inputs), schema)
@@ -1337,13 +1336,12 @@ class DevPipeExec:
                     for i in range(ncols)]
         else:
             if ent is None:
-                jx = kernels.jax()
                 emit = tv.emit
 
                 def mega(args):
                     valid, cols = emit(args)
                     return [valid] + [x for vm in cols for x in vm]
-                ent = _JIT_CACHE[key] = (jx.jit(mega), None)
+                ent = _JIT_CACHE[key] = (kernels.counted_jit(mega), None)
                 COMPILED_NODE_KEYS.update(pb.kparts)
             fn, _ = ent
             res = fn(pb.inputs)
@@ -1351,10 +1349,9 @@ class DevPipeExec:
             ckey = ("nvalid", nb)
             cent = _JIT_CACHE.get(ckey)
             if cent is None:
-                jx = kernels.jax()
                 cent = _JIT_CACHE[ckey] = (
-                    jx.jit(lambda v: jn.sum(v.astype(jn.int64))), None)
-            n_valid = int(cent[0](valid))
+                    kernels.counted_jit(lambda v: jn.sum(v.astype(jn.int64))), None)
+            n_valid = int(kernels.d2h(cent[0](valid)))
             if n_valid == 0:
                 host = [(np.empty(0, dtype=np.int64),
                          np.empty(0, dtype=bool))] * ncols

@@ -1096,7 +1096,7 @@ class TPUSelectionExec(Executor):
     def _compiled(self):
         if self._fn is None:
             flt = compile_filter(self.plan.conditions)
-            self._fn = kernels.jax().jit(flt)
+            self._fn = kernels.counted_jit(flt)
         return self._fn
 
     def next(self) -> Optional[Chunk]:

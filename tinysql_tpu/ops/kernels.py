@@ -168,6 +168,38 @@ def jnp():
     return jax().numpy
 
 
+# device-economics counters (bench diagnosability, VERDICT r2 weak-3):
+# every compiled-program dispatch and packed D2H transfer increments
+# these, so BENCH json can split engine time from link time per query.
+STATS = {"dispatches": 0, "d2h_transfers": 0, "d2h_bytes": 0}
+
+
+def stats_snapshot() -> dict:
+    return dict(STATS)
+
+
+def stats_delta(since: dict) -> dict:
+    return {k: STATS[k] - since.get(k, 0) for k in STATS}
+
+
+def counted_jit(fn, **kw):
+    """jax.jit wrapper that counts program dispatches."""
+    w = jax().jit(fn, **kw)
+
+    def call(*a, **k):
+        STATS["dispatches"] += 1
+        return w(*a, **k)
+    return call
+
+
+def d2h(dev_arr) -> np.ndarray:
+    """Counted device->host materialization."""
+    out = np.asarray(dev_arr)
+    STATS["d2h_transfers"] += 1
+    STATS["d2h_bytes"] += out.nbytes
+    return out
+
+
 I64_MIN = -(1 << 63)
 
 
@@ -211,9 +243,9 @@ def pack_arrays(schema: list, arrays) -> tuple:
 def unpack_flat(pair, schema: list) -> List[np.ndarray]:
     """At most two D2H transfers, then split per the recorded schema."""
     dev_i, dev_f = pair
-    flat_i = np.asarray(dev_i) if any(s == "i" for _, _, s in schema) \
+    flat_i = d2h(dev_i) if any(s == "i" for _, _, s in schema) \
         else None
-    flat_f = np.asarray(dev_f) if any(s == "f" for _, _, s in schema) \
+    flat_f = d2h(dev_f) if any(s == "f" for _, _, s in schema) \
         else None
     out = []
     pi = pf = 0
@@ -272,7 +304,7 @@ def _slice_pack(items, ob: int):
 
         def kernel(arrs):
             return pack_arrays(schema, [a[:ob] for a in arrs])
-        ent = _PACK_CACHE[key] = (jax().jit(kernel), schema)
+        ent = _PACK_CACHE[key] = (counted_jit(kernel), schema)
     fn, schema = ent
     return unpack_flat(fn(items), schema)
 
@@ -292,7 +324,7 @@ def _present_pack(presence, items, ob: int):
             idx = jn_.nonzero(pres > 0, size=ob, fill_value=ns)[0]
             safe = jn_.minimum(idx, ns - 1)
             return pack_arrays(schema, [idx] + [a[safe] for a in arrs])
-        ent = _PACK_CACHE[key] = (jax().jit(kernel), schema)
+        ent = _PACK_CACHE[key] = (counted_jit(kernel), schema)
     fn, schema = ent
     vals = unpack_flat(fn(presence, items), schema)
     return vals[0], vals[1:]
@@ -385,7 +417,7 @@ def _group_agg_kernel(n_keys: int, specs: tuple):
                 raise ValueError(func)
         return n_groups, first_orig, group_keys, outs
 
-    return j.jit(kernel)
+    return counted_jit(kernel)
 
 
 def group_aggregate(key_cols: List[Tuple[np.ndarray, np.ndarray]],
@@ -479,7 +511,7 @@ def _segment_agg_kernel(specs: tuple, n_segments: int):
         n_present = jn.sum((presence > 0).astype(jn.int64))
         return presence, first_orig, outs, n_present
 
-    return j.jit(kernel)
+    return counted_jit(kernel)
 
 
 MAX_SEGMENTS = 1 << 16
@@ -742,7 +774,7 @@ def fused_segment_aggregate(dev_cols, gid_dev, n_segments: int,
                                    seg=seg)
             n_present = jn.sum((presence > 0).astype(jn.int64))
             return presence, first_orig, outs, n_present
-        fn = _FUSED_CACHE[key] = j.jit(kernel)
+        fn = _FUSED_CACHE[key] = counted_jit(kernel)
     presence, first_orig, outs, n_present = fn(dev_cols, gid_dev,
                                                mask_arr, params)
     return _present_extract(presence, first_orig, outs, n_present, ns,
@@ -805,7 +837,7 @@ def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
             for v, m in outs:
                 items += [v, m]
             return pack_arrays(kernel_schema, items)
-        ent = _FUSED_CACHE[key] = (j.jit(kernel), kernel_schema)
+        ent = _FUSED_CACHE[key] = (counted_jit(kernel), kernel_schema)
     fn, schema = ent
     return _unpack_scalar_agg(unpack_flat(fn(dev_cols, mask_arr, params),
                                           schema))
@@ -890,7 +922,7 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
             for v, m in outs:
                 items += [v, m]
             return pack_arrays(kernel_schema, items)
-        fn = _FUSED_CACHE[key] = (j.jit(packed), kernel_schema)
+        fn = _FUSED_CACHE[key] = (counted_jit(packed), kernel_schema)
     pfn, schema = fn
     vals = unpack_flat(pfn(tuple(dev_cols), gid_dev, mask_arr, params),
                        schema)
@@ -954,7 +986,7 @@ def _scalar_agg_kernel(specs: tuple):
             items += [v, m]
         return pack_arrays(schema, items)
 
-    return j.jit(kernel), schema
+    return counted_jit(kernel), schema
 
 
 def scalar_aggregate(agg_specs, arg_cols, n_rows: int,
@@ -1012,7 +1044,7 @@ def _join_count_kernel():
         eff_total = total + jn.sum((lvalid & (counts == 0)).astype(jn.int64))
         return counts, lo, rperm, jn.stack([total, eff_total])
 
-    return j.jit(kernel)
+    return counted_jit(kernel)
 
 
 def _join_expand_kernel(outer: bool, ob2: int):
@@ -1037,7 +1069,7 @@ def _join_expand_kernel(outer: bool, ob2: int):
         ri = jn.where(matched, rperm[ridx], -1)
         return pack_arrays(schema, [li, ri])
 
-    return j.jit(kernel), schema
+    return counted_jit(kernel), schema
 
 
 def join_match(lkey: Tuple[np.ndarray, np.ndarray], n_left: int,
@@ -1071,7 +1103,7 @@ def join_match(lkey: Tuple[np.ndarray, np.ndarray], n_left: int,
         cfn = _JOIN_COUNT_CACHE[ck] = _join_count_kernel()
     lv_dev = jn.asarray(lv)
     counts, lo, rperm, totals = cfn(lk, ln, lv_dev, rk, rn, jn.asarray(rv))
-    totals = np.asarray(totals)  # ONE scalar-pair sync
+    totals = d2h(totals)  # ONE scalar-pair sync
     n_out = int(totals[1]) if outer else int(totals[0])
     if n_out == 0:
         return (np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64))
@@ -1111,7 +1143,7 @@ def _unique_join_kernel():
         match = match & r_live[cand]
         return match, cand, jn.sum(match.astype(jn.int64))
 
-    return j.jit(kernel)
+    return counted_jit(kernel)
 
 
 def _unique_pick_kernel(ob: int, nlb: int, outer: bool):
@@ -1129,7 +1161,7 @@ def _unique_pick_kernel(ob: int, nlb: int, outer: bool):
         ri = jn.where(match[safe], cand[safe], -1)
         return pack_arrays(schema, [li, ri])
 
-    return j.jit(kernel), schema
+    return counted_jit(kernel), schema
 
 
 def unique_join_match(lkey, n_left: int, rkey, n_right: int,
@@ -1209,7 +1241,7 @@ def _sort_kernel(descs: tuple):
         ops.append(jn.where(valid, 0, 1).astype(jn.int8))  # invalid last
         return jn.lexsort(ops)
 
-    return j.jit(kernel)
+    return counted_jit(kernel)
 
 
 def sort_permutation(key_cols: List[Tuple[np.ndarray, np.ndarray]],
@@ -1224,7 +1256,7 @@ def sort_permutation(key_cols: List[Tuple[np.ndarray, np.ndarray]],
     fn = _SORT_CACHE.get(key)
     if fn is None:
         fn = _SORT_CACHE[key] = _sort_kernel(tuple(descs))
-    perm = np.asarray(fn(kv, kn, jn.asarray(valid)))
+    perm = d2h(fn(kv, kn, jn.asarray(valid)))
     return perm[:n_rows]
 
 
@@ -1238,7 +1270,7 @@ def _topk_kernel(kb: int):
         _, ids = j.lax.top_k(score, kb)
         return ids
 
-    return j.jit(kernel)
+    return counted_jit(kernel)
 
 
 def _topk_single(key, desc: bool, n_rows: int, k: int):
@@ -1297,7 +1329,7 @@ def _topk_single(key, desc: bool, n_rows: int, k: int):
     fn = _TOPK_CACHE.get(ck)
     if fn is None:
         fn = _TOPK_CACHE[ck] = _topk_kernel(kb)
-    ids = np.asarray(fn(jn.asarray(pad1(score, nb, pad_val))))[:k]
+    ids = d2h(fn(jn.asarray(pad1(score, nb, pad_val))))[:k]
     return ids[ids < n_rows]  # k may exceed the row count
 
 

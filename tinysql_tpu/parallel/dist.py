@@ -88,7 +88,7 @@ def make_sharded_group_sum(mesh, n_buckets: int):
         cnt = jax.lax.psum(partial_cnt, "shard")
         return total, cnt
 
-    return jax.jit(step)
+    return kernels.counted_jit(step)
 
 
 # =========================================================================
@@ -117,7 +117,7 @@ def make_broadcast_join_counts(mesh):
         total = jax.lax.psum(jnp.sum(counts), "shard")
         return counts[None, :], total
 
-    return jax.jit(step)
+    return kernels.counted_jit(step)
 
 
 # =========================================================================
