@@ -121,6 +121,14 @@ def choose_path(ds: LogicalDataSource, stats,
 
     paths = _skyline_prune(paths) + order_paths
 
+    # a nonempty table never estimates below one row (reference pseudo
+    # stats floor, planner/core/stats.go: pseudo estimates are fractions
+    # of pseudoRowCount, never zero) — EQ_DEFAULT x a tiny row count would
+    # otherwise render estRows 0.00 and feed the cost model garbage
+    if total >= 1.0:
+        for p in paths:
+            p.est_rows = min(total, max(p.est_rows, 1.0))
+
     for p in paths:
         if p.index is None:
             p.cost = p.est_rows if p.access_conds else total
@@ -240,6 +248,14 @@ def _skyline_prune(paths: List[AccessPath]) -> List[AccessPath]:
     return keep or paths
 
 
+
+def _out_rows(path_rows: float, resid: float) -> float:
+    """Reader output estimate: access rows x residual selectivity, floored
+    at one row whenever the access estimate itself says rows exist."""
+    v = path_rows * resid
+    return max(v, 1.0) if path_rows >= 1.0 else v
+
+
 # ===== physical construction ===============================================
 
 def build_reader(ds: LogicalDataSource, stats, with_handle: bool,
@@ -269,8 +285,8 @@ def build_reader(ds: LogicalDataSource, stats, with_handle: bool,
         scan.has_estimate = True
         scan.order_col_uid = pk_uid  # handle-ordered scan
         reader = PhysicalTableReader(scan)
-        reader.stats_row_count = path.est_rows * _residual_sel(
-            stats, path.remaining)
+        reader.stats_row_count = _out_rows(
+            path.est_rows, _residual_sel(stats, path.remaining))
         reader.has_estimate = True
         return reader
 
@@ -300,16 +316,16 @@ def build_reader(ds: LogicalDataSource, stats, with_handle: bool,
         iscan.output_sources = sources
         iscan.filters = _bind(path.remaining, ds.schema)
         reader = PhysicalIndexReader(iscan)
-        reader.stats_row_count = path.est_rows * _residual_sel(
-            stats, path.remaining)
+        reader.stats_row_count = _out_rows(
+            path.est_rows, _residual_sel(stats, path.remaining))
         reader.has_estimate = True
         return reader
 
     tscan = PhysicalTableScan(ds.table_info, ds.db_name, ds.alias,
                               ds.schema, with_handle)
     tscan.filters = _bind(path.remaining, ds.schema)
-    tscan.stats_row_count = path.est_rows * _residual_sel(
-        stats, path.remaining)
+    tscan.stats_row_count = _out_rows(
+        path.est_rows, _residual_sel(stats, path.remaining))
     tscan.has_estimate = True
     reader = PhysicalIndexLookUpReader(iscan, tscan)
     reader.stats_row_count = tscan.stats_row_count
