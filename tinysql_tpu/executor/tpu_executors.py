@@ -1087,16 +1087,42 @@ class TPUProjectionExec(Executor):
         return Chunk.from_columns(out_cols)
 
 
+_FILTER_CACHE: dict = {}
+
+
 class TPUSelectionExec(Executor):
     def __init__(self, plan: PhysicalSelection, child: Executor):
         super().__init__(plan.schema, [child])
         self.plan = plan
         self._fn = None
+        self._params = None
 
     def _compiled(self):
         if self._fn is None:
-            flt = compile_filter(self.plan.conditions)
-            self._fn = kernels.counted_jit(flt)
+            # params-compiled program shared at module level: constants
+            # ride runtime param slots (exprjit.ParamTable), so queries
+            # differing only in literals reuse ONE compiled program — no
+            # per-literal cache growth, no jit dispatch-cache miss from a
+            # fresh wrapper per query (executors are rebuilt per query).
+            from ..ops.exprjit import (ParamTable, compile_expr_params,
+                                       stable_shape_key)
+            key = tuple(stable_shape_key(c) for c in self.plan.conditions)
+            pt = ParamTable()
+            fns = [compile_expr_params(c, pt) for c in self.plan.conditions]
+            self._params = [kernels.jnp().asarray(a) for a in pt.arrays()]
+            fn = _FILTER_CACHE.get(key)
+            if fn is None:
+                jn = kernels.jnp()
+
+                def kernel(cols, params, fns=fns):
+                    n = cols[0][0].shape[0] if cols else 0
+                    mask = jn.ones((n,), dtype=bool)
+                    for f in fns:
+                        v, null = f(cols, params)
+                        mask = mask & (v != 0) & ~null
+                    return mask
+                fn = _FILTER_CACHE[key] = kernels.counted_jit(kernel)
+            self._fn = fn
         return self._fn
 
     def next(self) -> Optional[Chunk]:
@@ -1110,7 +1136,8 @@ class TPUSelectionExec(Executor):
             if not chk.columns:
                 mask = vectorized_filter(self.plan.conditions, chk)
             else:
-                mask = np.asarray(self._compiled()(_marshal(chk)))
+                mask = np.asarray(
+                    self._compiled()(_marshal(chk), tuple(self._params)))
             if not mask.any():
                 continue
             chk.set_sel(np.nonzero(mask)[0])

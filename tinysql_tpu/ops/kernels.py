@@ -109,13 +109,28 @@ def ensure_live_backend(jax_mod=None, timeout: float = None,
         "jax.config.update('jax_platforms', os.environ['TINYSQL_PROBE_PLATFORMS']); "
         "print(jax.devices()[0].platform)")
     env = dict(os.environ, TINYSQL_PROBE_PLATFORMS=effective)
-    try:
-        r = subprocess.run([sys.executable, "-c", cmd],
-                           capture_output=True, text=True, timeout=timeout,
-                           env=env)
-        ok = r.returncode == 0
-    except Exception:
-        ok = False
+    # bounded retry: a flapping tunnel gets TINYSQL_BACKEND_PROBE_RETRIES
+    # attempts (bench sets >1) with a short wait between, so a transient
+    # relay hiccup does not silently demote a whole bench run to cpu
+    attempts = max(1, int(os.environ.get("TINYSQL_BACKEND_PROBE_RETRIES",
+                                         "1")))
+    wait = float(os.environ.get("TINYSQL_BACKEND_PROBE_RETRY_WAIT", "15"))
+    ok = False
+    for i in range(attempts):
+        try:
+            r = subprocess.run([sys.executable, "-c", cmd],
+                               capture_output=True, text=True,
+                               timeout=timeout, env=env)
+            ok = r.returncode == 0
+        except Exception:
+            ok = False
+        if ok:
+            break
+        if i + 1 < attempts:
+            logging.getLogger("tinysql_tpu").warning(
+                "jax backend %r probe attempt %d/%d failed — retrying "
+                "in %.0fs", effective, i + 1, attempts, wait)
+            time_mod.sleep(wait)
     def _touch(path):
         try:
             os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -171,7 +186,19 @@ def jnp():
 # device-economics counters (bench diagnosability, VERDICT r2 weak-3):
 # every compiled-program dispatch and packed D2H transfer increments
 # these, so BENCH json can split engine time from link time per query.
-STATS = {"dispatches": 0, "d2h_transfers": 0, "d2h_bytes": 0}
+STATS = {"dispatches": 0, "d2h_transfers": 0, "d2h_bytes": 0,
+         "flops": 0.0, "bytes_accessed": 0.0}
+
+# when on, every counted_jit dispatch also accrues the program's XLA cost
+# analysis (flops / bytes accessed) into STATS — the bench's MFU and
+# HBM-bandwidth accounting (VERDICT r3 weak-4).  Off by default: the
+# one-time lower().compile() per (fn, shape) hits the persistent cache but
+# still costs a retrace.
+_COST_TRACKING = {"on": False}
+
+
+def enable_cost_tracking(flag: bool = True) -> None:
+    _COST_TRACKING["on"] = flag
 
 
 def stats_snapshot() -> dict:
@@ -182,12 +209,66 @@ def stats_delta(since: dict) -> dict:
     return {k: STATS[k] - since.get(k, 0) for k in STATS}
 
 
+def _arg_spec(tree):
+    import jax as j
+    return tuple((getattr(x, "shape", None), str(getattr(x, "dtype", type(x))))
+                 for x in j.tree_util.tree_leaves(tree))
+
+
+def _abstractify(tree):
+    """Replace arrays with ShapeDtypeStructs so pending cost analyses hold
+    no device buffers alive."""
+    import jax as j
+
+    def conv(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return j.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+    return j.tree_util.tree_map(conv, tree)
+
+
+# (costs dict, spec, jitted fn, abstract args) awaiting cost analysis —
+# resolved OUTSIDE the timed region (resolve_pending_costs), so the AOT
+# retrace never inflates the walls the MFU is computed from
+_PENDING_COSTS: list = []
+
+
+def resolve_pending_costs() -> None:
+    """Run the deferred cost analyses (bench calls this between timed
+    runs).  Unresolvable programs record (0, 0)."""
+    while _PENDING_COSTS:
+        costs, spec, w, absargs = _PENDING_COSTS.pop()
+        a, k = absargs
+        try:
+            ca = w.lower(*a, **k).compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):  # older jax: dict per device
+                ca = ca[0] if ca else {}
+            costs[spec] = (float(ca.get("flops", 0.0) or 0.0),
+                           float(ca.get("bytes accessed", 0.0) or 0.0))
+        except Exception:
+            costs[spec] = (0.0, 0.0)
+
+
 def counted_jit(fn, **kw):
-    """jax.jit wrapper that counts program dispatches."""
+    """jax.jit wrapper that counts program dispatches (and, when cost
+    tracking is on, the dispatched program's flops / bytes accessed —
+    first sight of a (program, shape) only ENQUEUES the analysis; counts
+    accrue on dispatches after resolve_pending_costs ran)."""
     w = jax().jit(fn, **kw)
+    costs: Dict[tuple, Optional[tuple]] = {}
 
     def call(*a, **k):
         STATS["dispatches"] += 1
+        if _COST_TRACKING["on"]:
+            spec = _arg_spec((a, k))
+            c = costs.get(spec)
+            if c is not None:
+                STATS["flops"] += c[0]
+                STATS["bytes_accessed"] += c[1]
+            elif spec not in costs:
+                costs[spec] = None
+                _PENDING_COSTS.append((costs, spec, w,
+                                       _abstractify((a, k))))
         return w(*a, **k)
     return call
 
