@@ -44,12 +44,19 @@ def _both(s, sql):
 
 
 def _canon(rows):
-    out = []
-    for r in rows:
-        out.append(tuple("N" if v is None
-                         else (f"{v:.9g}" if isinstance(v, float) else v)
-                         for v in r))
-    return sorted(out)
+    # (type tag, str value) pairs: sortable with NULLs present, and a
+    # cross-tier TYPE regression (int vs str vs float) still fails
+    def cell(v):
+        if v is None:
+            return ("N", "")
+        if isinstance(v, float):
+            return ("f", f"{v:.9g}")
+        if isinstance(v, bool):
+            return ("i", str(int(v)))
+        if isinstance(v, int):
+            return ("i", str(v))
+        return ("s", str(v))
+    return sorted(tuple(cell(v) for v in r) for r in rows)
 
 
 def assert_match(s, sql, ordered=False):
@@ -63,12 +70,14 @@ def assert_match(s, sql, ordered=False):
 
 @pytest.fixture
 def counters(monkeypatch):
-    runs = {"join": 0, "agg": 0, "leaf": 0, "host": 0, "order": 0}
+    runs = {"join": 0, "agg": 0, "leaf": 0, "host": 0, "order": 0,
+            "sortgroup": 0}
     for cls, k in [(devpipe._JoinNode, "join"),
                    (devpipe._AggIndexNode, "agg"),
                    (devpipe._ReplicaLeaf, "leaf"),
                    (devpipe._HostLeaf, "host"),
-                   (devpipe._OrderNode, "order")]:
+                   (devpipe._OrderNode, "order"),
+                   (devpipe._SortGroupNode, "sortgroup")]:
         orig = cls.prepare
 
         def mk(orig, k):
@@ -278,7 +287,7 @@ def test_group_index_single_null_group():
     # collapse into ONE group (kernels._group_agg_kernel parity)
     vals = np.array([5, 1, 5, 9, 2, 7, 1], dtype=np.int64)
     nulls = np.array([False, True, False, True, False, True, False])
-    gi = devpipe.GroupIndex(vals, nulls)
+    gi = devpipe.GroupIndex([(vals, nulls)])
     assert gi.n_groups == 4  # {1, 2, 5}, one NULL group
     assert int(gi.gkey_null.sum()) == 1
     null_g = int(np.nonzero(gi.gkey_null)[0][0])
@@ -286,3 +295,104 @@ def test_group_index_single_null_group():
     assert int(gi.ends[null_g]) - start + 1 == 3  # all three NULL rows
     tbl = gi.pos_table()
     assert tbl is not None and (tbl >= 0).sum() == 3
+
+
+# ---- multi-key group-by on the replica leaf (_AggIndexNode) -------------
+
+def _gb_fixture(tk, n=4000, seed=23):
+    rng = np.random.default_rng(seed)
+    a = np.arange(1, n + 1, dtype=np.int64)
+    b = rng.integers(-5, 6, n).astype(np.int64)
+    bnull = rng.random(n) < 0.08
+    c = rng.random(n) * 100
+    cnull = rng.random(n) < 0.1
+    seg = np.array(["AA", "BB", "CC"])[rng.integers(0, 3, n)]
+    segnull = rng.random(n) < 0.05
+    d = rng.random(n) * 10
+    _load(tk, "g", "a bigint primary key, b bigint, c double, "
+                   "seg varchar(4), d double",
+          {"a": (a, None), "b": (b, bnull), "c": (c, cnull),
+           "seg": (seg, segnull), "d": (d, None)})
+
+
+def test_multikey_leaf_group_by_int_string(tk, counters):
+    _gb_fixture(tk)
+    assert_match(tk, "select b, seg, count(*), sum(c) from g "
+                     "group by b, seg order by b, seg")
+    assert counters["agg"] >= 1 and counters["sortgroup"] == 0
+    assert counters["host"] == 0
+
+
+def test_multikey_leaf_avg_min_max(tk, counters):
+    _gb_fixture(tk)
+    assert_match(tk, "select seg, b, avg(c), min(d), max(d), min(b), "
+                     "count(c) from g group by seg, b order by seg, b")
+    assert counters["agg"] >= 1
+
+
+def test_multikey_leaf_q1_shape(tk, counters):
+    """TPC-H Q1 shape: two string keys, sums of expressions, avgs,
+    count(*), filter, order by the keys — must run via the group index
+    (one device program when fused)."""
+    _gb_fixture(tk)
+    assert_match(tk, "select seg, b, sum(c) s1, sum(c * (1 - d/100)) s2, "
+                     "avg(c), avg(d), count(*) from g where d < 9.5 "
+                     "group by seg, b order by seg, b")
+    assert counters["agg"] >= 1 and counters["host"] == 0
+
+
+def test_single_key_real_group_by(tk, counters):
+    _gb_fixture(tk)
+    # float group keys: boundary on exact equality
+    tk.execute("insert into g values (100001, 1, 5.5, 'AA', 0.25)")
+    tk.execute("insert into g values (100002, 1, 5.5, 'BB', 0.25)")
+    assert_match(tk, "select d, count(*) from g group by d "
+                     "order by d limit 20")
+
+
+def test_group_by_above_join_sortgroup_final(tk, counters):
+    _fixture_tables(tk)
+    # agg pushdown rewrites this to partial-below-join + FINAL above:
+    # the sort-group node must merge the partial STATES on device
+    # (count -> sum of counts)
+    assert_match(tk, "select u.w, count(*), sum(t.c) from t join u "
+                     "on t.fk = u.k group by u.w")
+    assert counters["join"] >= 1 and counters["sortgroup"] >= 1
+
+
+def test_group_by_above_join_sortgroup_raw(tk, counters):
+    _fixture_tables(tk)
+    # agg args from BOTH sides defeat pushdown: the above-join agg stays
+    # in raw mode and must still run in-kernel
+    assert_match(tk, "select u.v, sum(t.c * u.w), avg(t.c), min(u.w) "
+                     "from t join u on t.fk = u.k group by u.v")
+    assert counters["sortgroup"] >= 1
+
+
+def test_group_by_above_join_multikey(tk, counters):
+    _fixture_tables(tk)
+    assert_match(tk, "select u.v, t.b, count(*), avg(t.c) from t join u "
+                     "on t.fk = u.k where t.c is not null "
+                     "group by u.v, t.b order by u.v, t.b limit 50")
+
+
+def test_sortgroup_null_keys_group_together(tk, counters):
+    _gb_fixture(tk)
+    # b has NULLs: all-NULL key rows form ONE group on both tiers
+    assert_match(tk, "select b, count(*), min(c) from g group by b "
+                     "order by b")
+    assert_match(tk, "select b, seg, count(*) from g group by b, seg "
+                     "order by b, seg")
+
+
+def test_keyorder_swapped_group_bys_no_cache_clobber(tk, counters):
+    """Two group-bys differing only in key order (int64 <-> float64 key
+    lanes swap) must not share a fused-program cache entry: the shared
+    pack schema of a clobbered entry returned silently corrupt rows
+    (round-4 review finding, reproduced)."""
+    _gb_fixture(tk)
+    q1 = "select b, d, count(*) from g group by b, d"
+    q2 = "select d, b, count(*) from g group by d, b"
+    assert_match(tk, q1)
+    assert_match(tk, q2)
+    assert_match(tk, q1)  # re-run q1 AFTER q2 traced: must still be right

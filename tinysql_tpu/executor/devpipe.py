@@ -120,50 +120,76 @@ class _TView:
 # =========================================================================
 
 class GroupIndex:
-    """Per (replica version, column) sorted order + group boundaries.
+    """Per (replica version, key columns) sorted order + group boundaries.
     order[i] = original row of sorted position i; groups are contiguous
     runs; ends[g] = last sorted position of group g (host int64 [ng]);
-    gkeys[g] = the key value (NULL group last, flagged)."""
-    __slots__ = ("order", "ends", "gkeys", "gkey_null", "n_groups", "lo",
-                 "hi")
+    keycols[j] = (values[ng], null[ng]) per key column (NULL keys form
+    one group; a multi-column key groups by the TUPLE).  The single-int-
+    key index additionally exposes gkeys/lo/hi + the dense pos_table the
+    join build sides ride."""
+    __slots__ = ("order", "ends", "keycols", "n_groups", "lo", "hi")
 
-    def __init__(self, vals: np.ndarray, nulls: np.ndarray):
-        order = np.lexsort((vals, nulls))  # non-null first, then by value
-        sv = vals[order]
-        sn = nulls[order]
-        n = len(sv)
+    def __init__(self, key_cols: List[tuple]):
+        # lexsort: last key is primary -> feed (vals, nulls) pairs in
+        # reverse column order, nulls after their values so each column
+        # sorts non-null-first.  Values under a null mask are garbage:
+        # mask them to 0 so the sort (and the boundary diff below) never
+        # splits the NULL group on them.
+        ops = []
+        svs = []
+        for vals, nulls in key_cols:
+            svs.append((np.where(nulls, 0, vals), nulls))
+        for mv, nl in reversed(svs):
+            ops.append(mv)
+            ops.append(nl)
+        order = np.lexsort(tuple(ops))
+        n = len(order)
+        svs = [(mv[order], nl[order]) for mv, nl in svs]
         if n == 0:
             self.order = order
             self.ends = np.empty(0, dtype=np.int64)
-            self.gkeys = np.empty(0, dtype=np.int64)
-            self.gkey_null = np.empty(0, dtype=bool)
+            self.keycols = [(np.empty(0, dtype=v.dtype),
+                             np.empty(0, dtype=bool)) for v, _ in key_cols]
             self.n_groups = 0
             self.lo = self.hi = 0
             return
-        boundary = np.empty(n, dtype=bool)
+        boundary = np.zeros(n, dtype=bool)
         boundary[0] = True
-        # a value diff only splits groups when NEITHER row is NULL: the
-        # stored values under a null mask are garbage, and all NULL keys
-        # form ONE group (kernels._group_agg_kernel applies the same
-        # ~(m & m) guard)
-        boundary[1:] = ((sv[1:] != sv[:-1]) & ~(sn[1:] & sn[:-1])) \
-            | (sn[1:] != sn[:-1])
+        for sv, sn in svs:
+            # a value diff only splits groups when NEITHER row is NULL:
+            # all NULL keys form ONE group (kernels._group_agg_kernel
+            # applies the same guard)
+            boundary[1:] |= ((sv[1:] != sv[:-1]) & ~(sn[1:] & sn[:-1])) \
+                | (sn[1:] != sn[:-1])
         starts = np.nonzero(boundary)[0]
         ends = np.empty(len(starts), dtype=np.int64)
         ends[:-1] = starts[1:] - 1
         ends[-1] = n - 1
         self.order = order
         self.ends = ends
-        self.gkeys = sv[ends]
-        self.gkey_null = sn[ends]
+        self.keycols = [(sv[ends], sn[ends]) for sv, sn in svs]
         self.n_groups = len(ends)
-        nn = self.gkeys[~self.gkey_null]
-        self.lo = int(nn.min()) if len(nn) else 0
-        self.hi = int(nn.max()) if len(nn) else 0
+        if len(key_cols) == 1 and self.gkeys.dtype == np.int64:
+            nn = self.gkeys[~self.gkey_null]
+            self.lo = int(nn.min()) if len(nn) else 0
+            self.hi = int(nn.max()) if len(nn) else 0
+        else:
+            self.lo = self.hi = 0
+
+    @property
+    def gkeys(self) -> np.ndarray:
+        return self.keycols[0][0]
+
+    @property
+    def gkey_null(self) -> np.ndarray:
+        return self.keycols[0][1]
 
     def pos_table(self) -> Optional[np.ndarray]:
         """Dense key -> group index (int32), -1 for absent keys; None when
-        the key range is too wide for a dense table."""
+        the key range is too wide for a dense table (single-int-key
+        indexes only)."""
+        if len(self.keycols) != 1 or self.gkeys.dtype != np.int64:
+            return None
         rng = self.hi - self.lo + 1
         if rng > MAX_DENSE_RANGE:
             return None
@@ -180,9 +206,16 @@ class GroupIndex:
         prev = np.concatenate(([np.int64(-1)], self.ends[:-1]))
         return self.ends - prev
 
+    def sorted_gid(self) -> np.ndarray:
+        """Group id per SORTED position (host int64 [n]) — the lane the
+        segment-min/max kernels reduce over."""
+        return np.repeat(np.arange(self.n_groups, dtype=np.int64),
+                         self.raw_counts())
 
-def _group_index(rep, sid, vals, nulls) -> GroupIndex:
-    return rep.memo(("groupindex", sid), lambda: GroupIndex(vals, nulls))
+
+def _group_index(rep, sids: tuple, key_cols: List[tuple]) -> GroupIndex:
+    """sids: tuple of stable slot ids (one per key column)."""
+    return rep.memo(("groupindex", sids), lambda: GroupIndex(key_cols))
 
 
 def _col_bounds(rep, sid, vals, nulls):
@@ -248,6 +281,7 @@ class _ReplicaLeaf:
         self.ex = reader_exec
         self.plan = plan
         self._rep = None  # set at prepare(): take_raw_replica consumes
+        self._chk = None
 
     @staticmethod
     def compile(plan: PhysicalTableReader, ctx: _Ctx):
@@ -271,6 +305,7 @@ class _ReplicaLeaf:
         if chk is None:
             return None
         self._rep = rep
+        self._chk = chk
         n = chk.full_rows()
         nb = kernels.bucket(max(n, 1))
         jn = _jn()
@@ -314,6 +349,9 @@ class _ReplicaLeaf:
     # host info the parent join/agg stages need (valid after prepare())
     def replica(self):
         return self._rep if self._rep is not None else self.ex._replica
+
+    def chunk(self):
+        return self._chk
 
     def close(self):
         self.ex.close()
@@ -373,30 +411,131 @@ class _HostLeaf:
         self.ex.close()
 
 
-class _AggIndexNode:
-    """High-cardinality GROUP BY over a single int replica column, via
-    the group index: mask -> gather to sorted order -> cumsum ->
-    boundary diff.  Output view: one row per group (bucket(ng)), valid =
-    group has passing rows.  Replaces the reference's partial-agg hash
-    table (aggregate.go:355 shuffle) for the agg-pushdown build sides."""
+def _assemble_agg_specs(plan):
+    """Shared descriptor lowering for the device aggregation nodes:
+    returns (specs, slots) or None.  specs[k] = (kind, expr|None) with
+    kind in count_star/count/sum/min/max; slots[i] maps descriptor i to
+    ("one", k) or ("avg", k_sum, k_cnt) — avg decomposes into sum+count
+    with the quotient taken in-kernel (reference partial-state split,
+    aggregation/descriptor.go)."""
+    from ..expression.aggregation import (AGG_AVG, AGG_MAX, AGG_MIN,
+                                          AggMode)
+    from ..expression.builtins import new_function
+    specs: List[tuple] = []
+    slots: List[tuple] = []
+    for d in plan.aggs:
+        if d.distinct:
+            return None
+        if d.mode is AggMode.FINAL:
+            # FINAL merges partial STATES (reference aggfuncs mode split):
+            # count -> SUM of partial counts; avg -> sum(sums)/sum(counts);
+            # sum/min/max merge with themselves
+            if d.name == AGG_COUNT and is_jittable(d.args[0]):
+                specs.append(("sum", d.args[0]))
+                slots.append(("one", len(specs) - 1))
+            elif d.name == AGG_AVG and len(d.args) == 2 \
+                    and all(is_jittable(a) for a in d.args):
+                a0 = d.args[0]
+                if a0.eval_type is not EvalType.REAL:
+                    a0 = new_function("cast_real", [a0])
+                specs.append(("sum", a0))
+                specs.append(("sum", d.args[1]))
+                slots.append(("avg", len(specs) - 2, len(specs) - 1))
+            elif d.name == AGG_SUM and is_jittable(d.args[0]):
+                a = d.args[0]
+                if (d.ret_type.eval_type is EvalType.REAL
+                        and a.eval_type is not EvalType.REAL):
+                    a = new_function("cast_real", [a])
+                specs.append(("sum", a))
+                slots.append(("one", len(specs) - 1))
+            elif d.name in (AGG_MIN, AGG_MAX) and is_jittable(d.args[0]) \
+                    and not (d.args[0].eval_type is EvalType.INT
+                             and getattr(d.args[0].ret_type,
+                                         "is_unsigned", False)):
+                specs.append((("min" if d.name == AGG_MIN else "max"),
+                              d.args[0]))
+                slots.append(("one", len(specs) - 1))
+            else:
+                return None
+            continue
+        if d.name == AGG_COUNT and isinstance(d.args[0], Constant) \
+                and d.args[0].value is not None:
+            specs.append(("count_star", None))
+            slots.append(("one", len(specs) - 1))
+        elif d.name == AGG_COUNT and is_jittable(d.args[0]):
+            specs.append(("count", d.args[0]))
+            slots.append(("one", len(specs) - 1))
+        elif d.name == AGG_SUM and is_jittable(d.args[0]):
+            a = d.args[0]
+            if (d.ret_type.eval_type is EvalType.REAL
+                    and a.eval_type is not EvalType.REAL):
+                a = new_function("cast_real", [a])
+            specs.append(("sum", a))
+            slots.append(("one", len(specs) - 1))
+        elif d.name == AGG_AVG and is_jittable(d.args[0]):
+            a = d.args[0]
+            ar = a if a.eval_type is EvalType.REAL \
+                else new_function("cast_real", [a])
+            specs.append(("sum", ar))
+            specs.append(("count", a))
+            slots.append(("avg", len(specs) - 2, len(specs) - 1))
+        elif d.name in (AGG_MIN, AGG_MAX) and is_jittable(d.args[0]):
+            a = d.args[0]
+            if (a.eval_type is EvalType.INT
+                    and getattr(a.ret_type, "is_unsigned", False)):
+                return None  # unsigned order map: CPU/per-op tiers
+            specs.append((("min" if d.name == AGG_MIN else "max"), a))
+            slots.append(("one", len(specs) - 1))
+        else:
+            return None
+    return specs, slots
 
-    def __init__(self, leaf: _ReplicaLeaf, plan, key_col: ExprColumn,
-                 specs, out_map):
+
+def _agg_out_map(plan):
+    """schema slot -> ("agg", descriptor i) | ("gb", key j), or None."""
+    out_map = []
+    for src, i in getattr(plan, "output_map", []):
+        out_map.append(("agg", i) if src == "agg" else ("gb", i))
+    if len(out_map) != len(plan.schema.columns):
+        return None
+    return out_map
+
+
+def _gb_key_ok(e) -> bool:
+    """Group keys the device nodes handle: plain columns — signed ints,
+    reals, or strings (dictionary codes on device)."""
+    if not isinstance(e, ExprColumn):
+        return False
+    if e.eval_type is EvalType.INT \
+            and getattr(e.ret_type, "is_unsigned", False):
+        return False
+    return True
+
+
+class _AggIndexNode:
+    """GROUP BY directly over the columnar replica, via the group index:
+    mask -> gather to sorted order -> cumsum -> boundary diff.  Multi-
+    column keys group by the tuple (strings ride dictionary codes); the
+    index is built ONCE per (replica version, key set) and memoized, so a
+    per-query aggregate is one fused program over [nb] with a tiny [ngb]
+    output.  Replaces the reference's partial-agg hash table
+    (aggregate.go:355 shuffle) for reader-rooted aggregates."""
+
+    def __init__(self, leaf: _ReplicaLeaf, plan, key_cols, specs, slots,
+                 out_map):
         self.leaf = leaf
         self.plan = plan
-        self.key_col = key_col
-        self.specs = specs          # [("sum"|"count"|"count_star", expr|None)]
-        self.out_map = out_map      # schema slot -> ("agg", i) | ("gb",)
+        self.key_cols = key_cols    # [ExprColumn]
+        self.specs = specs
+        self.slots = slots
+        self.out_map = out_map      # schema slot -> ("agg", i) | ("gb", j)
         self.gidx: Optional[GroupIndex] = None
 
     @staticmethod
     def compile(plan: PhysicalHashAgg, ctx: _Ctx):
-        if not plan.group_by or len(plan.group_by) != 1:
+        if not plan.group_by:
             return None
-        key = plan.group_by[0]
-        if not isinstance(key, ExprColumn) or key.eval_type is not EvalType.INT:
-            return None
-        if getattr(key.ret_type, "is_unsigned", False):
+        if not all(_gb_key_ok(e) for e in plan.group_by):
             return None
         child = plan.children[0]
         if not isinstance(child, PhysicalTableReader):
@@ -404,65 +543,80 @@ class _AggIndexNode:
         leaf = _ReplicaLeaf.compile(child, ctx)
         if leaf is None:
             return None
-        from ..expression.aggregation import AggMode
-        specs = []
-        for d in plan.aggs:
-            if d.distinct or d.mode is AggMode.FINAL:
-                # FINAL merges partial STATES (different count semantics);
-                # it never sits directly on a reader
-                leaf.close()
-                return None
-            if d.name == AGG_COUNT and isinstance(d.args[0], Constant) \
-                    and d.args[0].value is not None:
-                specs.append(("count_star", None))
-            elif d.name == AGG_COUNT and is_jittable(d.args[0]):
-                specs.append(("count", d.args[0]))
-            elif d.name == AGG_SUM and is_jittable(d.args[0]):
-                a = d.args[0]
-                if (d.ret_type.eval_type is EvalType.REAL
-                        and a.eval_type is not EvalType.REAL):
-                    from ..expression.builtins import new_function
-                    a = new_function("cast_real", [a])
-                specs.append(("sum", a))
-            else:
-                leaf.close()
-                return None
-        # schema slots: descriptor outputs then group key (output_map)
-        out_map = []
-        for src, i in getattr(plan, "output_map", []):
-            out_map.append(("agg", i) if src == "agg" else ("gb",))
-        if len(out_map) != len(plan.schema.columns):
+        got = _assemble_agg_specs(plan)
+        out_map = _agg_out_map(plan)
+        if got is None or out_map is None:
             leaf.close()
             return None
-        return _AggIndexNode(leaf, plan, key, specs, out_map)
+        specs, slots = got
+        return _AggIndexNode(leaf, plan, list(plan.group_by), specs, slots,
+                             out_map)
+
+    def _host_key_cols(self, rep):
+        """[(vals, nulls)] per key column (codes for strings), their
+        stable slot ids, and decode tables."""
+        from .tpu_executors import _rep_string_dict, _slot_id
+        chk = self.leaf.chunk()
+        key_cols, sids, decodes = [], [], []
+        for e in self.key_cols:
+            idx = e.index
+            sid = _slot_id(self.leaf.ex, idx)
+            if sid == "handle":
+                kv = rep.handles
+                km = np.zeros(rep.n_rows, dtype=bool)
+                decode = None
+            elif e.eval_type is EvalType.STRING:
+                got = _rep_string_dict(rep, sid, chk, idx)
+                if got is None:
+                    return None
+                kv = got[0]
+                km = chk.columns[idx].null_mask()
+                decode = got[3]
+            else:
+                kv, km = rep.columns[sid]
+                decode = None
+            key_cols.append((kv, km))
+            sids.append(sid)
+            decodes.append(decode)
+        return key_cols, tuple(sids), decodes
 
     def prepare(self, pb: _PipeBuilder) -> Optional[_TView]:
         tv = self.leaf.prepare(pb)
         if tv is None:
             return None
         rep = self.leaf.replica()
-        from .tpu_executors import _slot_id
-        idx = self.key_col.index
-        sid = _slot_id(self.leaf.ex, idx)
-        kv, km = rep.columns[sid] if sid != "handle" \
-            else (rep.handles, np.zeros(rep.n_rows, dtype=bool))
-        gidx = _group_index(rep, sid, kv, km)
+        got = self._host_key_cols(rep)
+        if got is None:
+            return None
+        key_cols, sids, decodes = got
+        gidx = _group_index(rep, sids, key_cols)
         self.gidx = gidx
         ng = gidx.n_groups
         ngb = kernels.bucket(max(ng, 1))
         nb = tv.nb
         jn = _jn()
-        io = pb.add(_dev_upload(rep, ("gi_order", sid, nb),
+        io = pb.add(_dev_upload(rep, ("gi_order", sids, nb),
                                 lambda: kernels.pad1(gidx.order, nb)))
-        ie = pb.add(_dev_upload(rep, ("gi_ends", sid, ngb),
+        ie = pb.add(_dev_upload(rep, ("gi_ends", sids, ngb),
                                 lambda: kernels.pad1(
                                     gidx.ends, ngb,
                                     fill=max(rep.n_rows - 1, 0))))
-        ik = pb.add(_dev_upload(rep, ("gi_gkeys", sid, ngb),
-                                lambda: kernels.pad1(gidx.gkeys, ngb)))
-        ikn = pb.add(_dev_upload(rep, ("gi_gknull", sid, ngb),
-                                 lambda: kernels.pad1(gidx.gkey_null, ngb,
-                                                      True)))
+        gb_slots = []
+        for j, (gk, gn) in enumerate(gidx.keycols):
+            ik = pb.add(_dev_upload(rep, ("gi_gkeys", sids, j, ngb),
+                                    lambda gk=gk: kernels.pad1(gk, ngb)))
+            ikn = pb.add(_dev_upload(rep, ("gi_gknull", sids, j, ngb),
+                                     lambda gn=gn: kernels.pad1(gn, ngb,
+                                                                True)))
+            gb_slots.append((ik, ikn))
+        need_mm = any(k in ("min", "max") for k, _ in self.specs)
+        isg = None
+        if need_mm:
+            # group id per sorted position, sentinel ngb on padding —
+            # the segment-min/max lane
+            isg = pb.add(_dev_upload(
+                rep, ("gi_sgid", sids, nb),
+                lambda: kernels.pad1(gidx.sorted_gid(), nb, fill=ngb)))
         pt = ParamTable()
         pt.add_int(ng)
         pt.add_int(rep.n_rows)
@@ -476,12 +630,20 @@ class _AggIndexNode:
                 arg_fns.append(compile_expr_params(a, pt))
                 keys.append(f"{kind}:{stable_shape_key(a)}")
         ip, fp = pb.params(pt)
-        pb.key(("aggindex", tuple(keys), nb, ngb))
+        # the cache key must pin EVERYTHING the traced closure depends
+        # on: key column ids + dtypes (int vs float key lanes retrace),
+        # the descriptor->spec slot mapping, and the output column map
+        kdts = tuple((str(s), str(gk.dtype))
+                     for s, (gk, _) in zip(sids, gidx.keycols))
+        pb.key(("aggindex", tuple(keys), kdts, tuple(self.slots),
+                tuple(self.out_map), nb, ngb))
         spec_kinds = [k for k, _ in self.specs]
+        slots = self.slots
         out_map = self.out_map
         schema_cols = self.plan.schema.columns
 
         def emit(args):
+            j = kernels.jax()
             valid, pairs = tv.emit(args)
             order, ends = args[io], args[ie]
             pr = (args[ip], args[fp])
@@ -501,46 +663,76 @@ class _AggIndexNode:
                               jn.zeros((), dtype=x_s.dtype))
                 return hi - lo
             presence = seg(valid_s.astype(jn.int64))
-            outs = []
+            res = []
             for kind, af in zip(spec_kinds, arg_fns):
                 if kind == "count_star":
-                    outs.append((presence, jn.zeros(ngb, dtype=bool)))
+                    res.append((presence, jn.zeros(ngb, dtype=bool)))
                     continue
                 av, an = af(pairs, pr)
                 live_s = (valid & ~an)[order] & in_table
                 cnt = seg(live_s.astype(jn.int64))
                 if kind == "count":
-                    outs.append((cnt, jn.zeros(ngb, dtype=bool)))
-                else:  # sum
+                    res.append((cnt, jn.zeros(ngb, dtype=bool)))
+                elif kind == "sum":
                     av_s = jn.where(live_s, av[order], 0)
-                    outs.append((seg(av_s), cnt == 0))
+                    res.append((seg(av_s), cnt == 0))
+                else:  # min / max over the sorted-gid lane
+                    if av.dtype == jn.int64:
+                        fill = (jn.iinfo(jn.int64).max if kind == "min"
+                                else jn.iinfo(jn.int64).min)
+                    else:
+                        fill = jn.inf if kind == "min" else -jn.inf
+                    gl = jn.where(live_s, args[isg], ngb)
+                    av_s = jn.where(live_s, av[order], fill)
+                    op = j.ops.segment_min if kind == "min" \
+                        else j.ops.segment_max
+                    res.append((op(av_s, gl, num_segments=ngb + 1)[:ngb],
+                                cnt == 0))
+            # descriptor outputs: direct spec results or the avg quotient
+            outs = []
+            for slot in slots:
+                if slot[0] == "one":
+                    outs.append(res[slot[1]])
+                else:  # avg = sum / count, NULL when count == 0
+                    sv, _ = res[slot[1]]
+                    cv, _ = res[slot[2]]
+                    outs.append((sv / jn.maximum(cv, 1).astype(sv.dtype),
+                                 cv == 0))
             gvalid = (jn.arange(ngb) < pr[0][0]) & (presence > 0)
             cols = []
-            for slot in out_map:
-                if slot[0] == "agg":
-                    cols.append(outs[slot[1]])
+            for m in out_map:
+                if m[0] == "agg":
+                    cols.append(outs[m[1]])
                 else:
-                    cols.append((args[ik], args[ikn]))
+                    cols.append((args[gb_slots[m[1]][0]],
+                                 args[gb_slots[m[1]][1]]))
             return gvalid, cols
-        meta = [(oc.ret_type, None) for oc in schema_cols]
+        meta = []
+        for oc, m in zip(schema_cols, out_map):
+            decode = decodes[m[1]] if m[0] == "gb" else None
+            meta.append((oc.ret_type, decode))
         return _TView(emit, ngb, meta)
 
     def build_key_info(self):
         """(lo, hi, pos_table np) for the parent join — static per
-        replica version."""
+        replica version (single-int-key indexes only)."""
         rep = self.leaf.replica()
+        got = self._host_key_cols(rep)
+        if got is None:
+            return None
+        _, sids, _ = got
 
         def mk():
             tbl = self.gidx.pos_table()
             if tbl is None:
                 return None
             return self.gidx.lo, self.gidx.hi, tbl
-        from .tpu_executors import _slot_id
-        sid = _slot_id(self.leaf.ex, self.key_col.index)
-        return rep.memo(("gi_postable", sid), mk)
+        return rep.memo(("gi_postable", sids), mk)
 
     def key_slot(self) -> int:
-        """Schema slot of the group key in the output view."""
+        """Schema slot of the (single) group key in the output view."""
+        if len(self.key_cols) != 1:
+            return -1
         for i, slot in enumerate(self.out_map):
             if slot[0] == "gb":
                 return i
@@ -724,12 +916,13 @@ class _JoinNode:
             kv, km = rep.handles, np.zeros(rep.n_rows, dtype=bool)
         else:
             kv, km = rep.columns[sid]
-        gidx = _group_index(rep, sid, kv, km)
+        sids = (sid,)
+        gidx = _group_index(rep, sids, [(kv, km)])
 
         def mk():
             tbl = gidx.pos_table()
             return None if tbl is None else (gidx.lo, gidx.hi, tbl)
-        got = rep.memo(("gi_postable", sid), mk)
+        got = rep.memo(("gi_postable", sids), mk)
         if got is None:
             return None
         lo, hi, tbl = got
@@ -745,13 +938,13 @@ class _JoinNode:
         ngb = kernels.bucket(max(ng, 1))
         tbl_len = int(tbl.shape[0])
         pk_slot = self.probe_key.index
-        io = pb.add(_dev_upload(rep, ("gi_order", sid, nbb),
+        io = pb.add(_dev_upload(rep, ("gi_order", sids, nbb),
                                 lambda: kernels.pad1(gidx.order, nbb)))
-        ie = pb.add(_dev_upload(rep, ("gi_ends", sid, ngb),
+        ie = pb.add(_dev_upload(rep, ("gi_ends", sids, ngb),
                                 lambda: kernels.pad1(
                                     gidx.ends, ngb,
                                     fill=max(rep.n_rows - 1, 0))))
-        it = pb.add(_dev_upload(rep, ("gi_postable_dev", sid),
+        it = pb.add(_dev_upload(rep, ("gi_postable_dev", sids),
                                 lambda: tbl))
         pt = ParamTable()
         pt.add_int(ng)
@@ -857,6 +1050,169 @@ class _JoinNode:
         _close_node(self.build)
 
 
+class _SortGroupNode:
+    """GROUP BY above an arbitrary device view (join outputs included,
+    VERDICT r3 #1): in-kernel lexsort by the key lanes (valid rows first),
+    boundary diff -> group leaders, next-leader positions by a reverse
+    cummin scan, then every sum/count is a cumsum + two gathers over the
+    leader windows — no scatter on the hot path (SURVEY §7 "hash tables
+    on TPU": sort-based grouping; reference aggregate.go:355 shuffle).
+    min/max ride segment ops over the group-number lane.  Output view:
+    group g at slot g of the child-sized bucket, valid = g < n_groups."""
+
+    def __init__(self, child, key_cols, specs, slots, out_map, plan):
+        self.child = child
+        self.key_cols = key_cols
+        self.specs = specs
+        self.slots = slots
+        self.out_map = out_map
+        self.plan = plan
+
+    @staticmethod
+    def compile(plan: PhysicalHashAgg, ctx: _Ctx):
+        if not plan.group_by:
+            return None
+        if not all(_gb_key_ok(e) for e in plan.group_by):
+            return None
+        got = _assemble_agg_specs(plan)
+        out_map = _agg_out_map(plan)
+        if got is None or out_map is None:
+            return None
+        specs, slots = got
+        child = _compile_node(plan.children[0], ctx)
+        if child is None:
+            return None
+        return _SortGroupNode(child, list(plan.group_by), specs, slots,
+                              out_map, plan)
+
+    def prepare(self, pb: _PipeBuilder) -> Optional[_TView]:
+        tv = self.child.prepare(pb)
+        if tv is None:
+            return None
+        jn = _jn()
+        nb = tv.nb
+        key_idx = []
+        decodes = []
+        for e in self.key_cols:
+            if e.index >= len(tv.meta):
+                return None
+            decode = tv.meta[e.index][1]
+            if e.eval_type is EvalType.STRING and decode is None:
+                return None  # string key without device codes
+            key_idx.append(e.index)
+            decodes.append(decode)
+        pt = ParamTable()
+        arg_fns = []
+        keys = []
+        for kind, a in self.specs:
+            if a is None:
+                arg_fns.append(None)
+                keys.append(kind)
+            else:
+                arg_fns.append(compile_expr_params(a, pt))
+                keys.append(f"{kind}:{stable_shape_key(a)}")
+        ip, fp = pb.params(pt)
+        pb.key(("sortgroup", tuple(keys), tuple(key_idx),
+                tuple(self.slots), tuple(self.out_map), nb,
+                len(tv.meta)))
+        spec_kinds = [k for k, _ in self.specs]
+        slots = self.slots
+        out_map = self.out_map
+        schema_cols = self.plan.schema.columns
+        nkeys = len(key_idx)
+
+        def emit(args):
+            from jax import lax
+            j = kernels.jax()
+            valid, pairs = tv.emit(args)
+            pr = (args[ip], args[fp])
+            kvs = [pairs[i] for i in key_idx]
+            perm = jn.lexsort(_sort_ops(jn, kvs, (False,) * nkeys, valid))
+            valid_s = valid[perm]
+            skeys = [(v[perm], m[perm]) for v, m in kvs]
+            idx = jn.arange(nb)
+            # leader = valid row starting a new key run (invalid rows
+            # sort last, so groups of valid rows are contiguous)
+            diff = jn.zeros(nb, dtype=bool).at[0].set(True)
+            for sv, sn in skeys:
+                d = ((sv[1:] != sv[:-1]) & ~(sn[1:] & sn[:-1])) \
+                    | (sn[1:] != sn[:-1])
+                diff = diff.at[1:].set(diff[1:] | d)
+            prev_invalid = jn.concatenate(
+                [jn.ones(1, dtype=bool), ~valid_s[:-1]])
+            lead = valid_s & (diff | prev_invalid)
+            gnum = jn.cumsum(lead.astype(jn.int64))       # 1-based
+            ng = gnum[-1]
+            sgid = jn.where(valid_s, gnum - 1, nb)        # per sorted pos
+            # group end for the leader at i: next leader position - 1
+            lp = jn.where(lead, idx, nb)
+            nxt = lax.cummin(lp[::-1])[::-1]              # next leader >= i
+            nxt_after = jn.concatenate([nxt[1:],
+                                        jn.full((1,), nb, dtype=nxt.dtype)])
+            end = jn.clip(nxt_after - 1, 0, nb - 1)
+
+            def seg(x_s):
+                # window sum [i, end_i], meaningful at leader positions;
+                # contributions are pre-masked so the last group's window
+                # absorbing the invalid tail adds zero
+                c = jn.cumsum(x_s)
+                c0 = jn.concatenate([jn.zeros(1, dtype=x_s.dtype), c[:-1]])
+                return c[end] - c0
+            lead_pos = jn.nonzero(lead, size=nb, fill_value=0)[0]
+            presence = seg(valid_s.astype(jn.int64))[lead_pos]
+            res = []
+            for kind, af in zip(spec_kinds, arg_fns):
+                if kind == "count_star":
+                    res.append((presence, jn.zeros(nb, dtype=bool)))
+                    continue
+                av, an = af(pairs, pr)
+                live_s = (valid & ~an)[perm]
+                cnt = seg(live_s.astype(jn.int64))[lead_pos]
+                if kind == "count":
+                    res.append((cnt, jn.zeros(nb, dtype=bool)))
+                elif kind == "sum":
+                    av_s = jn.where(live_s, av[perm], 0)
+                    res.append((seg(av_s)[lead_pos], cnt == 0))
+                else:  # min / max over the group-number lane
+                    if av.dtype == jn.int64:
+                        fill = (jn.iinfo(jn.int64).max if kind == "min"
+                                else jn.iinfo(jn.int64).min)
+                    else:
+                        fill = jn.inf if kind == "min" else -jn.inf
+                    gl = jn.where(live_s, sgid, nb)
+                    av_s = jn.where(live_s, av[perm], fill)
+                    op = j.ops.segment_min if kind == "min" \
+                        else j.ops.segment_max
+                    res.append((op(av_s, gl, num_segments=nb + 1)[:nb],
+                                cnt == 0))
+            outs = []
+            for slot in slots:
+                if slot[0] == "one":
+                    outs.append(res[slot[1]])
+                else:  # avg = sum / count, NULL when count == 0
+                    sv, _ = res[slot[1]]
+                    cv, _ = res[slot[2]]
+                    outs.append((sv / jn.maximum(cv, 1).astype(sv.dtype),
+                                 cv == 0))
+            gvalid = jn.arange(nb) < ng
+            cols = []
+            for m in out_map:
+                if m[0] == "agg":
+                    cols.append(outs[m[1]])
+                else:
+                    sv, sn = skeys[m[1]]
+                    cols.append((sv[lead_pos], sn[lead_pos] | ~gvalid))
+            return gvalid, cols
+        meta = []
+        for oc, m in zip(schema_cols, out_map):
+            decode = decodes[m[1]] if m[0] == "gb" else None
+            meta.append((oc.ret_type, decode))
+        return _TView(emit, nb, meta)
+
+    def close(self):
+        _close_node(self.child)
+
+
 def _leafish(node) -> Optional[_ReplicaLeaf]:
     """The underlying replica leaf of a leaf/selection chain (selection
     preserves the schema, so column offsets map straight through)."""
@@ -887,8 +1243,8 @@ def _prepare_build_key_info(node, build_key, pb: _PipeBuilder):
         lo, hi, tbl = got
         rep = node.leaf.replica()
         from .tpu_executors import _slot_id
-        sid = _slot_id(node.leaf.ex, node.key_col.index)
-        d = _dev_upload(rep, ("gi_postable_dev", sid), lambda: tbl)
+        sids = (_slot_id(node.leaf.ex, node.key_cols[0].index),)
+        d = _dev_upload(rep, ("gi_postable_dev", sids), lambda: tbl)
         return lo, hi, pb.add(d), int(tbl.shape[0])
     if isinstance(node, _SelNode):
         return _prepare_build_key_info(node.child, build_key, pb)
@@ -1162,7 +1518,10 @@ def _compile_device(plan, ctx: _Ctx):
     if isinstance(plan, PhysicalTableReader):
         return _ReplicaLeaf.compile(plan, ctx)
     if isinstance(plan, PhysicalHashAgg):
-        return _AggIndexNode.compile(plan, ctx)
+        node = _AggIndexNode.compile(plan, ctx)
+        if node is None:
+            node = _SortGroupNode.compile(plan, ctx)
+        return node
     if isinstance(plan, PhysicalHashJoin):
         return _JoinNode.compile(plan, ctx)
     if isinstance(plan, PhysicalSelection):
@@ -1181,6 +1540,12 @@ def _contains_join(plan) -> bool:
             and not isinstance(plan, PhysicalMergeJoin):
         return True
     return any(_contains_join(c) for c in plan.children)
+
+
+def _contains_grouped_agg(plan) -> bool:
+    if isinstance(plan, PhysicalHashAgg) and plan.group_by:
+        return True
+    return any(_contains_grouped_agg(c) for c in plan.children)
 
 
 # =========================================================================
@@ -1231,6 +1596,14 @@ class DevPipeExec:
         self.ctx = ctx
         self._done = False
         if not self._enabled(ctx):
+            self._node = None
+            self._open_fallback(ctx)
+            return
+        if not _contains_join(self.plan) \
+                and mesh_if_enabled(ctx.session_vars) is not None:
+            # agg-only pipelines under tidb_mesh_parallel ride the per-op
+            # SHARDED fused aggregate (psum partial merge over the mesh);
+            # devpipe's agg node is single-device
             self._node = None
             self._open_fallback(ctx)
             return
@@ -1313,7 +1686,15 @@ class DevPipeExec:
         nb = tv.nb
         ncols = len(tv.meta)
         small = nb <= kernels.SMALL_PACK
-        key = ("pipe", small, tuple(pb.kparts))
+        # the input dtype/shape signature joins the key as a structural
+        # backstop: a node key that under-pins its closure could otherwise
+        # share a cached program whose retrace clobbers the mutable pack
+        # schema (jit holds one trace per signature, the schema list holds
+        # only the LAST trace's layout)
+        sig = tuple((str(getattr(a, "dtype", type(a))),
+                     tuple(getattr(a, "shape", ())))
+                    for a in pb.inputs)
+        key = ("pipe", small, tuple(pb.kparts), sig)
         ent = _JIT_CACHE.get(key)
         if small:
             if ent is None:

@@ -1162,12 +1162,12 @@ def _marshal(chk: Chunk):
 
 
 def build_tpu_executor(plan) -> Optional[Executor]:
-    """TPU-tier builder.  Subtrees containing a supported join compile
-    into a device-resident pipeline (devpipe) with the per-operator
-    executors as fallback; lone operators use the per-op executors
-    (whose fused paths are already single-program)."""
-    from .devpipe import DevPipeExec, _contains_join
-    if _contains_join(plan):
+    """TPU-tier builder.  Subtrees containing a supported join or a
+    grouped aggregate compile into a device-resident pipeline (devpipe)
+    with the per-operator executors as fallback; lone operators use the
+    per-op executors (whose fused paths are already single-program)."""
+    from .devpipe import DevPipeExec, _contains_grouped_agg, _contains_join
+    if _contains_join(plan) or _contains_grouped_agg(plan):
         return DevPipeExec(plan, _build_tpu_op)
     return _build_tpu_op(plan)
 
