@@ -366,6 +366,7 @@ class _HostLeaf:
     def __init__(self, child_exec, plan):
         self.ex = child_exec
         self.plan = plan
+        self._chk = None
 
     @staticmethod
     def compile(plan, ctx: _Ctx):
@@ -388,6 +389,7 @@ class _HostLeaf:
     def prepare(self, pb: _PipeBuilder) -> Optional[_TView]:
         from .tpu_executors import _drain_chunk
         chk = _drain_chunk(self.ex, self.ex.field_types()).compact()
+        self._chk = chk
         n = chk.num_rows()
         nb = kernels.bucket(max(n, 1))
         slots = []
@@ -406,6 +408,9 @@ class _HostLeaf:
         def emit(args):
             return args[vi], [(args[a], args[b]) for a, b in slots]
         return _TView(emit, nb, meta)
+
+    def chunk(self):
+        return self._chk
 
     def close(self):
         self.ex.close()
@@ -501,6 +506,54 @@ def _agg_out_map(plan):
     return out_map
 
 
+def _mm_fill(jn, dtype, kind: str):
+    if dtype == jn.int64:
+        return (jn.iinfo(jn.int64).max if kind == "min"
+                else jn.iinfo(jn.int64).min)
+    return jn.inf if kind == "min" else -jn.inf
+
+
+def _spec_results(jn, spec_kinds, arg_fns, pairs, pr, valid, gmask,
+                  gvals, seg_sum, seg_mm, presence, n_out):
+    """Shared per-spec aggregation loop for both device group-by nodes
+    (the subtle NULL-when-empty / avg-pairing semantics live ONCE here).
+    gmask/gvals gather a lane into sorted order; seg_sum reduces a sorted
+    lane to [n_out]; seg_mm(av_s, live_s, kind) likewise for min/max."""
+    res = []
+    for kind, af in zip(spec_kinds, arg_fns):
+        if kind == "count_star":
+            res.append((presence, jn.zeros(n_out, dtype=bool)))
+            continue
+        av, an = af(pairs, pr)
+        live_s = gmask(valid & ~an)
+        cnt = seg_sum(live_s.astype(jn.int64))
+        if kind == "count":
+            res.append((cnt, jn.zeros(n_out, dtype=bool)))
+        elif kind == "sum":
+            res.append((seg_sum(jn.where(live_s, gvals(av), 0)),
+                        cnt == 0))
+        else:  # min / max
+            fill = _mm_fill(jn, av.dtype, kind)
+            res.append((seg_mm(jn.where(live_s, gvals(av), fill),
+                               live_s, kind), cnt == 0))
+    return res
+
+
+def _slot_outputs(jn, res, slots):
+    """Descriptor outputs from spec results: direct, or the avg quotient
+    (NULL when the count is zero)."""
+    outs = []
+    for slot in slots:
+        if slot[0] == "one":
+            outs.append(res[slot[1]])
+        else:
+            sv, _ = res[slot[1]]
+            cv, _ = res[slot[2]]
+            outs.append((sv / jn.maximum(cv, 1).astype(sv.dtype),
+                         cv == 0))
+    return outs
+
+
 def _gb_key_ok(e) -> bool:
     """Group keys the device nodes handle: plain columns — signed ints,
     reals, or strings (dictionary codes on device)."""
@@ -530,6 +583,7 @@ class _AggIndexNode:
         self.slots = slots
         self.out_map = out_map      # schema slot -> ("agg", i) | ("gb", j)
         self.gidx: Optional[GroupIndex] = None
+        self._sids: Optional[tuple] = None
 
     @staticmethod
     def compile(plan: PhysicalHashAgg, ctx: _Ctx):
@@ -591,6 +645,7 @@ class _AggIndexNode:
         key_cols, sids, decodes = got
         gidx = _group_index(rep, sids, key_cols)
         self.gidx = gidx
+        self._sids = sids
         ng = gidx.n_groups
         ngb = kernels.bucket(max(ng, 1))
         nb = tv.nb
@@ -662,42 +717,19 @@ class _AggIndexNode:
                 lo = jn.where(prev >= 0, c[prev_safe],
                               jn.zeros((), dtype=x_s.dtype))
                 return hi - lo
+
+            def seg_mm(av_s, live_s, kind):
+                gl = jn.where(live_s, args[isg], ngb)
+                op = j.ops.segment_min if kind == "min" \
+                    else j.ops.segment_max
+                return op(av_s, gl, num_segments=ngb + 1)[:ngb]
             presence = seg(valid_s.astype(jn.int64))
-            res = []
-            for kind, af in zip(spec_kinds, arg_fns):
-                if kind == "count_star":
-                    res.append((presence, jn.zeros(ngb, dtype=bool)))
-                    continue
-                av, an = af(pairs, pr)
-                live_s = (valid & ~an)[order] & in_table
-                cnt = seg(live_s.astype(jn.int64))
-                if kind == "count":
-                    res.append((cnt, jn.zeros(ngb, dtype=bool)))
-                elif kind == "sum":
-                    av_s = jn.where(live_s, av[order], 0)
-                    res.append((seg(av_s), cnt == 0))
-                else:  # min / max over the sorted-gid lane
-                    if av.dtype == jn.int64:
-                        fill = (jn.iinfo(jn.int64).max if kind == "min"
-                                else jn.iinfo(jn.int64).min)
-                    else:
-                        fill = jn.inf if kind == "min" else -jn.inf
-                    gl = jn.where(live_s, args[isg], ngb)
-                    av_s = jn.where(live_s, av[order], fill)
-                    op = j.ops.segment_min if kind == "min" \
-                        else j.ops.segment_max
-                    res.append((op(av_s, gl, num_segments=ngb + 1)[:ngb],
-                                cnt == 0))
-            # descriptor outputs: direct spec results or the avg quotient
-            outs = []
-            for slot in slots:
-                if slot[0] == "one":
-                    outs.append(res[slot[1]])
-                else:  # avg = sum / count, NULL when count == 0
-                    sv, _ = res[slot[1]]
-                    cv, _ = res[slot[2]]
-                    outs.append((sv / jn.maximum(cv, 1).astype(sv.dtype),
-                                 cv == 0))
+            res = _spec_results(
+                jn, spec_kinds, arg_fns, pairs, pr, valid,
+                gmask=lambda b: b[order] & in_table,
+                gvals=lambda v: v[order],
+                seg_sum=seg, seg_mm=seg_mm, presence=presence, n_out=ngb)
+            outs = _slot_outputs(jn, res, slots)
             gvalid = (jn.arange(ngb) < pr[0][0]) & (presence > 0)
             cols = []
             for m in out_map:
@@ -756,7 +788,8 @@ class _JoinNode:
     """
 
     def __init__(self, probe, build, probe_key, build_key, tp,
-                 probe_is_left, plan, mesh=None, mult=False):
+                 probe_is_left, plan, mesh=None, mult=False,
+                 session_vars=None):
         self.probe = probe
         self.build = build
         self.probe_key = probe_key
@@ -766,6 +799,7 @@ class _JoinNode:
         self.plan = plan
         self.mesh = mesh
         self.mult = mult
+        self.session_vars = session_vars or {}
         self.n_mesh = int(mesh.devices.size) if mesh is not None else 0
 
     @staticmethod
@@ -813,7 +847,9 @@ class _JoinNode:
             _close_node(build)
             return None
         return _JoinNode(probe, build, probe_key, build_key, plan.tp,
-                         probe_side == 0, plan, mesh=ctx.mesh, mult=mult)
+                         probe_side == 0, plan, mesh=ctx.mesh, mult=mult,
+                         session_vars=getattr(ctx.exec_ctx,
+                                              "session_vars", None))
 
     def prepare(self, pb: _PipeBuilder) -> Optional[_TView]:
         btv = self.build.prepare(pb)
@@ -828,7 +864,179 @@ class _JoinNode:
 
     # ---- unique build side: dense pos table + gather -------------------
 
+    def _host_key_lane(self, node, key: ExprColumn, nbucket: int):
+        """The raw (pre-filter) host values of a node view's key lane
+        (padded exactly as the device lane is), the live row count, and a
+        (rep, memo_key) handle for replica-backed lanes so the capacity
+        histogram memoizes per replica version.  None = shape without
+        host-visible keys (fall back to broadcast)."""
+        if isinstance(node, _SelNode):
+            return self._host_key_lane(node.child, key, nbucket)
+        if isinstance(node, _ReplicaLeaf):
+            rep = node.replica()
+            if rep is None:
+                return None
+            from .tpu_executors import _slot_id
+            sid = _slot_id(node.ex, key.index)
+            kv = rep.handles if sid == "handle" else rep.columns[sid][0]
+            if kv.dtype != np.int64:
+                return None
+            return kv, rep.n_rows, (rep, ("shufcap", sid))
+        if isinstance(node, _AggIndexNode):
+            if node.gidx is None or node.key_slot() != key.index:
+                return None
+            gk = node.gidx.gkeys
+            if gk.dtype != np.int64:
+                return None
+            rep = node.leaf.replica()
+            return gk, node.gidx.n_groups, (rep, ("shufcap_gi",
+                                                  node._sids))
+        if isinstance(node, _HostLeaf):
+            chk = node.chunk()
+            if chk is None:
+                return None
+            v = chk.columns[key.index].values()
+            if v.dtype != np.int64:
+                return None
+            return v, chk.num_rows(), None  # per-query data: no memo
+        return None
+
+    @staticmethod
+    def _shuffle_cap_of(lane, nbucket: int, n: int) -> int:
+        from ..parallel import dist
+        kv, n_rows, memo = lane
+
+        def calc():
+            return dist.shuffle_cap(kernels.pad1(kv, nbucket), n, n_rows)
+        if memo is None:
+            return calc()
+        rep, mkey = memo
+        return rep.memo(mkey + (nbucket, n), calc)
+
+    def _shuffle_wanted(self, nb: int, nbb: int, mesh) -> bool:
+        """Cost gate (reference P4 north star): partition the build side
+        over the mesh when it exceeds the broadcast budget; small build
+        sides broadcast (one all_gather beats a two-sided shuffle)."""
+        if mesh is None:
+            return False
+        n = int(mesh.devices.size)
+        if n & (n - 1) or nb % n or nbb % n:
+            return False
+        try:
+            thresh = int(self.session_vars.get(
+                "tidb_broadcast_build_max_rows", 1 << 20))
+        except Exception:
+            return False
+        return nbb > thresh
+
+    def _prepare_unique_shuffle(self, pb, btv, ptv, mesh) \
+            -> Optional[_TView]:
+        """Partitioned-build mesh join: all_to_all BOTH sides by key hash
+        over the mesh axis, then each shard joins its partition locally
+        (sort + searchsorted).  No shard ever holds the whole build side."""
+        from ..parallel import dist
+        jn = _jn()
+        n = int(mesh.devices.size)
+        nb, nbb = ptv.nb, btv.nb
+        got_p = self._host_key_lane(self.probe, self.probe_key, nb)
+        got_b = self._host_key_lane(self.build, self.build_key, nbb)
+        if got_p is None or got_b is None:
+            return None
+        pn_rows = got_p[1]
+        bn_rows = got_b[1]
+        capp = self._shuffle_cap_of(got_p, nb, n)
+        capb = self._shuffle_cap_of(got_b, nbb, n)
+        # skew gates, BOTH sides: a clustered hash would make one shard's
+        # receive buffer rival the whole table — broadcast is strictly
+        # better there
+        if n * n * capp > max(MAX_EXPAND, 2 * nb):
+            return None
+        if n * n * capb > max(MAX_EXPAND, 2 * nbb):
+            return None
+        pt = ParamTable()
+        pt.add_int(pn_rows)
+        pt.add_int(bn_rows)
+        ip, fp = pb.params(pt)
+        pk_slot = self.probe_key.index
+        bk_slot = self.build_key.index
+        outer = self.tp == "left"
+        probe_is_left = self.probe_is_left
+        npc, nbc = len(ptv.meta), len(btv.meta)
+        pb.key(("joinshuf", nb, nbb, capp, capb, pk_slot, bk_slot, outer,
+                probe_is_left, nbc, npc, n))
+
+        def kernel(ppairs, pvalid, bpairs, bvalid, pr):
+            from jax import lax
+            mp, mb = nb // n, nbb // n
+            si = lax.axis_index("shard").astype(jn.int64)
+            gp = si * mp + jn.arange(mp)
+            gb_ = si * mb + jn.arange(mb)
+            dp = dist.hash_dest_traced(jn, ppairs[pk_slot][0], n, gp,
+                                       pr[0][0])
+            db = dist.hash_dest_traced(jn, bpairs[bk_slot][0], n, gb_,
+                                       pr[0][1])
+            p_lanes = []
+            for v, m_ in ppairs:
+                p_lanes += [(v, jn.zeros((), dtype=v.dtype)), (m_, True)]
+            p_lanes.append((pvalid, False))
+            p_recv = dist.exchange_lanes(jn, p_lanes, dp, capp, n)
+            b_lanes = []
+            for v, m_ in bpairs:
+                b_lanes += [(v, jn.zeros((), dtype=v.dtype)), (m_, True)]
+            b_lanes.append((bvalid, False))
+            b_recv = dist.exchange_lanes(jn, b_lanes, db, capb, n)
+            P_ = [(p_recv[2 * i], p_recv[2 * i + 1]) for i in range(npc)]
+            pv_r = p_recv[-1]
+            B_ = [(b_recv[2 * i], b_recv[2 * i + 1]) for i in range(nbc)]
+            bv_r = b_recv[-1]
+            BN = n * capb
+            bk_r, bkn_r = B_[bk_slot]
+            pk_r, pkn_r = P_[pk_slot]
+            hit, brow = dist.local_unique_join(
+                jn, bk_r, bv_r & ~bkn_r, pk_r, BN)
+            matched = hit & ~pkn_r & pv_r
+            valid_out = pv_r if outer else matched
+            bcols = [(bv2[brow], bn2[brow] | ~matched) for bv2, bn2 in B_]
+            return valid_out, P_, bcols
+
+        from jax.sharding import PartitionSpec as P
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax
+            from jax.experimental.shard_map import shard_map
+        pspec = [(P("shard"), P("shard"))] * npc
+        bspec = [(P("shard"), P("shard"))] * nbc
+        sharded = shard_map(
+            kernel, mesh=mesh,
+            in_specs=(pspec, P("shard"), bspec, P("shard"), (P(), P())),
+            out_specs=(P("shard"),
+                       [(P("shard"), P("shard"))] * npc,
+                       [(P("shard"), P("shard"))] * nbc))
+
+        def emit(args):
+            bvalid, bpairs = btv.emit(args)
+            pvalid, ppairs = ptv.emit(args)
+            valid_out, pcols, bcols = sharded(ppairs, pvalid, bpairs,
+                                              bvalid,
+                                              (args[ip], args[fp]))
+            if probe_is_left:
+                return valid_out, list(pcols) + list(bcols)
+            return valid_out, list(bcols) + list(pcols)
+        if probe_is_left:
+            meta = ptv.meta + btv.meta
+        else:
+            meta = btv.meta + ptv.meta
+        return _TView(emit, n * n * capp, meta)
+
     def _prepare_unique(self, pb, btv, ptv) -> Optional[_TView]:
+        from ..parallel import dist as _dist
+        if self._shuffle_wanted(ptv.nb, btv.nb,
+                                self.mesh if _dist.shardable(ptv.nb,
+                                                             self.mesh)
+                                else None):
+            out = self._prepare_unique_shuffle(pb, btv, ptv, self.mesh)
+            if out is not None:
+                return out  # else: broadcast below
         info = _prepare_build_key_info(self.build, self.build_key, pb)
         if info is None:
             return None
@@ -1151,49 +1359,27 @@ class _SortGroupNode:
                                         jn.full((1,), nb, dtype=nxt.dtype)])
             end = jn.clip(nxt_after - 1, 0, nb - 1)
 
+            lead_pos = jn.nonzero(lead, size=nb, fill_value=0)[0]
+
             def seg(x_s):
-                # window sum [i, end_i], meaningful at leader positions;
+                # window sum [i, end_i] gathered at the leaders;
                 # contributions are pre-masked so the last group's window
                 # absorbing the invalid tail adds zero
                 c = jn.cumsum(x_s)
                 c0 = jn.concatenate([jn.zeros(1, dtype=x_s.dtype), c[:-1]])
-                return c[end] - c0
-            lead_pos = jn.nonzero(lead, size=nb, fill_value=0)[0]
-            presence = seg(valid_s.astype(jn.int64))[lead_pos]
-            res = []
-            for kind, af in zip(spec_kinds, arg_fns):
-                if kind == "count_star":
-                    res.append((presence, jn.zeros(nb, dtype=bool)))
-                    continue
-                av, an = af(pairs, pr)
-                live_s = (valid & ~an)[perm]
-                cnt = seg(live_s.astype(jn.int64))[lead_pos]
-                if kind == "count":
-                    res.append((cnt, jn.zeros(nb, dtype=bool)))
-                elif kind == "sum":
-                    av_s = jn.where(live_s, av[perm], 0)
-                    res.append((seg(av_s)[lead_pos], cnt == 0))
-                else:  # min / max over the group-number lane
-                    if av.dtype == jn.int64:
-                        fill = (jn.iinfo(jn.int64).max if kind == "min"
-                                else jn.iinfo(jn.int64).min)
-                    else:
-                        fill = jn.inf if kind == "min" else -jn.inf
-                    gl = jn.where(live_s, sgid, nb)
-                    av_s = jn.where(live_s, av[perm], fill)
-                    op = j.ops.segment_min if kind == "min" \
-                        else j.ops.segment_max
-                    res.append((op(av_s, gl, num_segments=nb + 1)[:nb],
-                                cnt == 0))
-            outs = []
-            for slot in slots:
-                if slot[0] == "one":
-                    outs.append(res[slot[1]])
-                else:  # avg = sum / count, NULL when count == 0
-                    sv, _ = res[slot[1]]
-                    cv, _ = res[slot[2]]
-                    outs.append((sv / jn.maximum(cv, 1).astype(sv.dtype),
-                                 cv == 0))
+                return (c[end] - c0)[lead_pos]
+
+            def seg_mm(av_s, live_s, kind):
+                gl = jn.where(live_s, sgid, nb)
+                op = j.ops.segment_min if kind == "min" \
+                    else j.ops.segment_max
+                return op(av_s, gl, num_segments=nb + 1)[:nb]
+            presence = seg(valid_s.astype(jn.int64))
+            res = _spec_results(
+                jn, spec_kinds, arg_fns, pairs, pr, valid,
+                gmask=lambda b: b[perm], gvals=lambda v: v[perm],
+                seg_sum=seg, seg_mm=seg_mm, presence=presence, n_out=nb)
+            outs = _slot_outputs(jn, res, slots)
             gvalid = jn.arange(nb) < ng
             cols = []
             for m in out_map:

@@ -121,6 +121,92 @@ def make_broadcast_join_counts(mesh):
 
 
 # =========================================================================
+# hash-partitioned (shuffle) join primitives (SURVEY §2.11 P4 north star:
+# "partition build-side tables")
+# =========================================================================
+# Both sides re-partition BY KEY HASH over the mesh axis with all_to_all
+# (ICI on hardware), so every shard holds only its hash partition of the
+# build side — build tables larger than one chip's HBM budget become
+# servable.  Static shapes: the host computes EXACT per-(source, dest)
+# bucket capacities from the raw key lanes (partitioning is value-only,
+# pre-filter; filters ride the validity lane through the exchange), so
+# the scatter never drops rows.  Padding rows spread round-robin to keep
+# the capacity bound tight.
+
+# golden-ratio multiplier (two's-complement int64 of 0x9E3779B97F4A7C15)
+HASH_GOLDEN = np.int64(0x9E3779B97F4A7C15 - (1 << 64))
+
+
+def hash_dest_np(keys: np.ndarray, n_shards: int,
+                 n_rows: Optional[int] = None) -> np.ndarray:
+    """Destination shard per row — MUST stay bit-identical to
+    hash_dest_traced (the host capacity bound relies on it)."""
+    with np.errstate(over="ignore"):
+        h = keys.astype(np.int64, copy=False) * HASH_GOLDEN
+    d = (h >> 33) & (n_shards - 1)
+    if n_rows is not None:
+        idx = np.arange(len(keys), dtype=np.int64)
+        d = np.where(idx < n_rows, d, idx % n_shards)
+    return d
+
+
+def hash_dest_traced(jn, keys, n_shards: int, global_idx, n_rows):
+    """Traced twin of hash_dest_np (int64 wrap-around multiply)."""
+    h = keys * HASH_GOLDEN
+    d = (h >> 33) & (n_shards - 1)
+    return jn.where(global_idx < n_rows, d, global_idx % n_shards)
+
+
+def shuffle_cap(keys_padded: np.ndarray, n_shards: int, n_rows: int) -> int:
+    """Power-of-two capacity per (source shard, dest shard) send bucket:
+    the exact max block histogram of the destinations."""
+    dest = hash_dest_np(keys_padded, n_shards, n_rows)
+    per = len(keys_padded) // n_shards
+    mx = 1
+    for i in range(n_shards):
+        c = np.bincount(dest[i * per:(i + 1) * per], minlength=n_shards)
+        mx = max(mx, int(c.max()))
+    return kernels.bucket(mx)
+
+
+def exchange_lanes(jn, lanes, dest_local, cap: int, n_shards: int,
+                   axis: str = "shard"):
+    """Traced, per shard: scatter each lane into an [n, cap] send buffer
+    by (dest, rank-within-dest), all_to_all over the mesh axis, return
+    flattened [n*cap] received lanes.  lanes = [(array [m], fill)]."""
+    from jax import lax
+    m = dest_local.shape[0]
+    order = jn.argsort(dest_local, stable=True)
+    ds = dest_local[order]
+    rank = jn.arange(m) - jn.searchsorted(ds, ds, side="left")
+    outs = []
+    for arr, fill in lanes:
+        buf = jn.full((n_shards, cap), fill, dtype=arr.dtype)
+        buf = buf.at[ds, rank].set(arr[order], mode="drop")
+        r = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
+                           tiled=True)
+        outs.append(r.reshape(n_shards * cap))
+    return outs
+
+
+def local_unique_join(jn, bk, blive, pk, BN: int):
+    """Traced, per shard: sort the received build partition by
+    (key, liveness) and probe with searchsorted.  Returns (hit, brow):
+    hit[i] = probe key i has a LIVE build row; brow[i] = its position in
+    the received build lanes.  Lexicographic sort puts the live row first
+    among equal keys, so a dead row never shadows a live one."""
+    from jax import lax
+    kmask = jn.where(blive, bk, jn.iinfo(jn.int64).max)
+    inv = (~blive).astype(jn.int32)
+    sk, sinv, sperm = lax.sort(
+        (kmask, inv, jn.arange(BN, dtype=jn.int64)), num_keys=2)
+    lo = jn.searchsorted(sk, pk, side="left")
+    loc = jn.clip(lo, 0, BN - 1)
+    hit = (lo < BN) & (sk[loc] == pk) & (sinv[loc] == 0)
+    return hit, sperm[loc]
+
+
+# =========================================================================
 # full distributed step (the dryrun/"training step" entry)
 # =========================================================================
 

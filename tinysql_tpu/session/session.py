@@ -45,6 +45,10 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
                                  # backends only), 0 off, 1 force
     "tidb_enable_cascades_planner": 0,
     "tidb_mesh_parallel": 0,     # shard fused aggregates over the device mesh
+    # mesh join strategy: build sides with more (bucketed) rows than this
+    # shuffle-partition over the mesh via all_to_all; smaller ones
+    # broadcast (reference P4 "partition build-side tables" north star)
+    "tidb_broadcast_build_max_rows": 1 << 20,
     "sql_mode": "STRICT_TRANS_TABLES",
     "max_execution_time": 0,
 }
