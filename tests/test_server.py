@@ -317,3 +317,192 @@ def test_config_strict_load(tmp_path):
     bad.write_text("nonexistent-key = 1\n")
     with pytest.raises(cfgmod.ConfigError, match="unknown configuration"):
         cfgmod.load(str(bad))
+
+
+def test_com_field_list(server):
+    """COM_FIELD_LIST over the real socket (reference conn.go:846
+    handleFieldList): one column-definition packet per table column, with
+    the empty default-value field appended, then EOF."""
+    c = MiniClient(server.port)
+    c.query("create database if not exists fl")
+    c.query("use fl")
+    c.query("create table ft (id int primary key, name varchar(20), "
+            "score double)")
+    c.io.reset_sequence()
+    c.io.write_packet(b"\x04" + b"ft\x00" + b"%")
+    names, types = [], []
+    while True:
+        d = c.io.read_packet()
+        if d[0] == 0xFE and len(d) < 9:
+            break
+        pos = 0
+        vals = []
+        for _ in range(6):
+            ln, pos = read_lenenc_int(d, pos)
+            vals.append(d[pos:pos + ln])
+            pos += ln
+        names.append(vals[4].decode())
+        assert d[pos] == 0x0C
+        tp = d[pos + 1 + 2 + 4]
+        types.append(tp)
+        # the trailing default-value field must be present (lenenc 0)
+        assert d[-1] == 0x00
+    assert names == ["id", "name", "score"]
+    assert types == [0x08, 0xFD, 0x05]  # LONGLONG, VAR_STRING, DOUBLE
+    # unknown table -> 1146
+    c.io.reset_sequence()
+    c.io.write_packet(b"\x04" + b"nope\x00")
+    d = c.io.read_packet()
+    assert d[0] == 0xFF and struct.unpack_from("<H", d, 1)[0] == 1146
+    c.close()
+
+
+def test_binary_row_encoding():
+    """Binary resultset row codec parity with the reference's
+    dumpBinaryRow (server/util.go:171): header byte, 2-bit-offset NULL
+    bitmap, longlong/double/lenenc-string wire values."""
+    from tinysql_tpu.mytypes import (FieldType, TYPE_DOUBLE, TYPE_LONGLONG,
+                                     TYPE_VARCHAR)
+    from tinysql_tpu.server.protocol import binary_row
+    fi = FieldType(TYPE_LONGLONG, 0, 20)
+    fr = FieldType(TYPE_DOUBLE, 0, 22)
+    fs = FieldType(TYPE_VARCHAR, 0, 20)
+    row = binary_row([5, None, 2.5, "ab"], [fi, fi, fr, fs])
+    assert row[0] == 0x00
+    nmap_len = (4 + 7 + 2) // 8
+    nmap = row[1:1 + nmap_len]
+    # col 1 NULL -> bit (1+2) of the bitmap
+    assert nmap[0] & (1 << 3) and not (nmap[0] & (1 << 2))
+    body = row[1 + nmap_len:]
+    iv = struct.unpack_from("<q", body, 0)[0]
+    rv = struct.unpack_from("<d", body, 8)[0]
+    assert iv == 5 and rv == 2.5
+    assert body[16] == 2 and body[17:19] == b"ab"
+    # negative + unsigned-range ints ride two's complement
+    row = binary_row([-7], [fi])
+    assert struct.unpack_from("<q", row, 1 + (1 + 9) // 8)[0] == -7
+    row = binary_row([2**64 - 1], [fi])
+    assert struct.unpack_from("<Q", row, 1 + (1 + 9) // 8)[0] == 2**64 - 1
+
+
+def test_prepared_statement_binary_protocol(server):
+    """COM_STMT_PREPARE/EXECUTE/CLOSE over the real socket: binary param
+    decoding (longlong/double/string/NULL) and BINARY resultset rows
+    (reference conn.go:879 writeResultset binary=true path)."""
+    from tinysql_tpu.server.packetio import lenenc_int
+    c = MiniClient(server.port)
+    c.query("create database if not exists ps")
+    c.query("use ps")
+    c.query("create table pt (id int primary key, nm varchar(20), "
+            "sc double)")
+    c.query("insert into pt values (1, 'ann', 1.5), (2, 'bob', 2.5), "
+            "(3, null, 3.5)")
+    # prepare
+    c.io.reset_sequence()
+    sql = b"select id, nm, sc from pt where id >= ? and sc < ? order by id"
+    c.io.write_packet(b"\x16" + sql)
+    d = c.io.read_packet()
+    assert d[0] == 0x00
+    stmt_id = struct.unpack_from("<I", d, 1)[0]
+    ncols = struct.unpack_from("<H", d, 5)[0]
+    nparams = struct.unpack_from("<H", d, 7)[0]
+    # prepare-time result metadata: the SELECT's real columns
+    assert nparams == 2 and ncols == 3
+    for _ in range(nparams):
+        c.io.read_packet()          # param definitions
+    assert c.io.read_packet()[0] == 0xFE
+    prep_cols = []
+    for _ in range(ncols):
+        d = c.io.read_packet()
+        pos = 0
+        vals = []
+        for _ in range(6):
+            ln, pos = read_lenenc_int(d, pos)
+            vals.append(d[pos:pos + ln])
+            pos += ln
+        prep_cols.append(vals[4].decode())
+    assert prep_cols == ["id", "nm", "sc"]
+    assert c.io.read_packet()[0] == 0xFE
+    # execute with id >= 1 (longlong), sc < 3.0 (double)
+    c.io.reset_sequence()
+    pl = struct.pack("<IBI", stmt_id, 0, 1)
+    pl += b"\x00"                    # null bitmap (2 params)
+    pl += b"\x01"                    # new params bound
+    pl += bytes([0x08, 0x00, 0x05, 0x00])   # LONGLONG, DOUBLE
+    pl += struct.pack("<q", 1) + struct.pack("<d", 3.0)
+    c.io.write_packet(b"\x17" + pl)
+    first = c.io.read_packet()
+    nc, _ = read_lenenc_int(first, 0)
+    assert nc == 3
+    fts = []
+    for _ in range(nc):
+        d = c.io.read_packet()
+        pos = 0
+        for _ in range(6):
+            ln, pos = read_lenenc_int(d, pos)
+            pos += ln
+        fts.append(d[pos + 1 + 2 + 4])   # column type byte
+    assert c.io.read_packet()[0] == 0xFE
+    rows = []
+    while True:
+        d = c.io.read_packet()
+        if d[0] == 0xFE and len(d) < 9:
+            break
+        assert d[0] == 0x00          # binary row header
+        nmap_len = (nc + 7 + 2) // 8
+        nmap = d[1:1 + nmap_len]
+        pos = 1 + nmap_len
+        row = []
+        for i, tp in enumerate(fts):
+            if nmap[(i + 2) // 8] & (1 << ((i + 2) % 8)):
+                row.append(None)
+                continue
+            if tp == 0x08:
+                row.append(struct.unpack_from("<q", d, pos)[0])
+                pos += 8
+            elif tp == 0x05:
+                row.append(struct.unpack_from("<d", d, pos)[0])
+                pos += 8
+            else:
+                ln, pos = read_lenenc_int(d, pos)
+                row.append(d[pos:pos + ln].decode())
+                pos += ln
+        rows.append(row)
+    assert rows == [[1, "ann", 1.5], [2, "bob", 2.5]], rows
+    # re-execute WITHOUT re-binding types (bound flag 0): types cached
+    c.io.reset_sequence()
+    pl = struct.pack("<IBI", stmt_id, 0, 1) + b"\x00" + b"\x00"
+    pl += struct.pack("<q", 3) + struct.pack("<d", 99.0)
+    c.io.write_packet(b"\x17" + pl)
+    first = c.io.read_packet()
+    nc2, _ = read_lenenc_int(first, 0)
+    assert nc2 == 3
+    for _ in range(nc2):
+        c.io.read_packet()           # column definitions
+    assert c.io.read_packet()[0] == 0xFE
+    rows2 = 0
+    null_seen = False
+    while True:
+        d = c.io.read_packet()
+        if d[0] == 0xFE and len(d) < 9:
+            break
+        rows2 += 1
+        nmap = d[1:1 + (nc2 + 7 + 2) // 8]
+        # nm is column 1 -> bitmap bit 1+2 (row id=3 has nm NULL)
+        null_seen = null_seen or bool(nmap[0] & (1 << 3))
+    assert rows2 == 1 and null_seen  # only id=3 matches; its nm is NULL
+    # close the statement (no response expected)
+    c.io.reset_sequence()
+    c.io.write_packet(b"\x19" + struct.pack("<I", stmt_id))
+    # connection still alive after close
+    cols, rows = c.query("select count(*) from pt")
+    assert rows == [["3"]]
+    c.close()
+
+
+def test_split_placeholders_comments_and_quotes():
+    from tinysql_tpu.server.protocol import split_placeholders as sp
+    assert len(sp("select id from t -- trailing?")) == 1
+    assert len(sp("select /* ? */ id from t where id = ?")) == 2
+    assert len(sp("select '?' , `a?b`, \"?\" from t where x = ?")) == 2
+    assert len(sp("select 1 # c?\n from t where a = ? and b = ?")) == 3

@@ -38,16 +38,62 @@ class InfoSchema:
                 self._tables[(d.name.lower(), t.name.lower())] = t
                 self._by_id[t.id] = (d.name, t)
 
+    # full loads with at least this many databases fetch per-db table
+    # lists concurrently (reference domain.go:155-207 splitForConcurrentFetch)
+    CONCURRENT_FETCH_MIN_DBS = 8
+
     @classmethod
     def load(cls, storage) -> "InfoSchema":
-        """Full load (reference: domain.go:66-207 full load path)."""
-        txn = storage.begin()
-        m = Meta(txn)
-        version = m.schema_version()
-        dbs = m.list_databases()
-        tables = {d.id: m.list_tables(d.id) for d in dbs}
-        txn.rollback()
-        return cls(version, dbs, tables)
+        """Full load (reference: domain.go:66-207 full load path).  Large
+        catalogs split the databases across a worker pool, each worker
+        reading through its own snapshot; a schema-version re-check
+        guards against a DDL landing between snapshots (one consistent
+        single-snapshot retry otherwise)."""
+        def one_snapshot():
+            txn = storage.begin()
+            try:
+                m = Meta(txn)
+                version = m.schema_version()
+                dbs = m.list_databases()
+                tables = {d.id: m.list_tables(d.id) for d in dbs}
+            finally:
+                txn.rollback()
+            return cls(version, dbs, tables)
+
+        for _ in range(3):
+            txn = storage.begin()
+            m = Meta(txn)
+            version = m.schema_version()
+            dbs = m.list_databases()
+            if len(dbs) < cls.CONCURRENT_FETCH_MIN_DBS:
+                # small catalog (the common case): finish in THIS snapshot
+                tables = {d.id: m.list_tables(d.id) for d in dbs}
+                txn.rollback()
+                return cls(version, dbs, tables)
+            txn.rollback()
+            from concurrent.futures import ThreadPoolExecutor
+
+            def fetch(chunk):
+                t2 = storage.begin()
+                try:
+                    m2 = Meta(t2)
+                    return {d.id: m2.list_tables(d.id) for d in chunk}
+                finally:
+                    t2.rollback()
+            nw = min(8, len(dbs))
+            tables = {}
+            with ThreadPoolExecutor(max_workers=nw) as ex:
+                for part in ex.map(fetch,
+                                   [dbs[i::nw] for i in range(nw)]):
+                    tables.update(part)
+            txn = storage.begin()
+            v2 = Meta(txn).schema_version()
+            txn.rollback()
+            if v2 == version:
+                return cls(version, dbs, tables)
+        # version moved 3 times under the concurrent fetch (DDL storm):
+        # give up on parallelism, one consistent snapshot
+        return one_snapshot()
 
     def schema_by_name(self, name: str) -> Optional[DBInfo]:
         return self._dbs.get(name.lower())

@@ -864,14 +864,14 @@ class _JoinNode:
 
     # ---- unique build side: dense pos table + gather -------------------
 
-    def _host_key_lane(self, node, key: ExprColumn, nbucket: int):
+    def _host_key_lane(self, node, key: ExprColumn):
         """The raw (pre-filter) host values of a node view's key lane
-        (padded exactly as the device lane is), the live row count, and a
-        (rep, memo_key) handle for replica-backed lanes so the capacity
-        histogram memoizes per replica version.  None = shape without
-        host-visible keys (fall back to broadcast)."""
+        (unpadded — _shuffle_cap_of pads to the device bucket), the live
+        row count, and a (rep, memo_key) handle for replica-backed lanes
+        so the capacity histogram memoizes per replica version.  None =
+        shape without host-visible keys (fall back to broadcast)."""
         if isinstance(node, _SelNode):
-            return self._host_key_lane(node.child, key, nbucket)
+            return self._host_key_lane(node.child, key)
         if isinstance(node, _ReplicaLeaf):
             rep = node.replica()
             if rep is None:
@@ -938,8 +938,8 @@ class _JoinNode:
         jn = _jn()
         n = int(mesh.devices.size)
         nb, nbb = ptv.nb, btv.nb
-        got_p = self._host_key_lane(self.probe, self.probe_key, nb)
-        got_b = self._host_key_lane(self.build, self.build_key, nbb)
+        got_p = self._host_key_lane(self.probe, self.probe_key)
+        got_b = self._host_key_lane(self.build, self.build_key)
         if got_p is None or got_b is None:
             return None
         pn_rows = got_p[1]

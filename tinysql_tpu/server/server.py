@@ -28,6 +28,9 @@ class ClientConn:
         self.io = PacketIO(conn)
         self.session = Session(server.storage, domain=server.domain)
         self.alive = True
+        # prepared statements: id -> [sql_parts, types] (binary protocol)
+        self._stmts: dict = {}
+        self._next_stmt_id = 1
 
     # ---- handshake (reference: conn.go:117,418 — with the scramble
     # verification full TiDB does and tinysql stripped) -------------------
@@ -88,6 +91,17 @@ class ClientConn:
                         self._run_sql(f"use `{db}`")
                     elif cmd == p.COM_QUERY:
                         self._run_sql(payload.decode("utf-8", "replace"))
+                    elif cmd == p.COM_FIELD_LIST:
+                        self._handle_field_list(payload)
+                    elif cmd == p.COM_STMT_PREPARE:
+                        self._handle_stmt_prepare(payload)
+                    elif cmd == p.COM_STMT_EXECUTE:
+                        self._handle_stmt_execute(payload)
+                    elif cmd == p.COM_STMT_CLOSE:
+                        import struct
+                        self._stmts.pop(
+                            struct.unpack_from("<I", payload, 0)[0], None)
+                        # COM_STMT_CLOSE sends no response
                     else:
                         self.io.write_packet(
                             p.err_packet(1047, f"unknown command {cmd}"))
@@ -107,6 +121,111 @@ class ClientConn:
                 pass
             self.sock.close()
             self.server.remove_conn(self.conn_id)
+
+    def _handle_field_list(self, payload: bytes) -> None:
+        """COM_FIELD_LIST (reference conn.go:846 handleFieldList): table
+        name up to NUL, optional field wildcard after; respond with one
+        column definition per table column (empty default value) + EOF."""
+        from ..catalog.infoschema import DatabaseNotExist, TableNotExist
+        name = payload.split(b"\x00", 1)[0].decode("utf-8", "replace")
+        db = self.session.current_db
+        if not db:
+            self.io.write_packet(p.err_packet(1046, "No database selected",
+                                              "3D000"))
+            return
+        try:
+            # fresh domain schema, NOT the session's statement pin: a
+            # COM_FIELD_LIST never runs a statement, so the pin would
+            # otherwise serve a stale column list across others' DDL
+            info = self.session.domain.info_schema().table_by_name(db,
+                                                                   name)
+        except DatabaseNotExist:
+            self.io.write_packet(p.err_packet(
+                1049, f"Unknown database '{db}'", "42000"))
+            return
+        except TableNotExist:
+            self.io.write_packet(p.err_packet(
+                1146, f"Table '{db}.{name}' doesn't exist", "42S02"))
+            return
+        self.io.begin_buffer()
+        try:
+            for col in info.columns:
+                self.io.write_packet(p.column_def(col.name, col.ft,
+                                                  with_default=True))
+            self.io.write_packet(p.eof_packet())
+        finally:
+            self.io.flush()
+
+    # ---- prepared statements (binary protocol) --------------------------
+    # The client-visible surface of the reference's binary resultset path
+    # (conn.go:879 writeResultset binary=true, util.go:171 dumpBinaryRow):
+    # prepare splits on '?' placeholders, execute decodes binary params,
+    # substitutes literals, and streams the resultset in BINARY rows.
+    def _handle_stmt_prepare(self, payload: bytes) -> None:
+        sql = payload.decode("utf-8", "replace")
+        parts = p.split_placeholders(sql)
+        n_params = len(parts) - 1
+        # result-column metadata WITHOUT executing: plan the statement
+        # with NULL in the placeholders (param types are unknown at
+        # prepare time — MySQL's own prepare metadata does the same)
+        cols = fts = None
+        try:
+            from ..parser import parse
+            probe = parse("NULL".join(parts))
+            if len(probe) == 1:
+                meta = self.session.select_metadata(probe[0])
+                if meta is not None:
+                    cols, fts = meta
+        except Exception:
+            cols = fts = None
+        finally:
+            self.session._pinned_is = None  # metadata build pinned it
+        sid = self._next_stmt_id
+        self._next_stmt_id += 1
+        self._stmts[sid] = [parts, None]
+        self.io.begin_buffer()
+        try:
+            self.io.write_packet(p.prepare_ok(sid, n_params,
+                                              len(cols) if cols else 0))
+            for _ in range(n_params):
+                self.io.write_packet(p.column_def("?", None))
+            if n_params:
+                self.io.write_packet(p.eof_packet())
+            if cols:
+                for name, ft in zip(cols, fts):
+                    self.io.write_packet(p.column_def(name, ft))
+                self.io.write_packet(p.eof_packet())
+        finally:
+            self.io.flush()
+
+    def _handle_stmt_execute(self, payload: bytes) -> None:
+        import struct
+        sid = struct.unpack_from("<I", payload, 0)[0]
+        ent = self._stmts.get(sid)
+        if ent is None:
+            self.io.write_packet(p.err_packet(
+                1243, f"Unknown prepared statement handler ({sid})",
+                "HY000"))
+            return
+        parts, prev_types = ent
+        _, vals, types = p.decode_execute_params(payload, len(parts) - 1,
+                                                 prev_types)
+        ent[1] = types
+        sql = parts[0] + "".join(p.literal(v) + seg
+                                 for v, seg in zip(vals, parts[1:]))
+        from ..parser import parse
+        stmts = parse(sql)
+        if len(stmts) != 1:
+            self.io.write_packet(p.err_packet(
+                1064, "prepared statement must be a single statement",
+                "42000"))
+            return
+        rs = self.session._execute_stmt(stmts[0])
+        if isinstance(rs, ResultSet):
+            self._write_resultset(rs, binary=True)
+        else:
+            self.io.write_packet(p.ok_packet(
+                affected=self.session.last_affected))
 
     def _run_sql(self, sql: str) -> None:
         """Execute statement-by-statement so each gets its own response,
@@ -133,7 +252,10 @@ class ClientConn:
                     affected=self.session.last_affected,
                     more_results=more))
 
-    def _write_resultset(self, rs: ResultSet, more: bool = False) -> None:
+    def _write_resultset(self, rs: ResultSet, more: bool = False,
+                         binary: bool = False) -> None:
+        """Text rows for COM_QUERY, binary rows for COM_STMT_EXECUTE
+        (reference conn.go:931,977 writeChunks text/binary split)."""
         from .packetio import lenenc_int
         self.io.begin_buffer()  # whole resultset -> one sendall
         try:
@@ -143,7 +265,8 @@ class ClientConn:
                 self.io.write_packet(p.column_def(name, ft))
             self.io.write_packet(p.eof_packet())
             for row in rs.rows:
-                self.io.write_packet(p.text_row(row))
+                self.io.write_packet(p.binary_row(row, fields) if binary
+                                     else p.text_row(row))
             self.io.write_packet(p.eof_packet(more_results=more))
         finally:
             self.io.flush()

@@ -315,6 +315,18 @@ class Session:
         return ResultSet(columns, rows,
                          [c.ret_type for c in logical.schema.columns])
 
+    def select_metadata(self, stmt) -> Optional[tuple]:
+        """(column names, FieldTypes) of a SELECT WITHOUT executing it —
+        COM_STMT_PREPARE result metadata (reference: prepare-time column
+        info in the writeResultset protocol contract).  Builds the
+        logical plan only; the statement pin is the caller's to clear."""
+        if not isinstance(stmt, ast.SelectStmt):
+            return None
+        builder = PlanBuilder(self)
+        logical = builder.build_select(stmt)
+        return ([c.name for c in logical.schema.columns],
+                [c.ret_type for c in logical.schema.columns])
+
     def _optimize(self, logical, use_tpu: bool):
         """Route between the two optimizer frameworks (reference:
         planner/optimize.go:29-56 EnableCascadesPlanner switch)."""
