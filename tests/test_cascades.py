@@ -37,6 +37,14 @@ QUERIES = [
     "group by u.v order by u.v",
     "select t.b, avg(t.a), max(t.c) from t join u on t.b = u.k "
     "group by t.b order by t.b",
+    # TopN through the preserved side of an outer join (cascades
+    # PushTopNDownOuterJoin; u.v keeps the join alive)
+    "select t.a, u.v from t left join u on t.b = u.k "
+    "order by t.a desc limit 4",
+    # projection merge/eliminate shapes (EliminateProjection,
+    # MergeAdjacentProjection, MergeAggregationProjection)
+    "select b + 1, count(*) from t group by b + 1 order by 1",
+    "select a * 2 from t where b = 2 order by a limit 3",
 ]
 
 

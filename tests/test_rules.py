@@ -326,3 +326,81 @@ def test_agg_not_eliminated_on_nullable_unique_index(tk):
     got = sorted(tk.query("select a, count(*) from nu group by a").rows,
                  key=lambda r: (r[0] is not None, r[0]))
     assert got == [[None, 2], [3, 1]], got
+
+
+# ---- round-4 cascades rule breadth (transformation_rules.go parity) -----
+
+def _cascades_plan(tk, q):
+    tk.execute("set @@tidb_enable_cascades_planner = 1")
+    try:
+        return [r[0].strip() for r in tk.query("explain " + q).rows]
+    finally:
+        tk.execute("set @@tidb_enable_cascades_planner = 0")
+
+
+def test_cascades_topn_through_outer_join(tk):
+    """PushTopNDownOuterJoin: sort keys from the preserved side push a
+    TopN below the left join (pre-cut reaches the cop layer)."""
+    tk.execute("create table lt (a int primary key, b int)")
+    tk.execute("insert into lt values " + ", ".join(
+        f"({i}, {i % 5})" for i in range(1, 61)))
+    tk.execute("create table rt (k int primary key, v varchar(5))")
+    tk.execute("insert into rt values (0,'z0'), (1,'z1'), (2,'z2')")
+    q = ("select lt.a, rt.v from lt left join rt on lt.b = rt.k "
+         "order by lt.a desc limit 3")
+    ops = _cascades_plan(tk, q)
+    ji = next(i for i, o in enumerate(ops) if o.startswith("HashJoin")
+              or o.startswith("MergeJoin"))
+    assert any(o.startswith("TopN") for o in ops[ji + 1:]), ops
+    tk.execute("set @@tidb_enable_cascades_planner = 1")
+    casc = tk.query(q).rows
+    tk.execute("set @@tidb_enable_cascades_planner = 0")
+    sysr = tk.query(q).rows
+    assert casc == sysr
+
+
+def test_cascades_merges_projections(tk):
+    """EliminateProjection / MergeAdjacentProjection: no projection
+    stacked directly on another projection survives exploration."""
+    tk.execute("create table mp (a int primary key, b int)")
+    tk.execute("insert into mp values (1, 2), (3, 4), (5, 6)")
+    for q in ("select a * 2 from mp where b > 1 order by a limit 2",
+              "select b + 1, count(*) from mp group by b + 1 order by 1"):
+        ops = _cascades_plan(tk, q)
+        for prev, cur in zip(ops, ops[1:]):
+            assert not (prev.startswith("Projection")
+                        and cur.startswith("Projection")), (q, ops)
+
+
+def test_pushsel_down_sort_rule_unit():
+    """PushSelDownSort memo-level unit: Selection(Sort(x)) gains a
+    Sort(Selection(x)) alternative."""
+    from tinysql_tpu.planner.cascades.memo import Memo, Group, GroupExpr
+    from tinysql_tpu.planner.cascades import rules as R
+    from tinysql_tpu.planner.logical import (LogicalSelection, LogicalSort)
+    from tinysql_tpu.session.session import new_session
+    s = new_session()
+    s.execute("create database ru")
+    s.execute("use ru")
+    s.execute("create table t (a int primary key, b int)")
+    from tinysql_tpu.planner.builder import PlanBuilder
+    from tinysql_tpu.parser import parse
+    stmt = parse("select a, b from t order by b")[0]
+    logical = PlanBuilder(s).build_select(stmt)
+    # locate the Sort node and wrap it in a Selection by hand
+    node = logical
+    while not isinstance(node, LogicalSort):
+        node = node.children[0]
+    sel = R._mk_sel([], node.schema)
+    memo = Memo()
+    sort_group = memo.build(node)
+    top = Group(node.schema)
+    sel_ge = GroupExpr(sel, [sort_group])
+    top.insert(sel_ge)
+    rule = R.PushSelDownSort()
+    fired = False
+    for binding in rule.pattern.match_expr(sel_ge):
+        fired |= rule.on_transform(memo, top, binding)
+    assert fired
+    kinds = {type(ge.op).__name__ for ge in top.exprs}
+    assert "LogicalSort" in kinds  # the pushed alternative

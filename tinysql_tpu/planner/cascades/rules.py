@@ -268,13 +268,151 @@ class PushLimitDownProjection(Transformation):
         return memo.insert_equivalent(group, new_proj, [lg])
 
 
+class PushSelDownSort(Transformation):
+    """Selection(Sort(x)) => Sort(Selection(x)) — filtering before the
+    sort is never worse (reference: PushSelDownSort
+    transformation_rules.go:388)."""
+    pattern = Pattern(LogicalSelection, [Pattern(LogicalSort)])
+
+    def on_transform(self, memo, group, binding):
+        sel_ge, sort_ge = binding[0], binding[1][0]
+        child_group = sort_ge.children[0]
+        s = _mk_sel(list(sel_ge.op.conditions), child_group.schema)
+        sg = Group(child_group.schema)
+        sg.insert(GroupExpr(s, [child_group]))
+        new_sort = copy.copy(sort_ge.op)
+        return memo.insert_equivalent(group, new_sort, [sg])
+
+
+class EliminateProjection(Transformation):
+    """Projection that is a 1:1 column passthrough of its child's schema
+    merges the child group's expressions into its own (reference:
+    EliminateProjection transformation_rules.go:623)."""
+    # no child pattern: one binding per projection expression (the child
+    # group is rescanned wholesale anyway)
+    pattern = Pattern(LogicalProjection)
+
+    def on_transform(self, memo, group, binding):
+        proj_ge = binding[0]
+        proj: LogicalProjection = proj_ge.op
+        if not proj_ge.children:
+            return False
+        child_group = proj_ge.children[0]
+        csch = child_group.schema.columns
+        if len(proj.exprs) != len(csch):
+            return False
+        for e, oc, c in zip(proj.exprs, proj.schema.columns, csch):
+            if not isinstance(e, Column) or e.unique_id != c.unique_id:
+                return False
+            if oc.unique_id != c.unique_id:
+                return False  # renaming projection: parents reference
+                # the NEW unique id — eliminating it would orphan them
+        changed = False
+        for cge in list(child_group.exprs):
+            changed |= memo.insert_equivalent(group, cge.op,
+                                              list(cge.children))
+        return changed
+
+
+class MergeAdjacentProjection(Transformation):
+    """Projection(Projection(x)) => one Projection with the outer exprs
+    substituted through the inner (reference: MergeAdjacentProjection
+    transformation_rules.go:663)."""
+    pattern = Pattern(LogicalProjection, [Pattern(LogicalProjection)])
+
+    def on_transform(self, memo, group, binding):
+        outer_ge, inner_ge = binding[0], binding[1][0]
+        outer, inner = outer_ge.op, inner_ge.op
+        # explicit resolvability check: substitute_column passes unknown
+        # columns through unchanged, which would silently emit a merged
+        # node referencing columns the new child does not produce
+        for e in outer.exprs:
+            if any(inner.schema.column_index(c) < 0
+                   for c in e.collect_columns()):
+                return False
+        exprs = [substitute_column(e, inner.schema, inner.exprs)
+                 for e in outer.exprs]
+        merged = _mk_proj(exprs, outer.schema)
+        return memo.insert_equivalent(group, merged,
+                                      list(inner_ge.children))
+
+
+class MergeAggregationProjection(Transformation):
+    """Aggregation(Projection(x)) => Aggregation'(x) with group-by and
+    argument expressions substituted through the projection (reference:
+    MergeAggregationProjection transformation_rules.go:778 — a course
+    stub there; realized per its header contract)."""
+    pattern = Pattern(LogicalAggregation, [Pattern(LogicalProjection)])
+
+    def on_transform(self, memo, group, binding):
+        agg_ge, proj_ge = binding[0], binding[1][0]
+        agg: LogicalAggregation = agg_ge.op
+        proj = proj_ge.op
+        for e in list(agg.group_by) + [a for d in agg.agg_funcs
+                                       for a in d.args]:
+            if any(proj.schema.column_index(c) < 0
+                   for c in e.collect_columns()):
+                return False
+        gb = [substitute_column(e, proj.schema, proj.exprs)
+              for e in agg.group_by]
+        funcs = []
+        for d in agg.agg_funcs:
+            d2 = d.clone()
+            d2.args = [substitute_column(a, proj.schema, proj.exprs)
+                       for a in d.args]
+            funcs.append(d2)
+        new_agg = copy.copy(agg)
+        new_agg.group_by = gb
+        new_agg.agg_funcs = funcs
+        return memo.insert_equivalent(group, new_agg,
+                                      list(proj_ge.children))
+
+
+class PushTopNDownOuterJoin(Transformation):
+    """TopN(LeftJoin(l, r)) with every sort key from the OUTER side =>
+    also TopN the left child (limit offset+count, offset 0): the join
+    preserves every outer row, so the global top-(o+c) is within the
+    outer top-(o+c) (the System-R topn_pushdown's join arm, reachable
+    from cascades plans; reference TiDB PushTopNDownOuterJoin)."""
+    pattern = Pattern(LogicalTopN, [Pattern(LogicalJoin)])
+
+    def on_transform(self, memo, group, binding):
+        from ..logical import JOIN_LEFT
+        topn_ge, join_ge = binding[0], binding[1][0]
+        topn: LogicalTopN = topn_ge.op
+        join: LogicalJoin = join_ge.op
+        if join.tp != JOIN_LEFT:
+            return False
+        lgroup, rgroup = join_ge.children
+        lsch = lgroup.schema
+        for e, _ in topn.by:
+            cols = e.collect_columns()
+            if not cols or not all(lsch.column_index(c) >= 0
+                                   for c in cols):
+                return False
+        inner = _mk_topn(list(topn.by), 0, topn.offset + topn.count, lsch)
+        lg = Group(lsch)
+        lg.insert(GroupExpr(inner, [lgroup]))
+        new_join = copy.copy(join)
+        jg = Group(group.schema)
+        jg.insert(GroupExpr(new_join, [lg, rgroup]))
+        top = _mk_topn(list(topn.by), topn.offset, topn.count,
+                       group.schema)
+        return memo.insert_equivalent(group, top, [jg])
+
+
 DEFAULT_RULES = [
     MergeLimitSortToTopN(),
     MergeAdjacentSelection(),
     PushSelDownDataSource(),
     PushSelDownProjection(),
+    PushSelDownSort(),
     PushSelDownJoin(),
     PushSelDownAggregation(),
     PushTopNDownProjection(),
+    PushTopNDownOuterJoin(),
     PushLimitDownProjection(),
+    EliminateProjection(),
+    MergeAdjacentProjection(),
+    MergeAggregationProjection(),
 ]

@@ -307,6 +307,7 @@ def decode_execute_params(payload: bytes, n_params: int,
 
 def literal(v: object) -> str:
     """Render a decoded parameter as a SQL literal for substitution."""
+    import math
     if v is None:
         return "NULL"
     if isinstance(v, bool):
@@ -314,6 +315,10 @@ def literal(v: object) -> str:
     if isinstance(v, int):
         return str(v)
     if isinstance(v, float):
+        if not math.isfinite(v):
+            # 'inf'/'nan' are not SQL literals; reject cleanly rather
+            # than surface a confusing parse error
+            raise ValueError("non-finite double parameter")
         return repr(v)
     s = str(v).replace("\\", "\\\\").replace("'", "\\'")
     return f"'{s}'"

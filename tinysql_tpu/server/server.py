@@ -161,7 +161,14 @@ class ClientConn:
     # (conn.go:879 writeResultset binary=true, util.go:171 dumpBinaryRow):
     # prepare splits on '?' placeholders, execute decodes binary params,
     # substitutes literals, and streams the resultset in BINARY rows.
+    MAX_PREPARED_STMTS = 1024  # per connection (max_prepared_stmt_count)
+
     def _handle_stmt_prepare(self, payload: bytes) -> None:
+        if len(self._stmts) >= self.MAX_PREPARED_STMTS:
+            self.io.write_packet(p.err_packet(
+                1461, "Can't create more than "
+                f"{self.MAX_PREPARED_STMTS} prepared statements", "42000"))
+            return
         sql = payload.decode("utf-8", "replace")
         parts = p.split_placeholders(sql)
         n_params = len(parts) - 1
@@ -178,8 +185,6 @@ class ClientConn:
                     cols, fts = meta
         except Exception:
             cols = fts = None
-        finally:
-            self.session._pinned_is = None  # metadata build pinned it
         sid = self._next_stmt_id
         self._next_stmt_id += 1
         self._stmts[sid] = [parts, None]
@@ -211,8 +216,12 @@ class ClientConn:
         _, vals, types = p.decode_execute_params(payload, len(parts) - 1,
                                                  prev_types)
         ent[1] = types
-        sql = parts[0] + "".join(p.literal(v) + seg
-                                 for v, seg in zip(vals, parts[1:]))
+        try:
+            sql = parts[0] + "".join(p.literal(v) + seg
+                                     for v, seg in zip(vals, parts[1:]))
+        except ValueError as e:
+            self.io.write_packet(p.err_packet(1367, str(e), "22007"))
+            return
         from ..parser import parse
         stmts = parse(sql)
         if len(stmts) != 1:

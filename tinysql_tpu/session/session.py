@@ -319,13 +319,18 @@ class Session:
         """(column names, FieldTypes) of a SELECT WITHOUT executing it —
         COM_STMT_PREPARE result metadata (reference: prepare-time column
         info in the writeResultset protocol contract).  Builds the
-        logical plan only; the statement pin is the caller's to clear."""
+        logical plan only and clears its own InfoSchema pin (a prepare
+        must not leave later statements planning against a stale
+        catalog)."""
         if not isinstance(stmt, ast.SelectStmt):
             return None
-        builder = PlanBuilder(self)
-        logical = builder.build_select(stmt)
-        return ([c.name for c in logical.schema.columns],
-                [c.ret_type for c in logical.schema.columns])
+        try:
+            builder = PlanBuilder(self)
+            logical = builder.build_select(stmt)
+            return ([c.name for c in logical.schema.columns],
+                    [c.ret_type for c in logical.schema.columns])
+        finally:
+            self._pinned_is = None
 
     def _optimize(self, logical, use_tpu: bool):
         """Route between the two optimizer frameworks (reference:
