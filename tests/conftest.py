@@ -20,3 +20,29 @@ import jax  # noqa: E402  (sitecustomize already imported it anyway)
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+import threading as _threading
+import time as _time
+
+import pytest as _pytest
+
+
+@_pytest.fixture(autouse=True, scope="module")
+def _no_thread_leak_per_module():
+    """Per-suite leak discipline (reference: util/testleak AfterTest wired
+    into every suite, leaktest.go:118): no non-daemon thread created in a
+    test module may survive the module."""
+    def live():
+        return {id(t): t.name for t in _threading.enumerate()
+                if t is not _threading.main_thread()
+                and not t.daemon and t.is_alive()}
+    base = set(live())
+    yield
+    deadline = _time.time() + 3.0
+    extra = {k: v for k, v in live().items() if k not in base}
+    while extra and _time.time() < deadline:
+        _time.sleep(0.05)
+        extra = {k: v for k, v in live().items() if k not in base}
+    assert not extra, \
+        f"module leaked non-daemon threads: {sorted(extra.values())}"
