@@ -34,15 +34,17 @@ def _no_thread_leak_per_module():
     into every suite, leaktest.go:118): no non-daemon thread created in a
     test module may survive the module."""
     def live():
-        return {id(t): t.name for t in _threading.enumerate()
+        return [t for t in _threading.enumerate()
                 if t is not _threading.main_thread()
-                and not t.daemon and t.is_alive()}
-    base = set(live())
+                and not t.daemon and t.is_alive()]
+    # strong refs to baseline Thread OBJECTS: comparing by id() would let
+    # a leaked thread hide behind a recycled address of a dead baseline
+    base = list(live())
     yield
     deadline = _time.time() + 3.0
-    extra = {k: v for k, v in live().items() if k not in base}
+    extra = [t for t in live() if t not in base]
     while extra and _time.time() < deadline:
         _time.sleep(0.05)
-        extra = {k: v for k, v in live().items() if k not in base}
+        extra = [t for t in live() if t not in base]
     assert not extra, \
-        f"module leaked non-daemon threads: {sorted(extra.values())}"
+        f"module leaked non-daemon threads: {sorted(t.name for t in extra)}"

@@ -504,6 +504,24 @@ class TPUHashAggExec(Executor):
             child._replica = rep
             return None
 
+        # ---- block-wise execution (SURVEY §5.7): tables above the device
+        # buffer budget stream through HBM in row blocks; partial states
+        # carry on host between blocks
+        try:
+            budget = int(self.ctx.session_vars.get(
+                "tidb_device_block_rows", 0) or 0)
+        except Exception:
+            budget = 0
+        if budget > 0 and n > budget:
+            out = self._fused_blockwise(chk, rep, child, filters,
+                                        specs, arg_exprs, slots,
+                                        key_layouts, n_segments, n, budget,
+                                        fmask=fmask)
+            if out is not None:
+                return out
+            child._replica = rep
+            return None
+
         # ---- device columns (memoized per replica + bucket) -------------
         needed = set(mask_needed)
         for a in arg_exprs:
@@ -586,6 +604,149 @@ class TPUHashAggExec(Executor):
             out_keys = self._decode_present(present, key_layouts)
         return self._assemble_output(chk, plan, slots, out_keys, out_aggs,
                                      first_orig,
+                                     [l[3] for l in key_layouts])
+
+    def _fused_blockwise(self, chk, rep, child, filters, specs,
+                         arg_exprs, slots, key_layouts, n_segments: int,
+                         n: int, budget: int, fmask=None):
+        """Block-wise fused aggregation (SURVEY §5.7 long-context
+        analogue; reference chunked iteration + RequiredRows): row blocks
+        of `budget` upload transiently (NOT replica-memoized — the whole
+        point is the table does not fit), the fused segment/scalar kernel
+        reduces each block on device, and per-segment partial states
+        (sum/count add, min/max fold, first-row min, presence union)
+        carry on host between blocks — the aggregate's partial/final mode
+        split applied across TIME instead of across workers."""
+        from ..ops.exprjit import stable_key
+        jn = kernels.jnp()
+        # host filter mask over the full table; reuse the caller's when
+        # it already folded one (the dev-mask path leaves it None)
+        if fmask is None and filters:
+            fmask = _fold_filter_masks(child, rep, chk, filters)
+        # argument programs (count-over-column reads only the null mask)
+        progs = []
+        for a in arg_exprs:
+            if isinstance(a, tuple):
+                progs.append(_count_mask_program(a[1]))
+            else:
+                progs.append(a)
+        program_key = tuple(
+            f"mask@{a[1]}" if isinstance(a, tuple)
+            else (stable_key(a) if a is not None else "-")
+            for a in arg_exprs)
+        needed = set()
+        for a in arg_exprs:
+            if isinstance(a, tuple):
+                needed.add((a[1], "mask"))
+            elif a is not None:
+                for c in a.collect_columns():
+                    needed.add((c.index, "full"))
+        gid_full = self._compose_gid(key_layouts, n) if key_layouts \
+            else None
+        ns = n_segments if key_layouts else 1
+        bb = kernels.bucket(budget)
+        seen = np.zeros(ns, dtype=bool)
+        first_acc = np.full(ns, np.iinfo(np.int64).max, dtype=np.int64)
+        acc: list = [None] * len(specs)
+
+        def ensure_acc(i, kind, dtype):
+            if acc[i] is not None:
+                return acc[i]
+            if kind in ("count_star", "count", "sum"):
+                av = np.zeros(ns, dtype=dtype)
+            elif kind == "min":
+                av = np.full(ns, np.inf if dtype == np.float64
+                             else np.iinfo(np.int64).max, dtype=dtype)
+            else:
+                av = np.full(ns, -np.inf if dtype == np.float64
+                             else np.iinfo(np.int64).min, dtype=dtype)
+            acc[i] = (av, np.ones(ns, dtype=bool))
+            return acc[i]
+
+        for start in range(0, n, budget):
+            end = min(start + budget, n)
+            m_rows = end - start
+            dev_cols = [None] * len(chk.columns)
+            for idx, kind in needed:
+                col = chk.columns[idx]
+                v = col.values()
+                m_ = col.null_mask()
+                if v.dtype == object or v.dtype.kind == "U":
+                    if kind == "full":
+                        return None  # string values in a compute expr
+                    dv = None
+                else:
+                    dv = jn.asarray(kernels.pad1(v[start:end], bb))
+                dn = jn.asarray(kernels.pad1(m_[start:end], bb, True))
+                if dev_cols[idx] is None or dv is not None:
+                    dev_cols[idx] = (dv, dn)
+            bmask = np.zeros(bb, dtype=bool)
+            bmask[:m_rows] = fmask[start:end] if fmask is not None \
+                else True
+            mask_spec = ("host", jn.asarray(bmask))
+            if key_layouts:
+                gid_b = jn.asarray(kernels.pad1(gid_full[start:end], bb))
+                present, outs, first = kernels.fused_segment_aggregate(
+                    dev_cols, gid_b, ns, specs, progs, m_rows, mask_spec,
+                    program_key=program_key)
+            else:
+                # scalar contract (_unpack_scalar_agg): zero-or-one-row
+                # arrays; an empty block contributes nothing
+                outs, first = kernels.fused_scalar_aggregate(
+                    dev_cols, specs, progs, m_rows, bb, mask_spec,
+                    program_key=program_key)
+                present = np.zeros(len(first), dtype=np.int64)
+                outs = [(np.asarray(v_), np.asarray(m_))
+                        for v_, m_ in outs]
+            if len(present) == 0:
+                continue
+            seen[present] = True
+            first_acc[present] = np.minimum(first_acc[present],
+                                            np.asarray(first) + start)
+            for i, ((v_, m_), (kind, _)) in enumerate(zip(outs, specs)):
+                v_ = np.asarray(v_)
+                m_ = np.asarray(m_)
+                live = ~m_
+                if not live.any():
+                    continue
+                av, am = ensure_acc(i, kind, v_.dtype)
+                ids = np.asarray(present)[live]
+                vv = v_[live]
+                if kind in ("count_star", "count", "sum"):
+                    av[ids] += vv
+                elif kind == "min":
+                    av[ids] = np.minimum(av[ids], vv)
+                else:
+                    av[ids] = np.maximum(av[ids], vv)
+                am[ids] = False
+        if self.plan.group_by:
+            present_ids = np.nonzero(seen)[0]
+        else:
+            # a scalar aggregate over zero rows still returns one row
+            # (COUNT=0, SUM=NULL)
+            present_ids = np.arange(1)
+            if not seen[0]:
+                first_acc[0] = 0
+        out_aggs = []
+        for i, (kind, _) in enumerate(specs):
+            if acc[i] is None:
+                dt = np.int64 if kind != "sum" else np.float64
+                av = np.zeros(ns, dtype=dt)
+                am = np.ones(ns, dtype=bool)
+                if kind in ("count_star", "count"):
+                    am = np.zeros(ns, dtype=bool)  # COUNT of nothing = 0
+                acc[i] = (av, am)
+            av, am = acc[i]
+            if kind in ("count_star", "count"):
+                am = np.zeros_like(am)  # counts are never NULL
+            out_aggs.append((av[present_ids], am[present_ids]))
+        out_keys = self._decode_present(present_ids, key_layouts) \
+            if key_layouts else []
+        first_orig = np.where(
+            first_acc[present_ids] == np.iinfo(np.int64).max, 0,
+            first_acc[present_ids])
+        return self._assemble_output(chk, self.plan, slots, out_keys,
+                                     out_aggs, first_orig,
                                      [l[3] for l in key_layouts])
 
     def _mesh_if_enabled(self, nb: int):

@@ -49,6 +49,12 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # shuffle-partition over the mesh via all_to_all; smaller ones
     # broadcast (reference P4 "partition build-side tables" north star)
     "tidb_broadcast_build_max_rows": 1 << 20,
+    # device memory budget in ROWS per upload block: AGGREGATION over
+    # tables above it runs block-wise (partial-state carry) instead of
+    # whole-column resident, and the fused device pipeline stands down
+    # (SURVEY §5.7 long-context analogue).  Other device operators are
+    # not budget-aware yet.  0 = unlimited
+    "tidb_device_block_rows": 0,
     "sql_mode": "STRICT_TRANS_TABLES",
     "max_execution_time": 0,
 }
