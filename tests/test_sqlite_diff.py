@@ -113,10 +113,17 @@ class _Gen:
                      "t.c is not null"])
             return (f"select t.a, u.v from t {jt} u on {cond}{where} "
                     f"order by t.a, u.v")
-        # aggregate over a join
+        # aggregate over a join: FINAL merges (pushdown-rewritten),
+        # raw mode (args from both sides), multi-key groups, outer joins
         cond = r.choice(["t.b = u.k", "t.a = u.k"])
-        return (f"select u.v, count(*), sum(t.b) from t join u on {cond}"
-                f"{where} group by u.v order by u.v")
+        jt = r.choice(["join", "join", "left join"])
+        gb = r.choice(["u.v", "u.k", "u.v, t.b"])
+        aggs = ", ".join(r.choice(
+            ["count(*)", "count(t.b)", "sum(t.b)", "sum(t.c)",
+             "avg(t.c)", "min(t.c)", "max(t.b)", "sum(t.c * u.k)",
+             "min(u.k)"]) for _ in range(r.randint(1, 3)))
+        return (f"select {gb}, {aggs} from t {jt} u on {cond}"
+                f"{where} group by {gb} order by {gb}")
 
 
 def _canon(rows):
