@@ -358,3 +358,64 @@ def test_autocommit0_first_stmt_atomicity(tk):
     tk.must_exec("commit")
     tk.must_query("select a from t").check(rows("2"))
     tk.must_exec("set autocommit = 1")
+
+
+def test_show_warnings_and_create_database(tk):
+    tk.must_exec("create database if not exists swdb")
+    # IF NOT EXISTS over an existing db -> Note 1007 (reference
+    # executor/show.go fetchShowWarnings; StatementContext warnings)
+    tk.must_exec("create database if not exists swdb")
+    r = tk.must_query("show warnings").as_str()
+    assert r and r[0][0] == "Note" and r[0][1] == "1007", r
+    assert tk.must_query("show errors").as_str() == []
+    tk.must_exec("use swdb")
+    tk.must_exec("create table wt (a int primary key)")
+    tk.must_exec("create table if not exists wt (a int primary key)")
+    r = tk.must_query("show warnings").as_str()
+    assert r and r[0][1] == "1050", r
+    tk.must_exec("drop table if exists nope_missing")
+    r = tk.must_query("show warnings").as_str()
+    assert r and r[0][1] == "1051", r
+    # a successful statement clears the warning sink
+    tk.must_query("select 1")
+    assert tk.must_query("show warnings").as_str() == []
+    r = tk.must_query("show create database swdb").as_str()
+    assert r[0][0] == "swdb" and "CREATE DATABASE" in r[0][1]
+    tk.must_exec("drop database if exists missing_db")
+    r = tk.must_query("show warnings").as_str()
+    assert r and r[0][1] == "1008", r
+
+
+def test_show_errors_reports_failed_statement(tk):
+    tk.must_exec("create database if not exists sedb")
+    tk.must_exec("use sedb")
+    try:
+        tk.must_exec("drop table definitely_missing")
+        assert False, "expected error"
+    except Exception:
+        pass
+    r = tk.must_query("show errors").as_str()
+    assert r and r[0][0] == "Error" and "definitely_missing" in r[0][2], r
+    # warnings view includes the error too
+    r = tk.must_query("show warnings").as_str()
+    assert r and r[0][0] == "Error", r
+
+
+def test_failed_ddl_leaves_no_success_note(tk):
+    # drop table if exists in a MISSING DATABASE errors on the database;
+    # no Note 1051 may survive (round-4 review repro)
+    try:
+        tk.must_exec("drop table if exists no_such_db.t")
+        assert False, "expected error"
+    except Exception:
+        pass
+    r = tk.must_query("show warnings").as_str()
+    assert all(row[1] != "1051" for row in r), r
+
+
+def test_show_warnings_rejects_like(tk):
+    try:
+        tk.must_query("show warnings like '%x%'")
+        assert False, "expected parse error"
+    except Exception:
+        pass

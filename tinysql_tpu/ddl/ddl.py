@@ -250,24 +250,29 @@ class DDL:
                     pass
 
     # ---- databases ------------------------------------------------------
-    def create_database(self, name: str, if_not_exists=False) -> None:
+    def create_database(self, name: str, if_not_exists=False) -> bool:
+        """Returns True when IF NOT EXISTS made this a no-op (the
+        session's Note 1007 rides the authoritative check here)."""
         txn = self.storage.begin()
         m = Meta(txn)
         exists = any(d.name.lower() == name.lower() for d in m.list_databases())
         txn.rollback()
         if exists:
             if if_not_exists:
-                return
+                return True
             raise DBExists(name)
         self._run_job(Job(0, ActionType.CREATE_SCHEMA, 0, 0, args=[name]))
+        return False
 
-    def drop_database(self, name: str, if_exists=False) -> None:
+    def drop_database(self, name: str, if_exists=False) -> bool:
+        """True when IF EXISTS made this a no-op (session Note 1008)."""
         db_id = self._db_id(name)
         if db_id is None:
             if if_exists:
-                return
+                return True
             raise DDLError(f"Can't drop database '{name}'; database doesn't exist")
         self._run_job(Job(0, ActionType.DROP_SCHEMA, db_id, 0))
+        return False
 
     def _db_id(self, name: str) -> Optional[int]:
         txn = self.storage.begin()
@@ -298,24 +303,28 @@ class DDL:
         return t
 
     # ---- tables ---------------------------------------------------------
-    def create_table(self, db_name: str, stmt: ast.CreateTableStmt) -> None:
+    def create_table(self, db_name: str, stmt: ast.CreateTableStmt) -> bool:
+        """True when IF NOT EXISTS made this a no-op (session Note 1050)."""
         db_id = self._require_db(db_name)
         if self._table(db_id, stmt.table.name) is not None:
             if stmt.if_not_exists:
-                return
+                return True
             raise TableExists(stmt.table.name)
         info = build_table_info(stmt, None)
         self._run_job(Job(0, ActionType.CREATE_TABLE, db_id, 0,
                           args=[info.to_dict()]))
+        return False
 
-    def drop_table(self, db_name: str, table: str, if_exists=False) -> None:
+    def drop_table(self, db_name: str, table: str, if_exists=False) -> bool:
+        """True when IF EXISTS made this a no-op (session Note 1051)."""
         db_id = self._require_db(db_name)
         t = self._table(db_id, table)
         if t is None:
             if if_exists:
-                return
+                return True
             raise DDLError(f"Unknown table '{table}'")
         self._run_job(Job(0, ActionType.DROP_TABLE, db_id, t.id))
+        return False
 
     def truncate_table(self, db_name: str, table: str) -> None:
         db_id = self._require_db(db_name)

@@ -623,24 +623,36 @@ class Parser:
             if self._accept_kw("from", "in"):
                 stmt.db = self._ident()
         elif self._accept_kw("create"):
-            self._expect_kw("table")
-            stmt = ShowStmt("create_table", table=self._table_name())
+            if self._accept_kw("database", "schema"):
+                stmt = ShowStmt("create_database")
+                stmt.db = self._ident()
+            else:
+                self._expect_kw("table")
+                stmt = ShowStmt("create_table", table=self._table_name())
         elif self._accept_kw("index", "indexes", "keys"):
             self._expect_kw("from")
             stmt = ShowStmt("indexes", table=self._table_name())
         elif self._accept_kw("variables"):
             stmt = ShowStmt("variables", global_scope=glob)
+        elif self._accept_kw("warnings"):
+            stmt = ShowStmt("warnings")
+        elif self._accept_kw("errors"):
+            stmt = ShowStmt("errors")
         else:
             raise ParseError("unsupported SHOW", self._cur().pos)
         stmt.full = full
-        if self._accept_kw("like"):
-            t = self._cur()
-            if t.kind != T_STRING:
-                raise ParseError("expected pattern string", t.pos)
-            self._advance()
-            stmt.pattern = t.value
-        elif self._accept_kw("where"):
-            stmt.where = self._expr()
+        # LIKE/WHERE tails only on the list-producing kinds (MySQL
+        # rejects e.g. SHOW WARNINGS LIKE ...)
+        if stmt.tp in ("databases", "tables", "columns", "indexes",
+                       "variables"):
+            if self._accept_kw("like"):
+                t = self._cur()
+                if t.kind != T_STRING:
+                    raise ParseError("expected pattern string", t.pos)
+                self._advance()
+                stmt.pattern = t.value
+            elif self._accept_kw("where"):
+                stmt.where = self._expr()
         return stmt
 
     def _set_stmt(self) -> SetStmt:

@@ -106,6 +106,9 @@ class Session:
         self._pinned_is: Optional[InfoSchema] = None
         self.ddl = domain.ddl()
         self.last_affected = 0
+        # (Level, Code, Message) triples of the LAST statement
+        # (reference: StatementContext warnings, SHOW WARNINGS/ERRORS)
+        self.last_warnings: List[tuple] = []
         # per-statement phase timings (reference: session.go DurationParse
         # :590 / DurationCompile :612 + slow-query logging)
         self.last_query_info: Dict[str, float] = {}
@@ -237,11 +240,20 @@ class Session:
         cp = self._txn.checkpoint() if (in_txn_scope and self._txn) else None
         self.last_affected = 0  # per-statement affected-rows counter
         self._pinned_is = None  # each statement pins a fresh InfoSchema
+        if not isinstance(stmt, ast.ShowStmt):
+            # statement-scoped warning sink (reference StatementContext
+            # warnings); SHOW itself must not clear what it reports
+            self.last_warnings = []
         try:
             rs = self._dispatch(stmt)
             self._finish_stmt(ok=True)
             return rs
-        except Exception:
+        except Exception as e:
+            if not isinstance(stmt, ast.ShowStmt):
+                # SHOW ERRORS reports the failed statement (reference:
+                # fetchShowWarnings(errors=true)); 1105 = generic server
+                # error, the wire layer's own mapping
+                self.last_warnings.append(("Error", 1105, str(e)))
             if cp is not None and self._txn is not None:
                 self._txn.restore(cp)
             elif in_txn_scope and self._txn is not None:
@@ -400,25 +412,43 @@ class Session:
         self.last_affected = dex.execute(txn, rows)
         return None
 
+    def add_warning(self, level: str, code: int, msg: str) -> None:
+        self.last_warnings.append((level, code, msg))
+
     # ---- DDL (implicit commit, reference: session commits before DDL) ---
     def _exec_ddl(self, stmt) -> None:
         self.commit_txn()
         d = self.ddl
+        # IF [NOT] EXISTS Notes ride the DDL layer's AUTHORITATIVE
+        # existence checks (the ops return True on a no-op), recorded
+        # only AFTER the op succeeded — a failing statement must not
+        # leave success-path warnings behind
         if isinstance(stmt, ast.CreateDatabaseStmt):
-            d.create_database(stmt.name, stmt.if_not_exists)
+            if d.create_database(stmt.name, stmt.if_not_exists):
+                self.add_warning("Note", 1007,
+                                 f"Can't create database '{stmt.name}'; "
+                                 "database exists")
         elif isinstance(stmt, ast.DropDatabaseStmt):
-            d.drop_database(stmt.name, stmt.if_exists)
+            if d.drop_database(stmt.name, stmt.if_exists):
+                self.add_warning("Note", 1008,
+                                 f"Can't drop database '{stmt.name}'; "
+                                 "database doesn't exist")
             if self.current_db.lower() == stmt.name.lower():
                 self.current_db = ""
         elif isinstance(stmt, ast.CreateTableStmt):
             db = stmt.table.db or self.current_db
             if not db:
                 raise SessionError("No database selected")
-            d.create_table(db, stmt)
+            if d.create_table(db, stmt):
+                self.add_warning("Note", 1050,
+                                 f"Table '{stmt.table.name}' already "
+                                 "exists")
         elif isinstance(stmt, ast.DropTableStmt):
             for tn in stmt.tables:
-                d.drop_table(tn.db or self.current_db, tn.name,
-                             stmt.if_exists)
+                db = tn.db or self.current_db
+                if d.drop_table(db, tn.name, stmt.if_exists):
+                    self.add_warning("Note", 1051,
+                                     f"Unknown table '{db}.{tn.name}'")
         elif isinstance(stmt, ast.CreateIndexStmt):
             d.add_index(stmt.table.db or self.current_db, stmt.table.name,
                         stmt.index_name, stmt.columns, stmt.unique)
@@ -512,6 +542,19 @@ class Session:
             rows = [[k, to_string(v)] for k, v in sorted(merged.items())
                     if pat is None or pat.match(k)]
             return ResultSet(["Variable_name", "Value"], rows)
+        if stmt.tp == "create_database":
+            from ..catalog.infoschema import DatabaseNotExist
+            d = isc.schema_by_name(stmt.db)
+            if d is None:
+                raise DatabaseNotExist(stmt.db)
+            return ResultSet(
+                ["Database", "Create Database"],
+                [[d.name, f"CREATE DATABASE `{d.name}` /*!40100 DEFAULT "
+                          "CHARACTER SET utf8mb4 */"]])
+        if stmt.tp in ("warnings", "errors"):
+            rows = [[lv, cd, msg] for lv, cd, msg in self.last_warnings
+                    if stmt.tp == "warnings" or lv == "Error"]
+            return ResultSet(["Level", "Code", "Message"], rows)
         raise SessionError(f"unsupported SHOW {stmt.tp}")
 
     # ---- EXPLAIN ---------------------------------------------------------
