@@ -396,3 +396,81 @@ def test_keyorder_swapped_group_bys_no_cache_clobber(tk, counters):
     assert_match(tk, q1)
     assert_match(tk, q2)
     assert_match(tk, q1)  # re-run q1 AFTER q2 traced: must still be right
+
+
+# ---- multi-key equi-joins via composite lanes ---------------------------
+
+def _mk_fixture(tk, seed=13):
+    rng = np.random.default_rng(seed)
+    rows_k1, rows_k2, rows_v, rows_id = [], [], [], []
+    i = 1
+    for a in range(1, 21):
+        for b in range(1, 16):
+            rows_id.append(i)
+            rows_k1.append(a)
+            rows_k2.append(b)
+            rows_v.append(a * 100.0 + b)
+            i += 1
+    _load(tk, "dimk", "id bigint primary key, k1 bigint, k2 bigint, "
+                      "v double",
+          {"id": (np.array(rows_id, dtype=np.int64), None),
+           "k1": (np.array(rows_k1, dtype=np.int64), None),
+           "k2": (np.array(rows_k2, dtype=np.int64), None),
+           "v": (np.array(rows_v), None)})
+    tk.execute("create unique index uk on dimk (k1, k2)")
+    n = 3000
+    f1 = rng.integers(1, 25, n).astype(np.int64)
+    f2 = rng.integers(1, 18, n).astype(np.int64)
+    f2n = rng.random(n) < 0.05
+    _load(tk, "factk", "fid bigint primary key, f1 bigint, f2 bigint, "
+                       "x double",
+          {"fid": (np.arange(1, n + 1, dtype=np.int64), None),
+           "f1": (f1, None), "f2": (f2, f2n),
+           "x": (rng.random(n) * 100, None)})
+
+
+def test_multikey_join_inner(tk, counters):
+    _mk_fixture(tk)
+    assert_match(tk, "select factk.fid, dimk.v from factk join dimk "
+                     "on factk.f1 = dimk.k1 and factk.f2 = dimk.k2 "
+                     "where factk.x < 50 order by factk.fid limit 40")
+    assert counters["join"] >= 1
+    assert any(k[0] == "joinmk" for k in devpipe.COMPILED_NODE_KEYS)
+
+
+def test_multikey_join_left_null_extend(tk, counters):
+    _mk_fixture(tk)
+    # f1 in 21..24 / f2 in 16..17 miss dimk; NULL f2 never matches
+    assert_match(tk, "select factk.fid, dimk.v from factk left join dimk "
+                     "on factk.f1 = dimk.k1 and factk.f2 = dimk.k2 "
+                     "order by factk.fid limit 100")
+
+
+def test_multikey_join_group_by_above(tk, counters):
+    _mk_fixture(tk)
+    assert_match(tk, "select dimk.k1, count(*), sum(factk.x), "
+                     "avg(factk.x) from factk join dimk "
+                     "on factk.f1 = dimk.k1 and factk.f2 = dimk.k2 "
+                     "group by dimk.k1 order by dimk.k1")
+
+
+def test_multikey_join_nonunique_build_falls_back_correct(tk, counters):
+    _mk_fixture(tk)
+    # dup table: NO unique index covers (g1, g2) and the tuple repeats,
+    # so _unique_on cannot prove uniqueness — devpipe must DECLINE
+    # (no new joinmk program) and the CPU join with device children
+    # must still answer correctly, including the duplicate expansion
+    rng = np.random.default_rng(5)
+    g1 = np.repeat(np.arange(1, 11, dtype=np.int64), 6)
+    g2 = np.tile(np.arange(1, 4, dtype=np.int64), 20)  # (g1,g2) dup x2
+    _load(tk, "dupd", "id bigint primary key, g1 bigint, g2 bigint, "
+                      "w double",
+          {"id": (np.arange(1, 61, dtype=np.int64), None),
+           "g1": (g1, None), "g2": (g2, None),
+           "w": (rng.random(60) * 10, None)})
+    before = {k for k in devpipe.COMPILED_NODE_KEYS if k[0] == "joinmk"}
+    assert_match(tk, "select factk.fid, dupd.w from factk join dupd "
+                     "on factk.f1 = dupd.g1 and factk.f2 = dupd.g2 "
+                     "order by factk.fid, dupd.w limit 40")
+    after = {k for k in devpipe.COMPILED_NODE_KEYS if k[0] == "joinmk"}
+    assert before == after, "non-unique multi-key build must not joinmk"

@@ -798,13 +798,16 @@ class _JoinNode:
       lands in a static bucket via scatter-starts + running-max fill.
     """
 
-    def __init__(self, probe, build, probe_key, build_key, tp,
+    def __init__(self, probe, build, probe_keys, build_keys, tp,
                  probe_is_left, plan, mesh=None, mult=False,
                  session_vars=None):
         self.probe = probe
         self.build = build
-        self.probe_key = probe_key
-        self.build_key = build_key
+        self.probe_keys = list(probe_keys)
+        self.build_keys = list(build_keys)
+        self.probe_key = self.probe_keys[0]
+        self.build_key = self.build_keys[0]
+        self.nk = len(self.probe_keys)
         self.tp = tp
         self.probe_is_left = probe_is_left
         self.plan = plan
@@ -819,37 +822,47 @@ class _JoinNode:
             return None
         if plan.tp not in ("inner", "left"):
             return None
-        if len(plan.left_keys) != 1 or plan.other_conditions:
+        if not plan.left_keys or plan.other_conditions \
+                or len(plan.left_keys) != len(plan.right_keys):
             return None
-        lk, rk = plan.left_keys[0], plan.right_keys[0]
-        if not (isinstance(lk, ExprColumn) and isinstance(rk, ExprColumn)):
-            return None
-        for k in (lk, rk):
-            if k.eval_type is not EvalType.INT \
+        for k in list(plan.left_keys) + list(plan.right_keys):
+            if not isinstance(k, ExprColumn) \
+                    or k.eval_type is not EvalType.INT \
                     or getattr(k.ret_type, "is_unsigned", False):
                 return None
         if getattr(plan, "left_conditions", None) \
                 or getattr(plan, "right_conditions", None):
             return None  # side conds live in Selections below by now
+        nk = len(plan.left_keys)
+        lk, rk = plan.left_keys[0], plan.right_keys[0]
         mult = False
-        if getattr(plan, "right_unique", False):
+        if nk > 1:
+            # multi-key: composite lane over a dense range — unique
+            # build over the key SET, leaf/sel build sides only (the
+            # non-unique composite CSR degrades to the CPU join)
+            if not getattr(plan, "right_unique", False):
+                return None
             build_side, probe_side = 1, 0
-            build_key, probe_key = rk, lk
+            build_keys = list(plan.right_keys)
+            probe_keys = list(plan.left_keys)
+        elif getattr(plan, "right_unique", False):
+            build_side, probe_side = 1, 0
+            build_keys, probe_keys = [rk], [lk]
         elif getattr(plan, "left_unique", False) and plan.tp == "inner":
             build_side, probe_side = 0, 1
-            build_key, probe_key = lk, rk
+            build_keys, probe_keys = [lk], [rk]
         else:
             # general multiplicity: build stays the right child (the
             # probe must stay the outer side of a LEFT join), CSR over
             # the build replica's group index
             build_side, probe_side = 1, 0
-            build_key, probe_key = rk, lk
+            build_keys, probe_keys = [rk], [lk]
             mult = True
         build = _compile_node(plan.children[build_side], ctx)
         if build is None:
             return None
-        ok = _leafish(build) is not None if mult \
-            else _has_build_key_info(build, build_key)
+        ok = _leafish(build) is not None if (nk > 1 or mult) \
+            else _has_build_key_info(build, build_keys[0])
         if not ok:
             _close_node(build)
             return None
@@ -857,8 +870,9 @@ class _JoinNode:
         if probe is None:
             _close_node(build)
             return None
-        return _JoinNode(probe, build, probe_key, build_key, plan.tp,
-                         probe_side == 0, plan, mesh=ctx.mesh, mult=mult,
+        return _JoinNode(probe, build, probe_keys, build_keys,
+                         plan.tp, probe_side == 0, plan, mesh=ctx.mesh,
+                         mult=mult,
                          session_vars=getattr(ctx.exec_ctx,
                                               "session_vars", None))
 
@@ -869,9 +883,101 @@ class _JoinNode:
         ptv = self.probe.prepare(pb)
         if ptv is None:
             return None
+        if self.nk > 1:
+            return self._prepare_unique_multi(pb, btv, ptv)
         if self.mult:
             return self._prepare_mult(pb, btv, ptv)
         return self._prepare_unique(pb, btv, ptv)
+
+    # ---- multi-key unique build: composite lane + dense table ----------
+
+    def _host_raw_key_cols(self, node, keys):
+        """Raw host (vals, nulls) per key over a leaf/sel chain, plus the
+        (replica, stable slot ids)."""
+        leaf = _leafish(node)
+        if leaf is None:
+            return None
+        rep = leaf.replica()
+        if rep is None:
+            return None
+        from .tpu_executors import _slot_id
+        cols, sids = [], []
+        for k in keys:
+            sid = _slot_id(leaf.ex, k.index)
+            if sid == "handle":
+                kv = rep.handles
+                km = np.zeros(rep.n_rows, dtype=bool)
+            else:
+                kv, km = rep.columns[sid]
+            if kv.dtype != np.int64:
+                return None
+            cols.append((kv, km))
+            sids.append(sid)
+        return rep, tuple(sids), cols
+
+    def _prepare_unique_multi(self, pb, btv, ptv) -> Optional[_TView]:
+        got = self._host_raw_key_cols(self.build, self.build_keys)
+        if got is None:
+            return None
+        rep, sids, cols = got
+        # per replica version: the full-column min/max scans + composite
+        # lane build amortize like the single-key bounds/pos tables
+        spec = rep.memo(("composite_spec", sids),
+                        lambda: _composite_spec(cols))
+        if spec is None:
+            return None
+        los, his, strides, comp, null_any, total = spec
+        jn = _jn()
+        nb, nbb = ptv.nb, btv.nb
+        pk_slots = tuple(k.index for k in self.probe_keys)
+        outer = self.tp == "left"
+        probe_is_left = self.probe_is_left
+
+        def mk():
+            # dense composite -> build row (uniqueness over the key SET
+            # is planner-proven; rows with any NULL key never match)
+            tbl = np.full(total, -1, dtype=np.int32)
+            live = ~null_any
+            tbl[comp[live]] = np.nonzero(live)[0].astype(np.int32)
+            return tbl
+        it = pb.add(_dev_upload(rep, ("postable_multi", sids), mk))
+        pt = ParamTable()
+        for lo, hi, st in zip(los, his, strides):
+            pt.add_int(lo)
+            pt.add_int(hi)
+            pt.add_int(st)
+        ip, fp = pb.params(pt)
+        pb.key(("joinmk", nb, nbb, total, pk_slots, outer, probe_is_left,
+                len(btv.meta), len(ptv.meta)))
+
+        def emit(args):
+            bvalid, bpairs = btv.emit(args)
+            pvalid, ppairs = ptv.emit(args)
+            pr = (args[ip], args[fp])
+            ok = pvalid
+            comp_t = jn.zeros(nb, dtype=jn.int64)
+            for j, slot in enumerate(pk_slots):
+                kv, kn = ppairs[slot]
+                lo_ = pr[0][3 * j]
+                hi_ = pr[0][3 * j + 1]
+                st_ = pr[0][3 * j + 2]
+                ok = ok & (kv >= lo_) & (kv <= hi_) & ~kn
+                comp_t = comp_t + (kv - lo_) * st_
+            pos0 = jn.clip(comp_t, 0, total - 1)
+            pos = jn.where(ok, args[it][pos0].astype(jn.int64), -1)
+            pos_safe = jn.clip(pos, 0, nbb - 1)
+            match = (pos >= 0) & bvalid[pos_safe]
+            valid_out = pvalid if outer else (pvalid & match)
+            gathered = [(bv[pos_safe], bn[pos_safe] | ~match)
+                        for bv, bn in bpairs]
+            if probe_is_left:
+                return valid_out, list(ppairs) + gathered
+            return valid_out, gathered + list(ppairs)
+        if probe_is_left:
+            meta = ptv.meta + btv.meta
+        else:
+            meta = btv.meta + ptv.meta
+        return _TView(emit, nb, meta)
 
     # ---- unique build side: dense pos table + gather -------------------
 
@@ -1462,6 +1568,40 @@ def _prepare_build_key_info(node, build_key, pb: _PipeBuilder):
         d = _dev_upload(rep, ("postable_dev", sid), lambda: tbl)
         return lo, hi, pb.add(d), int(tbl.shape[0])
     return None
+
+
+def _composite_spec(cols):
+    """Multi-key composite lane: per-key (lo, hi, stride) such that
+    comp = sum((k_i - lo_i) * stride_i) is a bijection over the cross
+    range — the device-friendly replacement for a multi-column hash key
+    (reference join key tuples, util/mvmap multi-part keys).  None when
+    the combined dense range exceeds MAX_DENSE_RANGE."""
+    los, his = [], []
+    total = 1
+    for kv, km in cols:
+        nn = kv[~km]
+        if len(nn):
+            lo, hi = int(nn.min()), int(nn.max())
+        else:
+            lo = hi = 0
+        span = hi - lo + 1
+        if span <= 0 or total > MAX_DENSE_RANGE // span:
+            return None
+        total *= span
+        los.append(lo)
+        his.append(hi)
+    strides = []
+    st = 1
+    for lo, hi in reversed(list(zip(los, his))):
+        strides.append(st)
+        st *= hi - lo + 1
+    strides.reverse()
+    comp = np.zeros(len(cols[0][0]), dtype=np.int64)
+    null_any = np.zeros(len(cols[0][0]), dtype=bool)
+    for (kv, km), lo, hi, stride in zip(cols, los, his, strides):
+        comp += (np.clip(kv, lo, hi) - lo) * stride
+        null_any |= km
+    return los, his, strides, comp, null_any, total
 
 
 class _SelNode:

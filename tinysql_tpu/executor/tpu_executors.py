@@ -1337,6 +1337,12 @@ def _build_tpu_op(plan) -> Optional[Executor]:
     if isinstance(plan, PhysicalHashAgg):
         return TPUHashAggExec(plan, build_executor(plan.children[0], True))
     if isinstance(plan, PhysicalHashJoin):
+        if len(plan.left_keys) != 1:
+            # multi-key joins ride devpipe composite lanes; the per-op
+            # kernel is single-key — CPU join over TPU-capable children
+            from .executors import HashJoinExec
+            return HashJoinExec(plan, build_executor(plan.children[0], True),
+                                build_executor(plan.children[1], True))
         return TPUHashJoinExec(plan, build_executor(plan.children[0], True),
                                build_executor(plan.children[1], True))
     if isinstance(plan, PhysicalTopN):

@@ -78,13 +78,22 @@ def place_devices(p: PhysicalPlan, enabled: bool = True,
         def _uns(e):
             return (e.eval_type is EvalType.INT
                     and getattr(e.ret_type, "is_unsigned", False))
-        p.use_tpu = (big and len(p.left_keys) == 1
-                     and is_jittable(p.left_keys[0])
-                     and is_jittable(p.right_keys[0])
-                     # mixed-signedness int keys need per-pair compare
-                     # semantics the sort+searchsorted kernel lacks: CPU tier
-                     and _uns(p.left_keys[0]) == _uns(p.right_keys[0])
-                     and p.tp in ("inner", "left"))
+        def _pair_ok(lk, rk):
+            # mixed-signedness int keys need per-pair compare semantics
+            # the sort+searchsorted kernel lacks: CPU tier
+            return (is_jittable(lk) and is_jittable(rk)
+                    and _uns(lk) == _uns(rk))
+        multi_ok = (len(p.left_keys) > 1
+                    # multi-key: devpipe composite lanes — signed-int
+                    # plain columns only (bounded composite ranges)
+                    and all(isinstance(k, Column)
+                            and k.eval_type is EvalType.INT
+                            and not _uns(k)
+                            for k in list(p.left_keys) + list(p.right_keys)))
+        p.use_tpu = (big and p.tp in ("inner", "left")
+                     and ((len(p.left_keys) == 1
+                           and _pair_ok(p.left_keys[0], p.right_keys[0]))
+                          or multi_ok))
     elif isinstance(p, (PhysicalSort, PhysicalTopN)):
         p.use_tpu = big and all(_key_ok(e) for e, _ in p.by)
     elif isinstance(p, PhysicalProjection):

@@ -252,12 +252,22 @@ def _unique_on(side: LogicalPlan, key_uids: Set[int], n_keys: int) -> bool:
         return (bool(gb) and all(isinstance(e, Column) for e in gb)
                 and {e.unique_id for e in gb} <= key_uids)
     if isinstance(side, LogicalDataSource):
-        pk = side.table_info.get_pk_handle_col()
-        if pk is None or n_keys != 1:
+        key_names = {sc.name.lower() for sc in side.schema.columns
+                     if sc.unique_id in key_uids}
+        if len(key_names) != n_keys:
             return False
-        sc = next((c for c in side.schema.columns if c.name == pk.name),
-                  None)
-        return sc is not None and sc.unique_id in key_uids
+        pk = side.table_info.get_pk_handle_col()
+        if pk is not None and pk.name.lower() in key_names:
+            return True
+        # a UNIQUE index whose columns are all join keys makes the key
+        # tuple unique among MATCHABLE rows (rows with a NULL key never
+        # equi-match, so nullable unique duplicates are irrelevant here)
+        for idx in side.table_info.public_indices():
+            if not idx.unique:
+                continue
+            if {c.name.lower() for c in idx.columns} <= key_names:
+                return True
+        return False
     if isinstance(side, (LogicalSelection, LogicalSort, LogicalTopN,
                          LogicalLimit)):
         return _unique_on(side.child(0), key_uids, n_keys)
