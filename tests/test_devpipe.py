@@ -454,12 +454,10 @@ def test_multikey_join_group_by_above(tk, counters):
                      "group by dimk.k1 order by dimk.k1")
 
 
-def test_multikey_join_nonunique_build_falls_back_correct(tk, counters):
+def test_multikey_join_nonunique_build_csr(tk, counters):
     _mk_fixture(tk)
-    # dup table: NO unique index covers (g1, g2) and the tuple repeats,
-    # so _unique_on cannot prove uniqueness — devpipe must DECLINE
-    # (no new joinmk program) and the CPU join with device children
-    # must still answer correctly, including the duplicate expansion
+    # dup table: NO unique index covers (g1, g2) and the tuple repeats —
+    # the composite CSR expansion must produce every duplicate match
     rng = np.random.default_rng(5)
     g1 = np.repeat(np.arange(1, 11, dtype=np.int64), 6)
     g2 = np.tile(np.arange(1, 4, dtype=np.int64), 20)  # (g1,g2) dup x2
@@ -468,9 +466,24 @@ def test_multikey_join_nonunique_build_falls_back_correct(tk, counters):
           {"id": (np.arange(1, 61, dtype=np.int64), None),
            "g1": (g1, None), "g2": (g2, None),
            "w": (rng.random(60) * 10, None)})
-    before = {k for k in devpipe.COMPILED_NODE_KEYS if k[0] == "joinmk"}
     assert_match(tk, "select factk.fid, dupd.w from factk join dupd "
                      "on factk.f1 = dupd.g1 and factk.f2 = dupd.g2 "
                      "order by factk.fid, dupd.w limit 40")
-    after = {k for k in devpipe.COMPILED_NODE_KEYS if k[0] == "joinmk"}
-    assert before == after, "non-unique multi-key build must not joinmk"
+    assert_match(tk, "select factk.fid, dupd.w from factk left join dupd "
+                     "on factk.f1 = dupd.g1 and factk.f2 = dupd.g2 "
+                     "order by factk.fid, dupd.w limit 60")
+    assert counters["join"] >= 1
+
+
+def test_multikey_join_other_conds_cpu_guard(tk, counters):
+    _mk_fixture(tk)
+    # a non-equi ON conjunct puts other_conditions on the join: devpipe
+    # declines ANY such join, and the per-op tier must route multi-key
+    # plans to the CPU hash join (never the single-key device kernel,
+    # which would silently join on the first key only)
+    assert_match(tk, "select factk.fid, dimk.v from factk join dimk "
+                     "on factk.f1 = dimk.k1 and factk.f2 = dimk.k2 "
+                     "and factk.x < dimk.v order by factk.fid limit 30")
+    # the per-test prepare counter (not the process-global key set, which
+    # earlier tests already populate) proves no devpipe join node ran
+    assert counters["join"] == 0, counters

@@ -281,6 +281,18 @@ def test_wire_fidelity_fuzz(server):
             for v in r) + ")" for r in rows))
     s.execute("insert into u values " + ", ".join(
         f"({k}, 'v{k % 6}')" for k in range(-2, 9)))
+    # the generator's multi-key arm needs the w/wd dims
+    s.execute("create table w (id int primary key, k1 int, k2 int, "
+              "x double, unique key uw (k1, k2))")
+    wrows = [(i * 10 + j, i, j, i + j / 10.0)
+             for i in range(-1, 6) for j in range(0, 4)]
+    s.execute("insert into w values " + ", ".join(
+        f"({a}, {b}, {c_}, {d})" for a, b, c_, d in wrows))
+    s.execute("create table wd (id int primary key, k1 int, k2 int, "
+              "x double)")
+    s.execute("insert into wd values " + ", ".join(
+        f"({n}, {r[1]}, {r[2]}, {r[3] + n})"
+        for n, r in enumerate(wrows + wrows[::2])))
     c = MiniClient(server.port, db="wf")
     gen = _Gen(rng)
 

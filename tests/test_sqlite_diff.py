@@ -113,6 +113,13 @@ class _Gen:
                      "t.c is not null"])
             return (f"select t.a, u.v from t {jt} u on {cond}{where} "
                     f"order by t.a, u.v")
+        if shape < 0.96:  # multi-key equi-join (composite device lanes)
+            dim = r.choice(["w", "w", "wd"])  # unique and duplicated
+            jt = r.choice(["join", "left join"])
+            sel = r.choice([f"t.a, {dim}.x", f"t.a, t.b, {dim}.id"])
+            return (f"select {sel} from t {jt} {dim} "
+                    f"on t.b = {dim}.k1 and t.a = {dim}.k2"
+                    f"{where} order by 1, 2")
         # aggregate over a join: FINAL merges (pushdown-rewritten),
         # raw mode (args from both sides), multi-key groups, outer joins
         cond = r.choice(["t.b = u.k", "t.a = u.k"])
@@ -165,6 +172,19 @@ def engines():
                 for v in r) + ")" for r in chunk))
     s.execute("insert into u values " + ", ".join(
         f"({k}, '{v}')" for k, v in urows))
+    # multi-key dim: (k1, k2) unique in w, DUPLICATED in wd
+    s.execute("create table w (id int primary key, k1 int, k2 int, "
+              "x double, unique key uw (k1, k2))")
+    wrows = [(i * 10 + j, i, j, i + j / 10.0)
+             for i in range(-1, 6) for j in range(0, 4)]
+    s.execute("insert into w values " + ", ".join(
+        f"({a}, {b}, {c}, {d})" for a, b, c, d in wrows))
+    s.execute("create table wd (id int primary key, k1 int, k2 int, "
+              "x double)")
+    wdrows = [(n, r[1], r[2], r[3] + n) for n, r in
+              enumerate(wrows + wrows[::2])]
+    s.execute("insert into wd values " + ", ".join(
+        f"({a}, {b}, {c}, {d})" for a, b, c, d in wdrows))
 
     lite = sqlite3.connect(":memory:")
     lite.execute("create table t (a integer primary key, b integer, "
@@ -172,6 +192,12 @@ def engines():
     lite.execute("create table u (k integer primary key, v text)")
     lite.executemany("insert into t values (?,?,?,?)", rows)
     lite.executemany("insert into u values (?,?)", urows)
+    lite.execute("create table w (id integer primary key, k1 integer, "
+                 "k2 integer, x real)")
+    lite.executemany("insert into w values (?,?,?,?)", wrows)
+    lite.execute("create table wd (id integer primary key, k1 integer, "
+                 "k2 integer, x real)")
+    lite.executemany("insert into wd values (?,?,?,?)", wdrows)
     return s, lite, rng
 
 

@@ -786,7 +786,9 @@ class _AggIndexNode:
 
 
 class _JoinNode:
-    """Equi-join on a single int key.  Two device layouts:
+    """Equi-join on int keys — a single key directly, or several keys
+    combined into one COMPOSITE lane (sum((k_i - lo_i) * stride_i), a
+    bijection over the bounded cross range).  Device layouts:
 
     - unique build (planner-proven pk/unique, or a group-index partial
       agg): dense key -> row position table + one gather per build
@@ -837,14 +839,13 @@ class _JoinNode:
         lk, rk = plan.left_keys[0], plan.right_keys[0]
         mult = False
         if nk > 1:
-            # multi-key: composite lane over a dense range — unique
-            # build over the key SET, leaf/sel build sides only (the
-            # non-unique composite CSR degrades to the CPU join)
-            if not getattr(plan, "right_unique", False):
-                return None
+            # multi-key: composite lane over a dense range, leaf/sel
+            # build sides only; non-unique key sets ride the same CSR
+            # expansion as single keys, over the composite lane
             build_side, probe_side = 1, 0
             build_keys = list(plan.right_keys)
             probe_keys = list(plan.left_keys)
+            mult = not getattr(plan, "right_unique", False)
         elif getattr(plan, "right_unique", False):
             build_side, probe_side = 1, 0
             build_keys, probe_keys = [rk], [lk]
@@ -883,10 +884,10 @@ class _JoinNode:
         ptv = self.probe.prepare(pb)
         if ptv is None:
             return None
-        if self.nk > 1:
-            return self._prepare_unique_multi(pb, btv, ptv)
         if self.mult:
             return self._prepare_mult(pb, btv, ptv)
+        if self.nk > 1:
+            return self._prepare_unique_multi(pb, btv, ptv)
         return self._prepare_unique(pb, btv, ptv)
 
     # ---- multi-key unique build: composite lane + dense table ----------
@@ -1236,12 +1237,26 @@ class _JoinNode:
         rep = leaf.replica()
         if rep is None:
             return None
-        sid = _slot_id(leaf.ex, self.build_key.index)
-        if sid == "handle":
-            kv, km = rep.handles, np.zeros(rep.n_rows, dtype=bool)
+        cspec = None
+        if self.nk > 1:
+            # multi-key CSR: group index over the composite lane
+            got = self._host_raw_key_cols(self.build, self.build_keys)
+            if got is None:
+                return None
+            rep, sids0, bcols_host = got
+            cspec = rep.memo(("composite_spec", sids0),
+                             lambda: _composite_spec(bcols_host))
+            if cspec is None:
+                return None
+            kv, km = cspec[3], cspec[4]
+            sids = ("comp",) + sids0
         else:
-            kv, km = rep.columns[sid]
-        sids = (sid,)
+            sid = _slot_id(leaf.ex, self.build_key.index)
+            if sid == "handle":
+                kv, km = rep.handles, np.zeros(rep.n_rows, dtype=bool)
+            else:
+                kv, km = rep.columns[sid]
+            sids = (sid,)
         gidx = _group_index(rep, sids, [(kv, km)])
 
         def mk():
@@ -1253,7 +1268,8 @@ class _JoinNode:
         lo, hi, tbl = got
         raw = gidx.raw_counts()
         outer = self.tp == "left"
-        ob = self._expand_bucket(raw, gidx, tbl, lo, hi, ptv, outer)
+        ob = self._expand_bucket(raw, gidx, tbl, lo, hi, ptv, outer,
+                                 cspec=cspec)
         if ob is None:
             return None
         jn = _jn()
@@ -1262,7 +1278,7 @@ class _JoinNode:
         ng = gidx.n_groups
         ngb = kernels.bucket(max(ng, 1))
         tbl_len = int(tbl.shape[0])
-        pk_slot = self.probe_key.index
+        pk_slots = tuple(k.index for k in self.probe_keys)
         io = pb.add(_dev_upload(rep, ("gi_order", sids, nbb),
                                 lambda: kernels.pad1(gidx.order, nbb)))
         ie = pb.add(_dev_upload(rep, ("gi_ends", sids, ngb),
@@ -1276,9 +1292,15 @@ class _JoinNode:
         pt.add_int(rep.n_rows)
         pt.add_int(lo)
         pt.add_int(hi)
+        if cspec is not None:
+            for klo, khi, kst in zip(cspec[0], cspec[1], cspec[2]):
+                pt.add_int(klo)
+                pt.add_int(khi)
+                pt.add_int(kst)
         ip, fp = pb.params(pt)
         probe_is_left = self.probe_is_left
-        pb.key(("joinm", nb, nbb, ngb, ob, tbl_len, pk_slot, outer,
+        nk = self.nk
+        pb.key(("joinm", nb, nbb, ngb, ob, tbl_len, pk_slots, outer,
                 probe_is_left, len(btv.meta), len(ptv.meta)))
 
         def emit(args):
@@ -1302,9 +1324,23 @@ class _JoinNode:
             # compacted sorted order: comp[j] = row of j-th valid entry
             vidx = jn.nonzero(vs, size=nbb, fill_value=0)[0]
             comp = order[vidx]
-            # probe -> group -> multiplicity
-            kp, knull = ppairs[pk_slot]
-            inr = (kp >= lo_p) & (kp <= hi_p) & ~knull & pvalid
+            # probe -> group -> multiplicity (multi-key probes compute
+            # the composite lane from per-key params)
+            if nk > 1:
+                ok = pvalid
+                kp = jn.zeros(nb, dtype=jn.int64)
+                for j, slot in enumerate(pk_slots):
+                    kvj, knj = ppairs[slot]
+                    klo = pr[0][4 + 3 * j]
+                    khi = pr[0][4 + 3 * j + 1]
+                    kst = pr[0][4 + 3 * j + 2]
+                    ok = ok & (kvj >= klo) & (kvj <= khi) & ~knj
+                    kp = kp + (kvj - klo) * kst
+                inr = ok & (kp >= lo_p) & (kp <= hi_p)
+                kp = jn.clip(kp, lo_p, hi_p)
+            else:
+                kp, knull = ppairs[pk_slots[0]]
+                inr = (kp >= lo_p) & (kp <= hi_p) & ~knull & pvalid
             pos0 = jn.clip(kp - lo_p, 0, tbl_len - 1)
             g = jn.where(inr, tbl_d[pos0].astype(jn.int64), -1)
             gsafe = jn.clip(g, 0, ngb - 1)
@@ -1339,30 +1375,45 @@ class _JoinNode:
             meta = btv.meta + ptv.meta
         return _TView(emit, ob, meta)
 
-    def _expand_bucket(self, raw, gidx, tbl, lo, hi, ptv, outer):
+    def _expand_bucket(self, raw, gidx, tbl, lo, hi, ptv, outer,
+                       cspec=None):
         """Static output bucket for the CSR expansion, from a host-side
         UPPER bound on match count (pre-filter group sizes; filters only
         shrink).  None = too large, fall off the device pipeline."""
         from .tpu_executors import _slot_id
         bound = None
-        pleaf = _leafish(self.probe)
-        if pleaf is not None:
-            prep = pleaf.replica()
-            if prep is not None:
-                psid = _slot_id(pleaf.ex, self.probe_key.index)
-                if psid == "handle":
-                    pkv = prep.handles
-                    pkm = np.zeros(prep.n_rows, dtype=bool)
-                else:
-                    pkv, pkm = prep.columns[psid]
-                inr = (~pkm) & (pkv >= lo) & (pkv <= hi)
-                gsafe = np.where(inr, pkv - lo, 0)
-                g = np.where(inr, tbl[gsafe], -1)
-                per = np.where(g >= 0, raw[np.clip(g, 0, max(len(raw) - 1,
-                                                             0))], 0)
-                if outer:
-                    per = np.maximum(per, 1)
-                bound = int(per.sum())
+        pkv = pkm = None
+        if cspec is not None:
+            got = self._host_raw_key_cols(self.probe, self.probe_keys)
+            if got is not None:
+                _, _, pcols = got
+                los, his, strides = cspec[0], cspec[1], cspec[2]
+                pkm = np.zeros(len(pcols[0][0]), dtype=bool)
+                pkv = np.zeros(len(pcols[0][0]), dtype=np.int64)
+                for (kvj, kmj), klo, khi, kst in zip(pcols, los, his,
+                                                     strides):
+                    pkm |= kmj | (kvj < klo) | (kvj > khi)
+                    pkv += (np.clip(kvj, klo, khi) - klo) * kst
+        else:
+            pleaf = _leafish(self.probe)
+            if pleaf is not None:
+                prep = pleaf.replica()
+                if prep is not None:
+                    psid = _slot_id(pleaf.ex, self.probe_key.index)
+                    if psid == "handle":
+                        pkv = prep.handles
+                        pkm = np.zeros(prep.n_rows, dtype=bool)
+                    else:
+                        pkv, pkm = prep.columns[psid]
+        if pkv is not None:
+            inr = (~pkm) & (pkv >= lo) & (pkv <= hi)
+            gsafe = np.where(inr, pkv - lo, 0)
+            g = np.where(inr, tbl[gsafe], -1)
+            per = np.where(g >= 0, raw[np.clip(g, 0, max(len(raw) - 1,
+                                                         0))], 0)
+            if outer:
+                per = np.maximum(per, 1)
+            bound = int(per.sum())
         if bound is None:
             mx = int(raw.max()) if len(raw) else 0
             bound = ptv.nb * max(mx, 1 if outer else 0)

@@ -8,7 +8,8 @@ device kernels:
 
 - HashAgg: agg args numeric (device segment-reduce); group keys numeric OR
   plain string Columns (order-preserving dictionary codes built host-side).
-- HashJoin: exactly one equi-key pair, numeric (sort+searchsorted kernel).
+- HashJoin: numeric equi-keys — one pair (sort+searchsorted kernel) or
+  several plain signed-int columns (devpipe composite lanes).
 - Sort/TopN: keys numeric or plain string Columns (dictionary codes).
 - Projection/Selection: every expression lowers through ops/exprjit.
 
