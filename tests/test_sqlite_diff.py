@@ -18,6 +18,9 @@ N_QUERIES = int(os.environ.get("TINYSQL_FUZZ_N", "120"))
 SEED = int(os.environ.get("TINYSQL_FUZZ_SEED", "1234"))
 N_ROWS = int(os.environ.get("TINYSQL_FUZZ_ROWS", "80"))
 MESH = os.environ.get("TINYSQL_FUZZ_MESH", "") == "1"
+# block-wise soak: cap the device upload block so the fuzz ALSO drives
+# the partial-state-carry aggregation path (tests/test_blockwise.py)
+BLOCK = int(os.environ.get("TINYSQL_FUZZ_BLOCK", "0"))
 
 COLS = [("a", "int"), ("b", "int"), ("c", "double"), ("d", "varchar(12)")]
 STRINGS = ["alpha", "beta", "Γδ", "x", "", "zz9", "Beta"]
@@ -159,6 +162,8 @@ def engines():
     s.execute("create database fuzz")
     s.execute("set @@tidb_tpu_min_rows = 0")
     s.execute("set @@tidb_devpipe = 1")
+    if BLOCK:
+        s.execute(f"set @@tidb_device_block_rows = {BLOCK}")
     s.execute("use fuzz")
     s.execute("create table t (a int primary key, b int, c double, "
               "d varchar(12), key ib (b))")
