@@ -487,3 +487,19 @@ def test_multikey_join_other_conds_cpu_guard(tk, counters):
     # the per-test prepare counter (not the process-global key set, which
     # earlier tests already populate) proves no devpipe join node ran
     assert counters["join"] == 0, counters
+
+
+def test_scalar_agg_above_join(tk, counters):
+    """Global aggregates above joins stay device-resident (one fused
+    program): FINAL merges from pushdown and raw both-sides args."""
+    _fixture_tables(tk)
+    assert_match(tk, "select count(*), sum(t.c), avg(t.c), min(u.w), "
+                     "max(t.b) from t join u on t.fk = u.k")
+    assert_match(tk, "select sum(t.c * u.w), count(t.c) from t join u "
+                     "on t.fk = u.k where t.b > 0")
+    assert_match(tk, "select count(*), sum(u.w) from t left join u "
+                     "on t.fk = u.k")
+    # zero-row input still yields the single scalar row
+    assert_match(tk, "select count(*), sum(t.c), min(t.b) from t join u "
+                     "on t.fk = u.k where t.b > 10000")
+    assert counters["join"] >= 1

@@ -430,10 +430,11 @@ class _HostLeaf:
 def _assemble_agg_specs(plan):
     """Shared descriptor lowering for the device aggregation nodes:
     returns (specs, slots) or None.  specs[k] = (kind, expr|None) with
-    kind in count_star/count/sum/min/max; slots[i] maps descriptor i to
-    ("one", k) or ("avg", k_sum, k_cnt) — avg decomposes into sum+count
-    with the quotient taken in-kernel (reference partial-state split,
-    aggregation/descriptor.go)."""
+    kind in count_star/count/sum/sum0/min/max — sum0 is a SUM of partial
+    COUNT states, 0 over empty input instead of NULL; slots[i] maps
+    descriptor i to ("one", k) or ("avg", k_sum, k_cnt) — avg decomposes
+    into sum+count with the quotient taken in-kernel (reference
+    partial-state split, aggregation/descriptor.go)."""
     from ..expression.aggregation import (AGG_AVG, AGG_MAX, AGG_MIN,
                                           AggMode)
     from ..expression.builtins import new_function
@@ -447,7 +448,9 @@ def _assemble_agg_specs(plan):
             # count -> SUM of partial counts; avg -> sum(sums)/sum(counts);
             # sum/min/max merge with themselves
             if d.name == AGG_COUNT and is_jittable(d.args[0]):
-                specs.append(("sum", d.args[0]))
+                # sum0: COUNT merged from partial states is 0 over empty
+                # input, never NULL (unlike SUM)
+                specs.append(("sum0", d.args[0]))
                 slots.append(("one", len(specs) - 1))
             elif d.name == AGG_AVG and len(d.args) == 2 \
                     and all(is_jittable(a) for a in d.args):
@@ -540,9 +543,10 @@ def _spec_results(jn, spec_kinds, arg_fns, pairs, pr, valid, gmask,
         cnt = seg_sum(live_s.astype(jn.int64))
         if kind == "count":
             res.append((cnt, jn.zeros(n_out, dtype=bool)))
-        elif kind == "sum":
+        elif kind in ("sum", "sum0"):
             res.append((seg_sum(jn.where(live_s, gvals(av), 0)),
-                        cnt == 0))
+                        jn.zeros(n_out, dtype=bool) if kind == "sum0"
+                        else cnt == 0))
         else:  # min / max
             fill = _mm_fill(jn, av.dtype, kind)
             res.append((seg_mm(jn.where(live_s, gvals(av), fill),
@@ -1567,6 +1571,87 @@ class _SortGroupNode:
         _close_node(self.child)
 
 
+class _ScalarAggNode:
+    """Global (no GROUP BY) aggregation over any device view — masked
+    reductions, one output row at slot 0 of a minimal bucket.  Keeps
+    scalar aggregates above joins device-resident (reference
+    aggregate.go:482 always-parallel Next, degenerate single group),
+    including FINAL partial-state merges from agg pushdown."""
+
+    def __init__(self, child, specs, slots, plan):
+        self.child = child
+        self.specs = specs
+        self.slots = slots
+        self.plan = plan
+
+    @staticmethod
+    def compile(plan: PhysicalHashAgg, ctx: _Ctx):
+        if plan.group_by:
+            return None
+        got = _assemble_agg_specs(plan)
+        if got is None:
+            return None
+        specs, slots = got
+        out_map = _agg_out_map(plan)
+        if out_map is None or any(m[0] != "agg" for m in out_map):
+            return None
+        child = _compile_node(plan.children[0], ctx)
+        if child is None:
+            return None
+        node = _ScalarAggNode(child, specs, slots, plan)
+        node.out_map = out_map
+        return node
+
+    def prepare(self, pb: _PipeBuilder) -> Optional[_TView]:
+        tv = self.child.prepare(pb)
+        if tv is None:
+            return None
+        jn = _jn()
+        ob = 16  # minimal bucket; the one result row sits at slot 0
+        pt = ParamTable()
+        arg_fns = []
+        keys = []
+        for kind, a in self.specs:
+            if a is None:
+                arg_fns.append(None)
+                keys.append(kind)
+            else:
+                arg_fns.append(compile_expr_params(a, pt))
+                keys.append(f"{kind}:{stable_shape_key(a)}")
+        ip, fp = pb.params(pt)
+        pb.key(("scalaragg", tuple(keys), tuple(self.slots),
+                tuple(self.out_map), tv.nb, len(tv.meta)))
+        spec_kinds = [k for k, _ in self.specs]
+        slots = self.slots
+        out_map = self.out_map
+        schema_cols = self.plan.schema.columns
+
+        def at0(x):
+            return jn.zeros(ob, dtype=x.dtype).at[0].set(x)
+
+        def emit(args):
+            valid, pairs = tv.emit(args)
+            pr = (args[ip], args[fp])
+            # the shared per-spec loop with degenerate reducers: one
+            # global segment, result at slot 0 (semantics live ONCE in
+            # _spec_results)
+            res = _spec_results(
+                jn, spec_kinds, arg_fns, pairs, pr, valid,
+                gmask=lambda b: b, gvals=lambda v: v,
+                seg_sum=lambda x_s: at0(jn.sum(x_s)),
+                seg_mm=lambda av_s, live_s, kind: at0(
+                    (jn.min if kind == "min" else jn.max)(av_s)),
+                presence=at0(jn.sum(valid.astype(jn.int64))), n_out=ob)
+            outs = _slot_outputs(jn, res, slots)
+            gvalid = jn.arange(ob) == 0  # exactly one result row
+            return gvalid, [outs[m[1]] for m in out_map]
+        meta = [(oc.ret_type, None) for oc in schema_cols]
+        return _TView(emit, ob, meta)
+
+    def close(self):
+        _close_node(self.child)
+
+
 def _leafish(node) -> Optional[_ReplicaLeaf]:
     """The underlying replica leaf of a leaf/selection chain (selection
     preserves the schema, so column offsets map straight through)."""
@@ -1906,6 +1991,8 @@ def _compile_device(plan, ctx: _Ctx):
     if isinstance(plan, PhysicalTableReader):
         return _ReplicaLeaf.compile(plan, ctx)
     if isinstance(plan, PhysicalHashAgg):
+        if not plan.group_by:
+            return _ScalarAggNode.compile(plan, ctx)
         node = _AggIndexNode.compile(plan, ctx)
         if node is None:
             node = _SortGroupNode.compile(plan, ctx)

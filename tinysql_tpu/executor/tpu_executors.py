@@ -390,7 +390,8 @@ class TPUHashAggExec(Executor):
                 a = d.args[0]
                 if not is_jittable(a):
                     return None
-                specs.append(("sum", True))
+                # sum0: merged COUNT is 0 over empty input, never NULL
+                specs.append(("sum0", True))
                 arg_exprs.append(a)
                 slots.append(("dev", len(specs) - 1))
             elif d.mode is AggMode.FINAL and d.name == AGG_AVG:
@@ -652,7 +653,7 @@ class TPUHashAggExec(Executor):
         def ensure_acc(i, kind, dtype):
             if acc[i] is not None:
                 return acc[i]
-            if kind in ("count_star", "count", "sum"):
+            if kind in ("count_star", "count", "sum", "sum0"):
                 av = np.zeros(ns, dtype=dtype)
             elif kind == "min":
                 av = np.full(ns, np.inf if dtype == np.float64
@@ -712,7 +713,7 @@ class TPUHashAggExec(Executor):
                 av, am = ensure_acc(i, kind, v_.dtype)
                 ids = np.asarray(present)[live]
                 vv = v_[live]
-                if kind in ("count_star", "count", "sum"):
+                if kind in ("count_star", "count", "sum", "sum0"):
                     av[ids] += vv
                 elif kind == "min":
                     av[ids] = np.minimum(av[ids], vv)
@@ -733,11 +734,11 @@ class TPUHashAggExec(Executor):
                 dt = np.int64 if kind != "sum" else np.float64
                 av = np.zeros(ns, dtype=dt)
                 am = np.ones(ns, dtype=bool)
-                if kind in ("count_star", "count"):
+                if kind in ("count_star", "count", "sum0"):
                     am = np.zeros(ns, dtype=bool)  # COUNT of nothing = 0
                 acc[i] = (av, am)
             av, am = acc[i]
-            if kind in ("count_star", "count"):
+            if kind in ("count_star", "count", "sum0"):
                 am = np.zeros_like(am)  # counts are never NULL
             out_aggs.append((av[present_ids], am[present_ids]))
         out_keys = self._decode_present(present_ids, key_layouts) \
@@ -868,7 +869,7 @@ class TPUHashAggExec(Executor):
             # count partials SUM; avg partials are a (sum, count) column
             # pair; sum/min/max/first_row merge with their own op
             if d.mode is AggMode.FINAL and d.name == AGG_COUNT:
-                specs.append(("sum", True))
+                specs.append(("sum0", True))  # merged COUNT: 0, not NULL
                 add_arg(d.args[0])
                 slots.append(("dev", len(specs) - 1))
             elif d.mode is AggMode.FINAL and d.name == AGG_AVG:

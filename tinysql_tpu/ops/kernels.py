@@ -473,11 +473,12 @@ def _group_agg_kernel(n_keys: int, specs: tuple):
                 live = valid_s & ~an
                 outs.append((seg(live.astype(jn.int64)),
                              jn.zeros(n, dtype=bool)))
-            elif func in ("sum", "sum_int"):
+            elif func in ("sum", "sum_int", "sum0"):
                 live = valid_s & ~an
                 total = seg(jn.where(live, av, 0))
                 cnt = seg(live.astype(jn.int64))
-                outs.append((total, cnt == 0))
+                outs.append((total, jn.zeros_like(cnt, dtype=bool)
+                             if func == "sum0" else cnt == 0))
             elif func in ("min", "max"):
                 live = valid_s & ~an
                 if func == "min":
@@ -583,8 +584,10 @@ def _segment_agg_kernel(specs: tuple, n_segments: int):
             cnt = seg.sum(live.astype(jn.int64), live)
             if func == "count":
                 outs.append((cnt, jn.zeros(n_segments, dtype=bool)))
-            elif func in ("sum", "sum_int"):
-                outs.append((seg.sum(av, live), cnt == 0))
+            elif func in ("sum", "sum_int", "sum0"):
+                outs.append((seg.sum(av, live),
+                             jn.zeros_like(cnt, dtype=bool)
+                             if func == "sum0" else cnt == 0))
             elif func in ("min", "max"):
                 outs.append((seg.minmax(av, live, func == "min"), cnt == 0))
             else:  # pragma: no cover
@@ -769,9 +772,12 @@ def _fused_agg_outs(j, jn, agg_specs, arg_fns, cols, gid, valid,
         cnt = merge_sum(seg.sum(live.astype(jn.int64), live))
         if func == "count":
             outs.append((cnt, jn.zeros(ns, dtype=bool)))
-        elif func == "sum":
+        elif func in ("sum", "sum0"):
+            # sum0: a COUNT merged from partial states — 0 over empty
+            # input, never NULL (unlike SUM)
             total = merge_sum(seg.sum(av, live))
-            outs.append((total, cnt == 0))
+            outs.append((total, jn.zeros(ns, dtype=bool)
+                         if func == "sum0" else cnt == 0))
         elif func in ("min", "max"):
             local = seg.minmax(av, live, func == "min")
             merged = merge_min(local) if func == "min" else merge_max(local)
@@ -896,10 +902,11 @@ def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
                 if func == "count":
                     outs.append((jn.sum(live.astype(jn.int64))[None],
                                  jn.zeros(1, dtype=bool)))
-                elif func == "sum":
+                elif func in ("sum", "sum0"):
                     total = jn.sum(jn.where(live, av, 0))[None]
                     cnt = jn.sum(live.astype(jn.int64))
-                    outs.append((total, (cnt == 0)[None]))
+                    outs.append((total, jn.zeros(1, dtype=bool)
+                                 if func == "sum0" else (cnt == 0)[None]))
                 elif func in ("min", "max"):
                     if av.dtype == jn.int64:
                         fill = (jn.iinfo(jn.int64).max if func == "min"
@@ -1042,11 +1049,12 @@ def _scalar_agg_kernel(specs: tuple):
                 live = valid & ~an
                 outs.append((jn.sum(live.astype(jn.int64))[None],
                              jn.zeros(1, dtype=bool)))
-            elif func in ("sum", "sum_int"):
+            elif func in ("sum", "sum_int", "sum0"):
                 live = valid & ~an
                 total = jn.sum(jn.where(live, av, 0))[None]
                 cnt = jn.sum(live.astype(jn.int64))
-                outs.append((total, (cnt == 0)[None]))
+                outs.append((total, jn.zeros(1, dtype=bool)
+                             if func == "sum0" else (cnt == 0)[None]))
             elif func in ("min", "max"):
                 live = valid & ~an
                 if av.dtype == jn.int64:
