@@ -168,3 +168,72 @@ def test_shuffle_vs_broadcast_cost_gate(join_tk):
     assert _canon(sharded) == _canon(single)
     assert before == after, "small build side must broadcast, not shuffle"
     join_tk.execute("set @@tidb_mesh_parallel = 0")
+
+
+def test_mesh_csr_nonunique_join(join_tk):
+    """Non-unique (duplicate-key) joins shard the probe side over the
+    mesh with the CSR structures broadcast; per-shard expansion buckets
+    come from host-exact per-shard bounds."""
+    import numpy as np
+    from tinysql_tpu.columnar.store import bulk_load
+    from tinysql_tpu.executor import devpipe
+    rng = np.random.default_rng(23)
+    join_tk.execute("create table dup (id bigint primary key, k bigint, "
+                    "w double)")
+    info = join_tk.infoschema().table_by_name("jm", "dup")
+    bulk_load(join_tk.storage, info,
+              {"id": np.arange(1, 161, dtype=np.int64),
+               "k": np.tile(np.arange(1, 41, dtype=np.int64), 4),
+               "w": rng.random(160) * 5})
+    qs = ["select big.a, dup.w from big join dup on big.fk = dup.k "
+          "where big.x < 5 order by big.a, dup.w limit 50",
+          "select big.a, dup.w from big left join dup on big.fk = dup.k "
+          "order by big.a, dup.w limit 50",
+          "select dup.k, count(*), sum(big.x) from big join dup "
+          "on big.fk = dup.k group by dup.k order by dup.k"]
+    for q in qs:
+        join_tk.execute("set @@tidb_mesh_parallel = 0")
+        single = join_tk.query(q).rows
+        join_tk.execute("set @@tidb_mesh_parallel = 1")
+        sharded = join_tk.query(q).rows
+        assert _canon(sharded) == _canon(single), q
+    join_tk.execute("set @@tidb_mesh_parallel = 0")
+    assert any(k[0] == "joinm" and k[-1] > 1
+               for k in devpipe.COMPILED_NODE_KEYS), \
+        "sharded CSR join never compiled"
+
+
+def test_mesh_csr_skew_retries_unsharded(join_tk, monkeypatch):
+    """A probe whose matches cluster in one shard can blow the per-shard
+    expansion bound while the GLOBAL bound still fits: the join must
+    retry unsharded on the device, not fall off the pipeline."""
+    import numpy as np
+    from tinysql_tpu.columnar.store import bulk_load
+    from tinysql_tpu.executor import devpipe
+    rng = np.random.default_rng(29)
+    join_tk.execute("create table sk (id bigint primary key, k bigint, "
+                    "w double)")
+    info = join_tk.infoschema().table_by_name("jm", "sk")
+    # key 1 has 3 duplicates; keys 2..40 have none
+    bulk_load(join_tk.storage, info,
+              {"id": np.arange(1, 4, dtype=np.int64),
+               "k": np.ones(3, dtype=np.int64),
+               "w": rng.random(3)})
+    join_tk.execute("create table pr (a bigint primary key, fk bigint)")
+    info = join_tk.infoschema().table_by_name("jm", "pr")
+    fk = np.full(1024, 999, dtype=np.int64)   # matches nothing...
+    fk[:128] = 1                              # ...except the first shard
+    bulk_load(join_tk.storage, info,
+              {"a": np.arange(1, 1025, dtype=np.int64), "fk": fk})
+    # per-shard bound = 128*3=384 -> bucket 512; 512*8 > 2048 = MAX_EXPAND
+    # but the global bound (bucket 512) fits
+    monkeypatch.setattr(devpipe, "MAX_EXPAND", 2048)
+    q = ("select pr.a, sk.w from pr join sk on pr.fk = sk.k "
+         "order by pr.a, sk.w")
+    join_tk.execute("set @@tidb_mesh_parallel = 0")
+    single = join_tk.query(q).rows
+    join_tk.execute("set @@tidb_mesh_parallel = 1")
+    sharded = join_tk.query(q).rows
+    join_tk.execute("set @@tidb_mesh_parallel = 0")
+    assert _canon(sharded) == _canon(single)
+    assert len(single) == 128 * 3

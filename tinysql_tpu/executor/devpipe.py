@@ -1121,11 +1121,8 @@ class _JoinNode:
             bcols = [(bv2[brow], bn2[brow] | ~matched) for bv2, bn2 in B_]
             return valid_out, P_, bcols
 
-        from jax.sharding import PartitionSpec as P
-        try:
-            from jax import shard_map
-        except ImportError:  # older jax
-            from jax.experimental.shard_map import shard_map
+        from ..parallel.dist import shard_map_fn
+        shard_map, P = shard_map_fn()
         pspec = [(P("shard"), P("shard"))] * npc
         bspec = [(P("shard"), P("shard"))] * nbc
         sharded = shard_map(
@@ -1202,11 +1199,8 @@ class _JoinNode:
             return valid_out, gathered
 
         if mesh is not None:
-            from jax.sharding import PartitionSpec as P
-            try:
-                from jax import shard_map
-            except ImportError:  # older jax
-                from jax.experimental.shard_map import shard_map
+            from ..parallel.dist import shard_map_fn
+            shard_map, P = shard_map_fn()
             pspec = [(P("shard"), P("shard"))] * len(ptv.meta)
             bspec = [(P(), P())] * len(btv.meta)
             sharded = shard_map(
@@ -1272,8 +1266,24 @@ class _JoinNode:
         lo, hi, tbl = got
         raw = gidx.raw_counts()
         outer = self.tp == "left"
-        ob = self._expand_bucket(raw, gidx, tbl, lo, hi, ptv, outer,
-                                 cspec=cspec)
+        # mesh: shard the PROBE side, broadcast the CSR structures; the
+        # per-shard expansion bucket needs host-exact per-shard bounds
+        from ..parallel import dist
+        mesh = self.mesh if dist.shardable(ptv.nb, self.mesh) else None
+        n_mesh = int(mesh.devices.size) if mesh is not None else 0
+        per_probe = self._per_probe_counts(raw, tbl, lo, hi, ptv, outer,
+                                           cspec=cspec)
+        if mesh is not None and per_probe is None:
+            mesh = None  # no host probe keys: per-shard bound unknowable
+            n_mesh = 0
+        ob = self._expand_bucket(raw, ptv, outer, per_probe,
+                                 shards=max(n_mesh, 1))
+        if ob is None and mesh is not None:
+            # probe skew blew the per-shard bound: retry unsharded
+            # before abandoning the device pipeline
+            mesh = None
+            n_mesh = 0
+            ob = self._expand_bucket(raw, ptv, outer, per_probe)
         if ob is None:
             return None
         jn = _jn()
@@ -1304,15 +1314,15 @@ class _JoinNode:
         ip, fp = pb.params(pt)
         probe_is_left = self.probe_is_left
         nk = self.nk
+        npc, nbc = len(ptv.meta), len(btv.meta)
+        nb_loc = nb // n_mesh if n_mesh else nb
         pb.key(("joinm", nb, nbb, ngb, ob, tbl_len, pk_slots, outer,
-                probe_is_left, len(btv.meta), len(ptv.meta)))
+                probe_is_left, nbc, npc, n_mesh))
 
-        def emit(args):
+        def kernel(ppairs, pvalid, bpairs, bvalid, order, ends, tbl_d,
+                   pr):
             from jax import lax
-            bvalid, bpairs = btv.emit(args)
-            pvalid, ppairs = ptv.emit(args)
-            order, ends, tbl_d = args[io], args[ie], args[it]
-            pr = (args[ip], args[fp])
+            nb = nb_loc  # per-shard probe rows (== global when no mesh)
             ng_p, nrows_p, lo_p, hi_p = (pr[0][0], pr[0][1], pr[0][2],
                                          pr[0][3])
             # per-group VALID counts from one cumsum over sorted validity
@@ -1370,22 +1380,46 @@ class _JoinNode:
             brow = comp[jn.clip(start_c[gjs] + k, 0, nbb - 1)]
             pcols = [(pv[ps], pn[ps]) for pv, pn in ppairs]
             bcols = [(bv[brow], bn[brow] | ~matched) for bv, bn in bpairs]
+            return valid_out, pcols, bcols
+
+        if mesh is not None:
+            # probe side sharded over the mesh, CSR structures broadcast
+            # (each shard expands its own probe block into its own
+            # per-shard bucket — SURVEY §2.11 P4)
+            from ..parallel.dist import shard_map_fn
+            shard_map, P = shard_map_fn()
+            sharded = shard_map(
+                kernel, mesh=mesh,
+                in_specs=([(P("shard"), P("shard"))] * npc, P("shard"),
+                          [(P(), P())] * nbc, P(), P(), P(), P(),
+                          (P(), P())),
+                out_specs=(P("shard"),
+                           [(P("shard"), P("shard"))] * npc,
+                           [(P("shard"), P("shard"))] * nbc))
+        else:
+            sharded = kernel
+
+        def emit(args):
+            bvalid, bpairs = btv.emit(args)
+            pvalid, ppairs = ptv.emit(args)
+            valid_out, pcols, bcols = sharded(
+                ppairs, pvalid, bpairs, bvalid, args[io], args[ie],
+                args[it], (args[ip], args[fp]))
             if probe_is_left:
-                return valid_out, pcols + bcols
-            return valid_out, bcols + pcols
+                return valid_out, list(pcols) + list(bcols)
+            return valid_out, list(bcols) + list(pcols)
         if probe_is_left:
             meta = ptv.meta + btv.meta
         else:
             meta = btv.meta + ptv.meta
-        return _TView(emit, ob, meta)
+        return _TView(emit, ob * max(n_mesh, 1), meta)
 
-    def _expand_bucket(self, raw, gidx, tbl, lo, hi, ptv, outer,
-                       cspec=None):
-        """Static output bucket for the CSR expansion, from a host-side
-        UPPER bound on match count (pre-filter group sizes; filters only
-        shrink).  None = too large, fall off the device pipeline."""
+    def _per_probe_counts(self, raw, tbl, lo, hi, ptv, outer, cspec=None):
+        """Host per-probe-row match-count UPPER bounds (pre-filter group
+        sizes; filters only shrink), padded to the probe bucket — feeds
+        both the global and the per-shard expansion bounds.  None when
+        the probe side has no host-visible keys."""
         from .tpu_executors import _slot_id
-        bound = None
         pkv = pkm = None
         if cspec is not None:
             got = self._host_raw_key_cols(self.probe, self.probe_keys)
@@ -1409,19 +1443,29 @@ class _JoinNode:
                         pkm = np.zeros(prep.n_rows, dtype=bool)
                     else:
                         pkv, pkm = prep.columns[psid]
-        if pkv is not None:
-            inr = (~pkm) & (pkv >= lo) & (pkv <= hi)
-            gsafe = np.where(inr, pkv - lo, 0)
-            g = np.where(inr, tbl[gsafe], -1)
-            per = np.where(g >= 0, raw[np.clip(g, 0, max(len(raw) - 1,
-                                                         0))], 0)
-            if outer:
-                per = np.maximum(per, 1)
-            bound = int(per.sum())
-        if bound is None:
+        if pkv is None:
+            return None
+        inr = (~pkm) & (pkv >= lo) & (pkv <= hi)
+        gsafe = np.where(inr, pkv - lo, 0)
+        g = np.where(inr, tbl[gsafe], -1)
+        per = np.where(g >= 0, raw[np.clip(g, 0, max(len(raw) - 1, 0))],
+                       0)
+        if outer:
+            per = np.maximum(per, 1)
+        return kernels.pad1(per.astype(np.int64), ptv.nb)
+
+    def _expand_bucket(self, raw, ptv, outer, per_probe, shards: int = 1):
+        """Static (per-shard) output bucket for the CSR expansion.  None
+        = too large, fall off the device pipeline."""
+        if per_probe is None:
             mx = int(raw.max()) if len(raw) else 0
             bound = ptv.nb * max(mx, 1 if outer else 0)
-        if bound > MAX_EXPAND:
+        elif shards > 1:
+            blk = ptv.nb // shards
+            bound = int(per_probe.reshape(shards, blk).sum(axis=1).max())
+        else:
+            bound = int(per_probe.sum())
+        if bound * shards > MAX_EXPAND:
             return None
         return kernels.bucket(max(bound, 1))
 

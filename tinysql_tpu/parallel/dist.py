@@ -19,6 +19,17 @@ import numpy as np
 from ..ops import kernels
 
 
+def shard_map_fn():
+    """(shard_map, PartitionSpec) with the jax-version fallback in ONE
+    place — every mesh kernel imports through here."""
+    from jax.sharding import PartitionSpec
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    return shard_map, PartitionSpec
+
+
 def make_mesh(n_devices: Optional[int] = None):
     """1-D device mesh over axis 'shard' (DP/region axis)."""
     jax = kernels.jax()
