@@ -20,7 +20,8 @@ from tinysql_tpu.server.server import Server
 class MiniClient:
     """Just enough of the client side of the protocol for tests."""
 
-    def __init__(self, port, db="", user="root", password=""):
+    def __init__(self, port, db="", user="root", password="",
+                 ssl_ctx=None):
         self.sock = socket.create_connection(("127.0.0.1", port), timeout=10)
         self.io = PacketIO(self.sock)
         greeting = self.io.read_packet()
@@ -32,10 +33,25 @@ class MiniClient:
         salt = bytes(greeting[p1:p1 + 8])
         p2 = p1 + 8 + 1 + 2 + 1 + 2 + 2 + 1 + 10
         salt += bytes(greeting[p2:p2 + 12])
+        caps_lo = struct.unpack_from("<H", greeting, p1 + 8 + 1)[0]
+        self.server_caps = caps_lo  # low 16 bits incl. CLIENT_SSL (1<<11)
         from tinysql_tpu.server.auth import scramble
         token = scramble(password, salt)
         caps = 0x0200 | 0x8000 | (0x00008 if db else 0)
-        payload = struct.pack("<IIB", caps, 1 << 24, 0x21) + b"\x00" * 23
+        if ssl_ctx is not None:
+            assert self.server_caps & 0x0800, "server did not offer SSL"
+            caps |= 0x0800  # CLIENT_SSL
+        prefix = struct.pack("<IIB", caps, 1 << 24, 0x21) + b"\x00" * 23
+        if ssl_ctx is not None:
+            # SSLRequest = exactly the 32-byte response prefix, then the
+            # full response repeats the SAME prefix over TLS
+            self.io.write_packet(prefix)
+            seq = self.io.sequence
+            self.sock = ssl_ctx.wrap_socket(self.sock,
+                                            server_hostname="localhost")
+            self.io = PacketIO(self.sock)
+            self.io.sequence = seq
+        payload = prefix
         payload += user.encode() + b"\x00"
         payload += bytes([len(token)]) + token
         if db:
@@ -518,3 +534,84 @@ def test_split_placeholders_comments_and_quotes():
     assert len(sp("select /* ? */ id from t where id = ?")) == 2
     assert len(sp("select '?' , `a?b`, \"?\" from t where x = ?")) == 2
     assert len(sp("select 1 # c?\n from t where a = ? and b = ?")) == 3
+
+
+# ---- TLS upgrade (reference: server/conn.go:448-455, upgradeToTLS :1070) --
+
+@pytest.fixture(scope="module")
+def tls_server(tmp_path_factory):
+    """Server with a self-signed cert: advertises CLIENT_SSL and accepts
+    the mid-handshake SSLRequest upgrade."""
+    import datetime
+    import ipaddress
+    pytest.importorskip("cryptography")
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    d = tmp_path_factory.mktemp("tls")
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName("localhost"),
+                 x509.IPAddress(ipaddress.ip_address("127.0.0.1"))]),
+                critical=False)
+            .sign(key, hashes.SHA256()))
+    cert_path = d / "server.crt"
+    key_path = d / "server.key"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()))
+
+    storage = new_mock_storage()
+    srv = Server(storage, port=0, ssl_cert=str(cert_path),
+                 ssl_key=str(key_path))
+    srv.start()
+    yield srv
+    srv.close()
+
+
+def _client_ssl_ctx():
+    import ssl
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE  # self-signed test cert
+    return ctx
+
+
+def test_tls_upgrade_end_to_end(tls_server):
+    c = MiniClient(tls_server.port, ssl_ctx=_client_ssl_ctx())
+    import ssl
+    assert isinstance(c.sock, ssl.SSLSocket)  # actually upgraded
+    c.query("create database if not exists tlsdb")
+    c.query("use tlsdb")
+    c.query("drop table if exists t")
+    c.query("create table t (id bigint primary key, v bigint)")
+    assert c.query("insert into t values (1, 10), (2, 20)") == 2
+    cols, rows = c.query("select id, v from t order by id")
+    assert cols == ["id", "v"] and rows == [["1", "10"], ["2", "20"]]
+    c.close()
+
+
+def test_tls_server_still_accepts_plaintext(tls_server):
+    # a client that ignores CLIENT_SSL keeps working on the same listener
+    c = MiniClient(tls_server.port)
+    assert c.server_caps & 0x0800  # offered...
+    cols, rows = c.query("select 1 + 1")
+    assert rows == [["2"]]  # ...but not required
+    c.close()
+
+
+def test_plain_server_does_not_offer_ssl(server):
+    c = MiniClient(server.port)
+    assert not (c.server_caps & 0x0800)
+    c.close()

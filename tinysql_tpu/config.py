@@ -27,6 +27,15 @@ class Status:
 
 
 @dataclass
+class Security:
+    # reference: config/config.go Security section (ssl-cert/ssl-key);
+    # both set => the server advertises CLIENT_SSL and accepts the
+    # mid-handshake upgrade (server/conn.go:448-455,1070)
+    ssl_cert: str = ""
+    ssl_key: str = ""
+
+
+@dataclass
 class Config:
     host: str = "127.0.0.1"
     port: int = 4000
@@ -37,6 +46,7 @@ class Config:
     use_tpu: bool = True
     log: Log = field(default_factory=Log)
     status: Status = field(default_factory=Status)
+    security: Security = field(default_factory=Security)
 
 
 def _apply(obj, data: dict, prefix: str = "") -> None:

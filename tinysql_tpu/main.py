@@ -90,7 +90,9 @@ def main(argv=None) -> int:
     log = logging.getLogger("tinysql_tpu")
     storage = new_mock_storage(num_stores=cfg.num_stores)
     bootstrap(storage)
-    server = Server(storage, cfg.host, cfg.port)
+    server = Server(storage, cfg.host, cfg.port,
+                    ssl_cert=cfg.security.ssl_cert,
+                    ssl_key=cfg.security.ssl_key)
     port = server.start()
     status = None
     if cfg.status.report_status:

@@ -32,6 +32,9 @@ def _make_handler(server_ref):
                 body = json.dumps({
                     "version": SERVER_VERSION,
                     "connections": len(srv.conns) if srv else 0,
+                    "tls_connections": sum(
+                        1 for c in srv.conns.values()
+                        if getattr(c, "tls", False)) if srv else 0,
                 }).encode()
                 self._send(200, body)
             elif self.path == "/debug/threads":

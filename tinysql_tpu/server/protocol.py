@@ -20,6 +20,7 @@ CLIENT_FOUND_ROWS = 1 << 1
 CLIENT_LONG_FLAG = 1 << 2
 CLIENT_CONNECT_WITH_DB = 1 << 3
 CLIENT_PROTOCOL_41 = 1 << 9
+CLIENT_SSL = 1 << 11
 CLIENT_TRANSACTIONS = 1 << 13
 CLIENT_SECURE_CONNECTION = 1 << 15
 CLIENT_MULTI_STATEMENTS = 1 << 16
@@ -51,16 +52,17 @@ COM_STMT_EXECUTE = 0x17
 COM_STMT_CLOSE = 0x19
 
 
-def handshake_v10(conn_id: int, salt: bytes) -> bytes:
+def handshake_v10(conn_id: int, salt: bytes,
+                  caps: int = SERVER_CAPS) -> bytes:
     out = bytearray()
     out.append(10)  # protocol version
     out += SERVER_VERSION.encode() + b"\x00"
     out += struct.pack("<I", conn_id)
     out += salt[:8] + b"\x00"
-    out += struct.pack("<H", SERVER_CAPS & 0xFFFF)
+    out += struct.pack("<H", caps & 0xFFFF)
     out.append(0x21)  # charset utf8
     out += struct.pack("<H", SERVER_STATUS_AUTOCOMMIT)
-    out += struct.pack("<H", (SERVER_CAPS >> 16) & 0xFFFF)
+    out += struct.pack("<H", (caps >> 16) & 0xFFFF)
     out.append(21)  # auth plugin data len
     out += b"\x00" * 10
     out += salt[8:20] + b"\x00"

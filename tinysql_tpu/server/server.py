@@ -26,6 +26,7 @@ class ClientConn:
         self.sock = conn
         self.conn_id = conn_id
         self.io = PacketIO(conn)
+        self.tls = False
         self.session = Session(server.storage, domain=server.domain)
         self.alive = True
         # prepared statements: id -> [sql_parts, types] (binary protocol)
@@ -38,11 +39,31 @@ class ClientConn:
         import struct
         from . import auth
         salt = p.new_salt()
-        self.io.write_packet(p.handshake_v10(self.conn_id, salt))
+        caps = p.SERVER_CAPS
+        if self.server.ssl_ctx is not None:
+            caps |= p.CLIENT_SSL
+        self.io.write_packet(p.handshake_v10(self.conn_id, salt, caps))
         try:
-            resp = p.parse_handshake_response(self.io.read_packet())
-        except (ConnectionError, IndexError, ValueError, struct.error):
-            return False  # not a MySQL client; close quietly
+            payload = self.io.read_packet()
+            # SSLRequest (reference: conn.go:448-455 readOptionalSSLRequest
+            # + upgradeToTLS :1070): the protocol-41 SSLRequest is the
+            # 32-byte response prefix (caps, max-packet, charset, filler)
+            # with CLIENT_SSL set and NO username — the client then
+            # renegotiates over TLS and sends the full response.
+            if (self.server.ssl_ctx is not None and len(payload) <= 32
+                    and struct.unpack_from("<I", payload, 0)[0]
+                    & p.CLIENT_SSL):
+                seq = self.io.sequence
+                self.sock = self.server.ssl_ctx.wrap_socket(
+                    self.sock, server_side=True)
+                self.io = PacketIO(self.sock)
+                self.io.sequence = seq
+                self.tls = True
+                payload = self.io.read_packet()
+            resp = p.parse_handshake_response(payload)
+        except (ConnectionError, IndexError, ValueError, struct.error,
+                OSError):
+            return False  # not a MySQL client (or bad TLS); close quietly
         try:
             stored = auth.lookup_auth_string(self.server.storage,
                                              resp["user"])
@@ -283,8 +304,18 @@ class ClientConn:
 
 class Server:
     def __init__(self, storage, host: str = "127.0.0.1", port: int = 4000,
-                 lease_s: float = 0.05):
+                 lease_s: float = 0.05, ssl_cert: str = "",
+                 ssl_key: str = ""):
         self.storage = storage
+        # mid-handshake TLS upgrade (reference: server/conn.go:448-455,
+        # upgradeToTLS :1070) — advertised via CLIENT_SSL only when a
+        # cert/key pair is configured
+        self.ssl_ctx = None
+        if ssl_cert and ssl_key:
+            import ssl as _ssl
+            ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(ssl_cert, ssl_key)
+            self.ssl_ctx = ctx
         # one schema-cache domain PER SERVER (reference: domain singleton
         # per tidb-server process) with a background reload ticker so the
         # DDL syncer barrier sees this server catch up

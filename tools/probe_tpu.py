@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Bounded axon-tunnel liveness probe with a committed cadence log.
+
+VERDICT r4 next-1: every device-tier claim needs real-chip evidence, and if
+the tunnel never comes up the round must prove it *tried* — a probe-cadence
+log.  Each invocation appends ONE JSON line to PROBE_r05.jsonl:
+
+    {"ts": <iso8601>, "status": "live"|"timeout"|"error", "platform": ...,
+     "device_kind": ..., "elapsed_s": N}
+
+The probe runs `jax.devices()` in a SUBPROCESS with a hard timeout because a
+dead tunnel HANGS the call (it never errors) — learned in round 4.  Exit code:
+0 = live TPU, 1 = dead/cpu-only.  Run with --quiet for cron use.
+"""
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                   "PROBE_r05.jsonl")
+
+PROBE_SNIPPET = (
+    "import jax, json; d = jax.devices()[0]; "
+    "print(json.dumps({'platform': d.platform, "
+    "'device_kind': getattr(d, 'device_kind', '')}))"
+)
+
+
+def probe(timeout: float = 90.0) -> dict:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the sitecustomize axon pin apply
+    t0 = datetime.datetime.now(datetime.timezone.utc)
+    rec = {"ts": t0.isoformat(timespec="seconds")}
+    try:
+        p = subprocess.run([sys.executable, "-c", PROBE_SNIPPET],
+                           capture_output=True, text=True, timeout=timeout,
+                           env=env)
+        el = (datetime.datetime.now(datetime.timezone.utc) - t0).total_seconds()
+        rec["elapsed_s"] = round(el, 1)
+        if p.returncode == 0:
+            info = json.loads(p.stdout.strip().splitlines()[-1])
+            rec.update(info)
+            rec["status"] = ("live" if info.get("platform") not in
+                             ("cpu", None) else "cpu_only")
+        else:
+            rec["status"] = "error"
+            rec["stderr"] = p.stderr.strip()[-300:]
+    except subprocess.TimeoutExpired:
+        rec["elapsed_s"] = timeout
+        rec["status"] = "timeout"
+    return rec
+
+
+def main():
+    rec = probe(float(os.environ.get("TPU_PROBE_TIMEOUT", "90")))
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    if "--quiet" not in sys.argv:
+        print(json.dumps(rec))
+    sys.exit(0 if rec["status"] == "live" else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Scratch profiler for TPC-H Q3 on the per-op device tier (jax_cpu host).
+
+Loads SF (env TPCH_SF, default 1) once, warms, then reports per-run wall and
+a cProfile of the best-run path.  Iteration harness for VERDICT r5 item 2.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from tinysql_tpu.session.session import new_session
+from tinysql_tpu.bench import tpch
+from tinysql_tpu.ops import kernels
+
+
+def main():
+    sf = float(os.environ.get("TPCH_SF", "1"))
+    q = os.environ.get("Q", "Q3")
+    sql = tpch.QUERIES[q]
+    s = new_session()
+    t0 = time.time()
+    data = tpch.generate(sf)
+    print(f"gen {time.time()-t0:.1f}s", file=sys.stderr)
+    t0 = time.time()
+    tpch.load(s, sf=sf, data=data)
+    print(f"load {time.time()-t0:.1f}s", file=sys.stderr)
+    s.execute("set @@tidb_use_tpu = 1")
+    walls = []
+    for i in range(4):
+        snap = kernels.stats_snapshot()
+        t0 = time.time()
+        rows = s.query(sql).rows
+        dt = time.time() - t0
+        walls.append(round(dt, 4))
+        print(f"run{i}: {dt:.4f}s stats={kernels.stats_delta(snap)}",
+              file=sys.stderr)
+    print(f"walls={walls} rows={len(rows)}", file=sys.stderr)
+    if os.environ.get("CPROFILE"):
+        import cProfile, pstats
+        pr = cProfile.Profile()
+        pr.enable()
+        s.query(sql)
+        pr.disable()
+        st = pstats.Stats(pr, stream=sys.stderr)
+        st.sort_stats("cumulative").print_stats(40)
+
+
+if __name__ == "__main__":
+    main()
