@@ -1906,14 +1906,24 @@ def _sort_ops(jn, keys, descs, valid):
 class _OrderNode:
     """TopN (static offset/count slice after lexsort — valid rows sort
     first, so perm[offset : offset+count_bucket] IS the answer) or full
-    Sort over a view."""
+    Sort over a view.
 
-    def __init__(self, child, by, offset, count, plan):
+    Under `tidb_mesh_parallel` a TopN runs distributed (the mesh analogue
+    of the reference's per-region TopN pushdown + root merge,
+    /root/reference/store/mockstore/mocktikv/topn.go:1-139 +
+    planner/core/task.go:392-452): each shard lexsorts its partition and
+    keeps its top (offset+count) candidates, an all_gather moves the
+    k x n_shards survivors over ICI, and a replicated merge sort slices
+    the final window.  A global-row-index tiebreak makes the result
+    bit-identical to the single-device stable sort."""
+
+    def __init__(self, child, by, offset, count, plan, mesh=None):
         self.child = child
         self.by = by
         self.off = offset        # None = full sort
         self.count = count
         self.plan = plan
+        self.mesh = mesh
 
     @staticmethod
     def compile(plan, ctx: _Ctx):
@@ -1930,7 +1940,7 @@ class _OrderNode:
         off = count = None
         if isinstance(plan, PhysicalTopN):
             off, count = plan.offset, plan.count
-        return _OrderNode(child, by, off, count, plan)
+        return _OrderNode(child, by, off, count, plan, mesh=ctx.mesh)
 
     def prepare(self, pb: _PipeBuilder) -> Optional[_TView]:
         tv = self.child.prepare(pb)
@@ -1955,6 +1965,14 @@ class _OrderNode:
             kb = min(kernels.bucket(max(self.count, 1)) + off, tv.nb)
         count = self.count
         ip, fp = pb.params(pt)
+
+        from ..parallel import dist
+        mesh = self.mesh if (self.off is not None
+                             and dist.shardable(tv.nb, mesh=self.mesh)
+                             ) else None
+        if mesh is not None:
+            return self._prepare_mesh(pb, tv, fns, tuple(keys), descs, off,
+                                      kb, count, ip, fp, mesh)
         pb.key(("order", tuple(keys), off, kb, count, tv.nb,
                 len(tv.meta)))
 
@@ -1976,6 +1994,71 @@ class _OrderNode:
                 out_valid = out_valid & (jn.arange(kb - off) < count)
             outs = [(v[take], m[take]) for v, m in pairs]
             return out_valid, outs
+        return _TView(emit, kb - off, tv.meta)
+
+    def _prepare_mesh(self, pb, tv, fns, key_ids, descs, off, kb, count,
+                      ip, fp, mesh):
+        """Distributed TopN: per-shard top-(off+count) + all_gather merge.
+        Column sort keys alias the payload lanes, so only computed ('fn')
+        keys travel as extra lanes — the merge re-reads column keys from
+        the gathered payload instead of gathering them twice."""
+        jn = _jn()
+        from jax import lax
+        n = int(mesh.devices.size)
+        per = tv.nb // n
+        kc = min(kb, per)  # per-shard candidate count
+        pb.key(("order_mesh", key_ids, off, kb,
+                count, tv.nb, len(tv.meta), n, kc))
+
+        def pick_kvs(fn_kvs, pairs):
+            out = []
+            it = iter(fn_kvs)
+            for kind, f in fns:
+                out.append(pairs[f] if kind == "col" else next(it))
+            return out
+
+        def kernel(fn_kvs, valid, pairs):
+            # per-shard [per] lanes; global row index = the stable-sort
+            # tiebreak that reproduces the single-device order exactly
+            si = lax.axis_index("shard").astype(jn.int64)
+            gidx = si * per + jn.arange(per, dtype=jn.int64)
+            kvs = pick_kvs(fn_kvs, pairs)
+            perm = jn.lexsort([gidx] + _sort_ops(jn, kvs, descs, valid))
+            take = perm[:kc]
+            lanes = ([(kv[0][take], kv[1][take]) for kv in fn_kvs]
+                     + [(v[take], m[take]) for v, m in pairs])
+            g_valid = lax.all_gather(valid[take], "shard", tiled=True)
+            g_gidx = lax.all_gather(gidx[take], "shard", tiled=True)
+            g_lanes = [(lax.all_gather(v, "shard", tiled=True),
+                        lax.all_gather(m, "shard", tiled=True))
+                       for v, m in lanes]
+            g_fn_kvs = g_lanes[:len(fn_kvs)]
+            g_pairs = g_lanes[len(fn_kvs):]
+            g_kvs = pick_kvs(g_fn_kvs, g_pairs)
+            perm2 = jn.lexsort([g_gidx]
+                               + _sort_ops(jn, g_kvs, descs, g_valid))
+            take2 = perm2[off:kb]
+            out_valid = g_valid[take2]
+            if count is not None:
+                out_valid = out_valid & (jn.arange(kb - off) < count)
+            outs = [(v[take2], m[take2]) for v, m in g_pairs]
+            return out_valid, outs
+
+        from ..parallel.dist import shard_map_fn, shard_map_unchecked
+        _, P = shard_map_fn()
+
+        def emit(args):
+            valid, pairs = tv.emit(args)
+            pr = (args[ip], args[fp])
+            fn_kvs = [f(pairs, pr) for kind, f in fns if kind == "fn"]
+            npairs = len(pairs)
+            sharded = shard_map_unchecked(
+                kernel, mesh=mesh,
+                in_specs=([(P("shard"), P("shard"))] * len(fn_kvs),
+                          P("shard"),
+                          [(P("shard"), P("shard"))] * npairs),
+                out_specs=(P(), [(P(), P())] * npairs))
+            return sharded(fn_kvs, valid, list(pairs))
         return _TView(emit, kb - off, tv.meta)
 
     def close(self):
@@ -2119,10 +2202,13 @@ class DevPipeExec:
             self._open_fallback(ctx)
             return
         if not _contains_join(self.plan) \
+                and _contains_grouped_agg(self.plan) \
                 and mesh_if_enabled(ctx.session_vars) is not None:
             # agg-only pipelines under tidb_mesh_parallel ride the per-op
             # SHARDED fused aggregate (psum partial merge over the mesh);
-            # devpipe's agg node is single-device
+            # devpipe's agg node is single-device.  Join pipelines and
+            # plain scan+TopN stay here: the join and TopN nodes have
+            # their own mesh (shard_map) paths.
             self._node = None
             self._open_fallback(ctx)
             return

@@ -33,7 +33,7 @@ def _make_handler(server_ref):
                     "version": SERVER_VERSION,
                     "connections": len(srv.conns) if srv else 0,
                     "tls_connections": sum(
-                        1 for c in srv.conns.values()
+                        1 for c in list(srv.conns.values())
                         if getattr(c, "tls", False)) if srv else 0,
                 }).encode()
                 self._send(200, body)

@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Per-operator timing for Q3 per-op tier at SF=1 (scratch)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from tinysql_tpu.session.session import new_session
+from tinysql_tpu.bench import tpch
+from tinysql_tpu.executor import tpu_executors as tx
+
+for cls in (tx.TPUHashAggExec, tx.TPUHashJoinExec, tx.TPUTopNExec,
+            tx.TPUSortExec):
+    orig = cls.next
+
+    def timed(self, _orig=orig, _name=cls.__name__):
+        t0 = time.perf_counter()
+        out = _orig(self)
+        dt = time.perf_counter() - t0
+        if dt > 0.005:
+            n = out.num_rows() if out is not None else 0
+            nc = len(out.columns) if out is not None else 0
+            print(f"  [{_name}] {dt*1e3:8.1f}ms -> {n} rows x {nc} cols",
+                  file=sys.stderr)
+        return out
+    cls.next = timed
+
+
+def main():
+    sf = float(os.environ.get("TPCH_SF", "1"))
+    sql = tpch.QUERIES[os.environ.get("Q", "Q3")]
+    s = new_session()
+    data = tpch.generate(sf)
+    tpch.load(s, sf=sf, data=data)
+    s.execute("set @@tidb_use_tpu = 1")
+    for i in range(3):
+        t0 = time.time()
+        rows = s.query(sql).rows
+        print(f"run{i}: {time.time()-t0:.4f}s", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
