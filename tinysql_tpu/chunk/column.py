@@ -75,7 +75,14 @@ class Column:
     def __len__(self) -> int:
         return self._len
 
+    def _ensure_host(self) -> None:
+        """Hook for lazily-materialized subclasses (DeviceColumn): a
+        no-op here.  Every accessor that touches the host buffers calls
+        it first, so device-resident columns stay on device until a host
+        consumer actually reads them."""
+
     def _grow(self, need: int) -> None:
+        self._ensure_host()
         cap = len(self._data)
         if self._len + need <= cap:
             return
@@ -104,6 +111,7 @@ class Column:
 
     def extend(self, other: "Column", start: int = 0,
                end: Optional[int] = None) -> None:
+        other._ensure_host()
         end = other._len if end is None else end
         n = end - start
         if n <= 0:
@@ -114,6 +122,7 @@ class Column:
         self._len += n
 
     def extend_take(self, other: "Column", idx: np.ndarray) -> None:
+        other._ensure_host()
         n = len(idx)
         if n == 0:
             return
@@ -124,6 +133,7 @@ class Column:
 
     # ---- access -------------------------------------------------------
     def get(self, i: int) -> Datum:
+        self._ensure_host()
         if self._null[i]:
             return None
         v = self._data[i]
@@ -138,13 +148,16 @@ class Column:
         return str(v)  # normalize np.str_ -> str
 
     def is_null(self, i: int) -> bool:
+        self._ensure_host()
         return bool(self._null[i])
 
     def values(self) -> np.ndarray:
         """Raw buffer view, length-trimmed (reference: column.go Int64s())."""
+        self._ensure_host()
         return self._data[:self._len]
 
     def null_mask(self) -> np.ndarray:
+        self._ensure_host()
         return self._null[:self._len]
 
     def datums(self) -> List[Datum]:
@@ -169,3 +182,94 @@ class Column:
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"Column({self.ft.type_name()}, {self.datums()[:8]}{'...' if self._len > 8 else ''})"
+
+
+class DeviceColumn(Column):
+    """Device-resident column: values/null live as `jax.Array`s padded to
+    a power-of-two bucket; host buffers materialize lazily on first host
+    access.  The per-op TPU tier's late-materialization carrier — an
+    aggregate output consumed by the join above it never round-trips
+    through host memory (the reference's chunk always lives in Go heap,
+    column.go:28; on TPU the chunk's natural home is HBM).
+
+    Rows [0:_len) are live; padding rows carry null=True so device
+    consumers (join match) treat them as no-match.  `sorted_live` marks
+    values ascending among live non-null rows (a single-key aggregate
+    output inherits the segment table's order) — joins against such a
+    build side skip their device sort."""
+
+    __slots__ = ("_dev_v", "_dev_n", "sorted_live")
+
+    def __init__(self, ft: FieldType, dev_v, dev_n, n: int):
+        self.ft = ft
+        self._data = None         # host buffers: absent until demanded
+        self._null = None
+        self._len = n
+        self._dev_v = dev_v
+        self._dev_n = dev_n
+        self.sorted_live = False
+
+    def device_pair(self):
+        """(values, null) jax arrays, bucket-padded (padding null=True)."""
+        return self._dev_v, self._dev_n
+
+    def device_bucket(self) -> int:
+        return int(self._dev_v.shape[0])
+
+    def _ensure_host(self) -> None:
+        if self._data is None:
+            v = np.asarray(self._dev_v)[:self._len]
+            m = np.asarray(self._dev_n)[:self._len]
+            dt = _np_dtype(self.ft.eval_type)
+            self._data = np.ascontiguousarray(v, dtype=dt)
+            self._null = np.asarray(m, dtype=bool).copy()
+
+    def take(self, idx: np.ndarray) -> "Column":
+        """Gather on device, land only the gathered rows on host — the
+        late-materialization payoff: a join keeping k of n rows downloads
+        k values, not n."""
+        if self._data is not None:
+            return super().take(idx)
+        import jax.numpy as jnp
+        di = jnp.asarray(np.asarray(idx, dtype=np.int64))
+        v = np.asarray(self._dev_v[di])
+        m = np.asarray(self._dev_n[di])
+        dt = _np_dtype(self.ft.eval_type)
+        return Column.from_numpy(
+            self.ft, np.ascontiguousarray(v, dtype=dt),
+            np.asarray(m, dtype=bool))
+
+
+class LazyTakeColumn(Column):
+    """Deferred gather: (source column, row indices) materialized only on
+    first host access.  Joins emit their output columns as lazy takes, so
+    a chain join -> join -> TopN gathers each payload column ONCE at the
+    final (smallest) cardinality instead of at every operator — the
+    late-materialization analogue of the reference's chunk.Row indirection
+    (util/chunk/chunk.go:573 Sel semantics), generalized across operators.
+
+    take() composes index arrays without touching the data, and the source
+    may itself be a DeviceColumn (the final gather then runs on device)."""
+
+    __slots__ = ("_src", "_idx")
+
+    def __init__(self, src: Column, idx: np.ndarray):
+        self.ft = src.ft
+        self._data = None
+        self._null = None
+        self._idx = np.asarray(idx, dtype=np.int64)
+        self._len = len(self._idx)
+        self._src = src
+
+    def _ensure_host(self) -> None:
+        if self._data is None:
+            mat = self._src.take(self._idx)
+            mat._ensure_host()
+            self._data = mat._data
+            self._null = mat._null
+
+    def take(self, idx: np.ndarray) -> "Column":
+        if self._data is not None:
+            return super().take(idx)
+        return LazyTakeColumn(self._src,
+                              self._idx[np.asarray(idx, dtype=np.int64)])

@@ -29,7 +29,18 @@ from .executors import Executor, build_executor
 
 
 def _drain_chunk(ex: Executor, fields) -> Chunk:
+    first = ex.next()
+    if first is None:
+        return Chunk(fields, cap=MAX_CHUNK_SIZE)
+    nxt = ex.next()
+    if nxt is None:
+        # single-chunk children (every device-tier operator) hand their
+        # output over without a copy — this also keeps DeviceColumn
+        # (late-materialization) chunks resident on device
+        return first.compact()
     out = Chunk(fields, cap=MAX_CHUNK_SIZE)
+    out.append_chunk(first)
+    out.append_chunk(nxt)
     while True:
         chk = ex.next()
         if chk is None:
@@ -242,11 +253,22 @@ def _string_cmp_mask(ex, rep, chk, cond):
 
 def _compact_if_selective(chk: Chunk, mask):
     """Selective filters compact (less kernel work); permissive ones stay
-    masked (stable bucket shape = one TPU compile per table size)."""
+    masked (stable bucket shape = one TPU compile per table size).
+    String columns compact LAZILY (LazyTakeColumn): copying a <U date
+    column costs ~5x an int64 copy, and a join above usually needs only
+    its final few rows — the gather defers to that cardinality."""
+    from ..chunk.column import LazyTakeColumn
     if (mask is not None and mask.size
             and mask.mean() < _mask_compact_threshold()):
-        chk.set_sel(np.nonzero(mask)[0])
-        return chk.compact(), None
+        sel = np.nonzero(mask)[0]
+        cols = []
+        for c in chk.columns:
+            v = c._data
+            if v is not None and (v.dtype == object or v.dtype.kind == "U"):
+                cols.append(LazyTakeColumn(c, sel))
+            else:
+                cols.append(c.take(sel))
+        return Chunk.from_columns(cols), None
     if mask is not None and not mask.size:
         return chk, None  # empty chunk: nothing to mask
     return chk, mask
@@ -597,6 +619,13 @@ class TPUHashAggExec(Executor):
                     kernels.fused_segment_aggregate_sharded(
                         mesh, dev_cols, gid_dev, n_segments, specs, progs,
                         n, mask_spec, program_key=program_key)
+            elif self._can_device_passthrough(plan, slots, key_layouts):
+                ids, live, out_aggs_d, np_, ob = \
+                    kernels.fused_segment_aggregate_keep(
+                        dev_cols, gid_dev, n_segments, specs, progs,
+                        mask_spec, program_key=program_key)
+                return self._assemble_device_output(
+                    plan, slots, key_layouts, ids, live, out_aggs_d, np_)
             else:
                 present, out_aggs, first_orig = \
                     kernels.fused_segment_aggregate(
@@ -940,6 +969,108 @@ class TPUHashAggExec(Executor):
         return self._assemble_output(chk, plan, slots, out_keys, out_aggs,
                                      first_orig, [d for _, _, d in keys])
 
+    def _can_device_passthrough(self, plan, slots, key_layouts) -> bool:
+        """Late-materialization gate (VERDICT r4 next-2): the aggregate's
+        output chunk stays device-resident (DeviceColumn) when every
+        output can be produced by traced ops — numeric group keys without
+        a string decode table or unsigned order-map, and dev/avg/min-max
+        slots (first_row gathers host-side by representative row)."""
+        if not plan.group_by:
+            return False
+        try:
+            if int(self.ctx.session_vars.get(
+                    "tidb_device_passthrough", 1) or 0) == 0:
+                return False
+        except Exception:
+            pass
+        for sl in slots:
+            if sl[0] == "dev" or sl[0] == "avg":
+                continue
+            if sl[0] == "dev_mm" and not sl[2]:
+                continue
+            return False
+        for lay, e in zip(key_layouts, plan.group_by):
+            if lay[3] is not None:  # string dictionary decode
+                return False
+            if getattr(e.ret_type, "is_unsigned", False):
+                return False
+        return True
+
+    _DEVOUT_CACHE: Dict[tuple, object] = {}
+
+    def _assemble_device_output(self, plan, slots, key_layouts, ids, live,
+                                out_aggs, np_):
+        """Device-resident output chunk: ONE jitted program decodes group
+        ids back to key values and finishes the slots (avg divide, REAL
+        cast), producing bucket-padded (values, null) pairs wrapped as
+        DeviceColumns.  Nothing lands on host until a host consumer asks
+        (a device join above consumes the pairs directly)."""
+        from ..chunk import DeviceColumn
+        jn = kernels.jnp()
+        ob = int(ids.shape[0])
+        strides = []
+        s = 1
+        for _, card, _, _ in reversed(key_layouts):
+            strides.append(s)
+            s *= card + 1
+        strides.reverse()
+        # (card, base, stride) per key ride as RUNTIME params — stats
+        # shifts (inserts widening a key's min/max) must not recompile
+        # the decode kernel (same rule as the device-mask params)
+        lay = np.array([(card, base, stride)
+                        for (_, card, base, _), stride
+                        in zip(key_layouts, strides)], dtype=np.int64)
+        slot_sig = []
+        for src, idx in plan.output_map:
+            if src == "agg":
+                sl = slots[idx]
+                real = (plan.aggs[idx].ret_type.eval_type
+                        is EvalType.REAL)
+                slot_sig.append((sl[0], sl[1],
+                                 sl[2] if sl[0] == "avg" else None, real))
+            else:
+                slot_sig.append(("gb", idx, None, False))
+        key = (ob, len(key_layouts), tuple(slot_sig),
+               tuple(str(v.dtype) for v, _ in out_aggs))
+        fn = self._DEVOUT_CACHE.get(key)
+        if fn is None:
+            def kernel(ids_in, live_in, aggs, lay_in):
+                outs = []
+                for kind, i, extra, real in slot_sig:
+                    if kind == "gb":
+                        card = lay_in[i, 0]
+                        base = lay_in[i, 1]
+                        stride = lay_in[i, 2]
+                        code = (ids_in // stride) % (card + 1)
+                        nullk = (code == card) | ~live_in
+                        outs.append((jn.where(nullk, 0, code + base),
+                                     nullk))
+                    elif kind == "avg":
+                        sv, sm = aggs[i]
+                        cv, _ = aggs[extra]
+                        outs.append((sv / jn.maximum(cv, 1),
+                                     sm | (cv == 0)))
+                    else:  # dev / dev_mm (unsigned excluded by the gate)
+                        v, m = aggs[i]
+                        if real and v.dtype != jn.float64:
+                            v = v.astype(jn.float64)
+                        outs.append((v, m))
+                return outs
+            fn = self._DEVOUT_CACHE[key] = kernels.counted_jit(kernel)
+        outs = fn(ids, live, list(out_aggs), jn.asarray(lay))
+        cols = []
+        for (src, idx), (v, m) in zip(plan.output_map, outs):
+            ft = (plan.aggs[idx].ret_type if src == "agg"
+                  else plan.group_by[idx].ret_type)
+            col = DeviceColumn(ft, v, m, np_)
+            if src == "gb" and len(key_layouts) == 1:
+                # single-key groups: present ids ascend, and id = code =
+                # value - base, so live non-null key values ascend — a
+                # join building on this column skips its sort
+                col.sorted_live = True
+            cols.append(col)
+        return Chunk.from_columns(cols)
+
     def _assemble_output(self, chk, plan, slots, out_keys, out_aggs,
                          first_orig, decodes):
         """Materialize the output chunk from kernel results (shared by the
@@ -1066,24 +1197,33 @@ class TPUHashJoinExec(Executor):
             li, ri = kernels.unique_join_match(
                 (lk, lnull), lchk.full_rows(), (rk, rnull),
                 rchk.full_rows(), outer=(plan.tp == "left"),
-                lvalid=lmask, rvalid=rmask)
+                lvalid=lmask, rvalid=rmask,
+                build_sorted=self._sorted_build(plan.right_keys[0], rchk))
         elif left_unique and plan.tp == "inner":
             ri, li = kernels.unique_join_match(
                 (rk, rnull), rchk.full_rows(), (lk, lnull),
                 lchk.full_rows(), outer=False,
-                lvalid=rmask, rvalid=lmask)
+                lvalid=rmask, rvalid=lmask,
+                build_sorted=self._sorted_build(plan.left_keys[0], lchk))
         else:
             li, ri = kernels.join_match((lk, lnull), lchk.full_rows(),
                                         (rk, rnull), rchk.full_rows(),
                                         outer=(plan.tp == "left"),
                                         lvalid=lmask, rvalid=rmask)
-        # gather output columns
+        # gather output columns — LAZILY for inner joins: a parent join
+        # or TopN composes the index chain and each payload column lands
+        # once, at the final (smallest) cardinality
+        from ..chunk.column import LazyTakeColumn
         unmatched = ri < 0
         ri_safe = np.where(unmatched, 0, ri)
+        lazy = plan.tp != "left" and not unmatched.any()
         cols: List[CCol] = []
         for c in lchk.columns:
-            cols.append(c.take(li))
+            cols.append(LazyTakeColumn(c, li) if lazy else c.take(li))
         for c in rchk.columns:
+            if lazy:
+                cols.append(LazyTakeColumn(c, ri_safe))
+                continue
             taken = c.take(ri_safe)
             if unmatched.any():
                 taken.null_mask()[unmatched] = True
@@ -1127,12 +1267,31 @@ class TPUHashJoinExec(Executor):
         return keep
 
 
+    @staticmethod
+    def _sorted_build(key_expr, chk) -> bool:
+        """True when the build key column provably ascends among live
+        rows (a device-resident single-key aggregate output): the join
+        kernel then skips its argsort."""
+        from ..chunk import DeviceColumn
+        from ..expression import Column as ExprColumn
+        if not isinstance(key_expr, ExprColumn):
+            return False
+        col = chk.columns[key_expr.index]
+        return (isinstance(col, DeviceColumn) and col._data is None
+                and col.sorted_live)
+
     def _key_arrays(self, key_expr, chk, rep, side):
         """Join key (values, null) — for a bare Column over an uncompacted
         replica, PADDED DEVICE arrays memoized on the replica (no re-upload
-        per query); numpy otherwise."""
+        per query); device-resident for a DeviceColumn child (an aggregate
+        output that never landed on host); numpy otherwise."""
+        from ..chunk import DeviceColumn
         from ..expression import Column as ExprColumn
         from .executors import TableReaderExec
+        if isinstance(key_expr, ExprColumn):
+            col = chk.columns[key_expr.index]
+            if isinstance(col, DeviceColumn) and col._data is None:
+                return col.device_pair()
         if rep is not None and isinstance(key_expr, ExprColumn):
             child = self.children[side]
             if isinstance(child, TableReaderExec):

@@ -55,6 +55,10 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # (SURVEY §5.7 long-context analogue).  Other device operators are
     # not budget-aware yet.  0 = unlimited
     "tidb_device_block_rows": 0,
+    # late materialization: aggregate outputs consumed by device joins
+    # stay resident in device memory (DeviceColumn chunks); 0 forces the
+    # host-extraction path
+    "tidb_device_passthrough": 1,
     "sql_mode": "STRICT_TRANS_TABLES",
     "max_execution_time": 0,
 }

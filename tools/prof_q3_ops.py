@@ -1,5 +1,9 @@
 #!/usr/bin/env python
-"""Per-operator timing for Q3 per-op tier at SF=1 (scratch)."""
+"""Per-operator timing for Q3 per-op tier at SF=1 (scratch).
+
+NOTE: printed times are INCLUSIVE — a parent's next() wall time contains
+its children's next() calls (the tree drains bottom-up), so attribute by
+subtracting the child lines printed above each parent."""
 import os
 import sys
 import time
