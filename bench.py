@@ -223,6 +223,20 @@ def main():
               f"{lite_t / dev_t:.2f}x match={ok} "
               f"({len(dev_rows)} rows)", file=sys.stderr)
 
+    # operator micro-benchmarks (BASELINE.json configs 1-4): rows/sec
+    # through HashAgg / HashJoin / Projection+Filter / top-k Sort per
+    # tier, so operator regressions are visible independent of the
+    # TPC-H query shapes (VERDICT r4 next-8)
+    from tinysql_tpu.bench import operators as opbench
+    print("[bench] operator micro-benchmarks ...", file=sys.stderr)
+    opbench.load(s)
+    op_results = opbench.run(s, dev_tier)
+    for op, ent in op_results.items():
+        print(f"[bench] op {op}: {dev_tier}={ent[f'{dev_tier}_rows_per_s']:,}"
+              f" rows/s cpu={ent['cpu_rows_per_s']:,}"
+              f" sqlite={ent['sqlite_rows_per_s']:,}"
+              f" match={ent['match']}", file=sys.stderr)
+
     q1_dev, q1_cpu, q1_lite, q1_ok = results["Q1"]
     # the metric NAME carries the tier that actually ran: an XLA:CPU run
     # must never publish under a "tpu" label (VERDICT r3 weak-1)
@@ -240,8 +254,10 @@ def main():
                    **run_stats.get(tpch.QUERIES[name], {})}
             for name, (t, c, l, ok) in results.items()
         },
+        "operators": op_results,
         "link": link,
-        "correct": all(ok for _, _, _, ok in results.values()),
+        "correct": all(ok for _, _, _, ok in results.values())
+                   and all(e["match"] for e in op_results.values()),
         "total_bench_seconds": round(time.time() - t_start, 1),
     }
     if not device:

@@ -298,3 +298,33 @@ def test_kernel_sort_permutation_direct():
     want = sorted(range(200), key=key)
     # compare by key equivalence (stable order between equal keys may differ)
     assert [key(i) for i in perm] == [key(i) for i in want]
+
+
+def test_topk_multi_key_matches_full_sort():
+    """Multi-key top-k (primary-threshold candidate selection) must equal
+    the full sort + slice bit-for-bit, including NULL ordering, ties, and
+    stability (kernels._topk_multi; VERDICT r4 next-8 operator bench)."""
+    import numpy as np
+    from tinysql_tpu.ops import kernels
+    rng = np.random.default_rng(3)
+    n = 30000
+    a = rng.integers(0, 50, n).astype(np.int64)       # heavy ties
+    am = rng.random(n) < 0.1                          # NULL primaries
+    c = np.round(rng.random(n), 3)
+    cm = rng.random(n) < 0.05
+    for descs in ([False, False], [True, False],
+                  [False, True], [True, True]):
+        keys = [(a, am), (c, cm)]
+        fast = kernels._topk_multi(keys, descs, n, 37)
+        full = kernels.sort_permutation(keys, descs, n)[:37]
+        assert fast is not None and np.array_equal(fast, full), descs
+    # all-equal primary without nulls: degenerate ties fall back
+    ae = np.zeros(n, dtype=np.int64)
+    zm = np.zeros(n, dtype=bool)
+    assert kernels._topk_multi([(ae, zm), (c, cm)],
+                               [False, False], n, 10) is None
+    # top_k public entry must still answer correctly through the fallback
+    ids = kernels.top_k([(ae, zm), (c, cm)], [False, False], n, 10)
+    full = kernels.sort_permutation([(ae, zm), (c, cm)],
+                                    [False, False], n)[:10]
+    assert np.array_equal(np.asarray(ids), np.asarray(full))

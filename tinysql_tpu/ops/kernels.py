@@ -1425,33 +1425,11 @@ def _topk_single(key, desc: bool, n_rows: int, k: int):
     before the padding.  Returns None when an exact mapping isn't safe
     (key values touching the sentinel range, non-finite floats)."""
     v, m = key
-    if v.dtype == object or getattr(v.dtype, "kind", "") == "U":
-        return None
     nb = bucket(max(n_rows, 1))
-    if v.dtype == np.int64:
-        info = np.iinfo(np.int64)
-        vmin = int(v.min()) if n_rows else 0
-        vmax = int(v.max()) if n_rows else 0
-        if vmin < info.min + 2 or vmax > info.max - 2:
-            return None
-        if desc:  # null last -> worst score
-            score = np.where(m, info.min + 1, v)
-            pad_val = info.min
-        else:     # asc: ~v reverses order exactly; null first -> best
-            score = np.where(m, info.max, ~v)
-            pad_val = info.min
-    elif v.dtype == np.float64:
-        w = np.where(m, 0.0, v)
-        if n_rows and not np.isfinite(w).all():
-            return None
-        if desc:
-            score = np.where(m, -np.inf, w)
-            pad_val = -np.inf
-        else:
-            score = np.where(m, np.inf, -w)
-            pad_val = -np.inf
-    else:
+    score = _primary_score(key, desc, n_rows)
+    if score is None:
         return None
+    pad_val = np.iinfo(np.int64).min if v.dtype == np.int64 else -np.inf
     if jax().default_backend() == "cpu":
         # XLA:CPU's top_k lowering barely beats the full sort; host
         # partition selection is ~100x faster there.  Exact stable-tie
@@ -1476,15 +1454,85 @@ def _topk_single(key, desc: bool, n_rows: int, k: int):
     return ids[ids < n_rows]  # k may exceed the row count
 
 
+def _primary_score(key, desc: bool, n_rows: int):
+    """Map one sort key onto a total-order score (bigger = earlier) with
+    NULL ordering folded in, or None when unsafe.  Shared by the single-
+    and multi-key top-k selection paths."""
+    v, m = key
+    if v.dtype == object or getattr(v.dtype, "kind", "") == "U":
+        return None
+    if v.dtype == np.int64:
+        info = np.iinfo(np.int64)
+        vmin = int(v.min()) if n_rows else 0
+        vmax = int(v.max()) if n_rows else 0
+        if vmin < info.min + 2 or vmax > info.max - 2:
+            return None
+        if desc:  # null last -> worst score
+            return np.where(m, info.min + 1, v)
+        return np.where(m, info.max, ~v)  # asc: ~v reverses; null first
+    if v.dtype == np.float64:
+        w = np.where(m, 0.0, v)
+        if n_rows and not np.isfinite(w).all():
+            return None
+        if desc:
+            return np.where(m, -np.inf, w)
+        return np.where(m, np.inf, -w)
+    return None
+
+
+def _np_lexsort_perm(key_cols, descs, sub: np.ndarray) -> np.ndarray:
+    """numpy twin of _sort_kernel over the row subset `sub`: same operand
+    order, same NULL first/last semantics, stable — restricted to a
+    candidate subset it reproduces the full sort's relative order."""
+    ops = []
+    for i in range(len(key_cols) - 1, -1, -1):
+        v, m = key_cols[i]
+        v, m = v[sub], m[sub]
+        vv = np.where(m, 0, v)
+        if descs[i]:
+            vv = ~vv if vv.dtype == np.int64 else -vv
+            rank = np.where(m, 1, 0).astype(np.int8)   # NULL last
+        else:
+            rank = np.where(m, 0, 1).astype(np.int8)   # NULL first
+        ops.append(vv)
+        ops.append(rank)
+    return np.lexsort(ops)
+
+
+def _topk_multi(key_cols, descs, n_rows: int, k: int):
+    """Multi-key top-k via primary-key threshold selection: rows scoring
+    at or above the k-th primary score are a SUPERSET of the true top-k
+    (secondary keys only reorder within primary ties), so the full
+    lexsort runs over that small candidate set instead of all rows —
+    O(n) selection + O(c log c) sort, vs the O(n log n) full sort that
+    XLA:CPU executes serially."""
+    score = _primary_score(key_cols[0], descs[0], n_rows)
+    if score is None:
+        return None
+    kk = min(k, n_rows)
+    s = np.asarray(score[:n_rows])
+    t = np.partition(s, n_rows - kk)[n_rows - kk]
+    cand = np.nonzero(s >= t)[0]
+    if len(cand) * 4 > n_rows * 3:
+        return None  # degenerate ties: the full sort is no worse
+    order = _np_lexsort_perm(key_cols, descs, cand)
+    return cand[order[:kk]]
+
+
 def top_k(key_cols: List[Tuple[np.ndarray, np.ndarray]], descs: List[bool],
           n_rows: int, k: int) -> np.ndarray:
     """Top-k row indices in requested order.  Single-key inputs take the
-    lax.top_k selection path (VERDICT r1 #10); multi-key falls back to
-    the full device sort + slice."""
+    lax.top_k selection path (VERDICT r1 #10); multi-key selects
+    candidates by primary-key threshold and sorts only those; the full
+    device sort + slice remains the fallback."""
     if k <= 0 or n_rows <= 0:
         return np.empty(0, dtype=np.int64)
     if len(key_cols) == 1:
         ids = _topk_single(key_cols[0], descs[0], n_rows, k)
+        if ids is not None:
+            return ids
+    else:
+        ids = _topk_multi(key_cols, descs, n_rows, k)
         if ids is not None:
             return ids
     perm = sort_permutation(key_cols, descs, n_rows)
