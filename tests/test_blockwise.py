@@ -127,3 +127,67 @@ def test_negative_budget_is_ignored(tk):
     tk.execute("set @@tidb_device_block_rows = 0")
     b = tk.query(q).rows
     assert a == b and a[0][0] == N, (a, b)
+
+
+# ---- block-wise JOIN / TopN / Sort (VERDICT r4 next-3) -------------------
+
+@pytest.fixture
+def join_tk(tk):
+    rng = np.random.default_rng(47)
+    tk.execute("create table fact (id bigint primary key, k bigint, "
+               "v double)")
+    info = tk.infoschema().table_by_name("bw", "fact")
+    bulk_load(tk.storage, info,
+              {"id": np.arange(1, N + 1, dtype=np.int64),
+               "k": rng.integers(1, 80, N).astype(np.int64),
+               "v": np.round(rng.random(N) * 9, 2)})
+    tk.execute("create table d (k bigint primary key, tag bigint)")
+    info = tk.infoschema().table_by_name("bw", "d")
+    bulk_load(tk.storage, info,
+              {"k": np.arange(1, 80, dtype=np.int64),
+               "tag": rng.integers(0, 6, 79).astype(np.int64)})
+    tk.query("select * from fact")
+    tk.query("select * from d")
+    return tk
+
+
+def test_blockwise_join_above_budget(join_tk):
+    """fact (5000 rows) > budget (512): the probe side streams in blocks
+    against the resident build table; the probe key column must never
+    upload whole."""
+    q = ("select d.tag, count(*), sum(fact.v) from fact join d "
+         "on fact.k = d.k group by d.tag order by d.tag")
+    a, b, st = _both(join_tk, q)
+    assert _canon(a) == _canon(b)
+    # the join ran block-wise: >= ceil(5000/512) = 10 match dispatches
+    assert st["dispatches"] >= 10, st
+
+
+def test_blockwise_left_join(join_tk):
+    q = ("select fact.id, d.tag from fact left join d "
+         "on fact.k = d.k and d.tag < 3 order by fact.id limit 40")
+    a, b, _ = _both(join_tk, q)
+    assert _canon(a) == _canon(b)
+
+
+def test_blockwise_topn_above_budget(join_tk):
+    """TopN carries its candidate set across blocks: per-block top-k,
+    merge, final k — identical rows AND order vs the CPU tier."""
+    q = "select id, k, v from fact order by k desc, v, id limit 100, 25"
+    a, b, _ = _both(join_tk, q)
+    assert a == b  # exact order, not just set equality
+
+
+def test_blockwise_full_sort_above_budget(join_tk):
+    q = "select id, v from fact where k < 40 order by v desc, id limit 4500"
+    a, b, _ = _both(join_tk, q)
+    assert a == b
+
+
+def test_blockwise_join_topn_pipeline(join_tk):
+    """join + group-by + TopN over an above-budget table, all under the
+    block budget (the VERDICT r4 next-3 'done' shape)."""
+    q = ("select fact.k, sum(fact.v * (1 + d.tag)) as s from fact, d "
+         "where fact.k = d.k group by fact.k order by s desc limit 7")
+    a, b, _ = _both(join_tk, q)
+    assert _canon(a) == _canon(b)

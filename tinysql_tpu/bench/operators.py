@@ -53,9 +53,21 @@ OPERATORS = {
 }
 
 
+_DATA = None
+
+
+def _data():
+    """Generate once per process: run() reuses load()'s arrays for the
+    sqlite twin instead of paying the 2M-row RNG twice."""
+    global _DATA
+    if _DATA is None:
+        _DATA = _gen()
+    return _DATA
+
+
 def load(session) -> None:
     from ..columnar.store import bulk_load
-    fact, dim = _gen()
+    fact, dim = _data()
     session.execute("create database if not exists opbench")
     session.execute("use opbench")
     for name, data in (("opbench_fact", fact), ("opbench_dim", dim)):
@@ -111,7 +123,7 @@ def _canon(rows):
 
 def _sqlite_times(reps: int = 3):
     import sqlite3
-    fact, dim = _gen()
+    fact, dim = _data()
     db = sqlite3.connect(":memory:")
     db.execute("PRAGMA journal_mode=OFF")
     db.execute("create table opbench_fact (id integer primary key, "

@@ -296,11 +296,8 @@ class _ReplicaLeaf:
         if ex._replica is None:
             ex.close()
             return None
-        try:
-            budget = int(getattr(ctx.exec_ctx, "session_vars", {}).get(
-                "tidb_device_block_rows", 0) or 0)
-        except Exception:
-            budget = 0
+        from .tpu_executors import _block_budget
+        budget = _block_budget(getattr(ctx.exec_ctx, "session_vars", {}))
         if budget > 0 and ex._replica.n_rows > budget:
             # table exceeds the device buffer budget: whole-column
             # residency is off the table — the per-op tier's block-wise

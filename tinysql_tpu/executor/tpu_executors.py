@@ -49,6 +49,15 @@ def _drain_chunk(ex: Executor, fields) -> Chunk:
     return out
 
 
+def _block_budget(session_vars) -> int:
+    """tidb_device_block_rows, defensively parsed — the ONE reader all
+    block-wise paths (agg, join, sort, topn, devpipe leaf) share."""
+    try:
+        return int(session_vars.get("tidb_device_block_rows", 0) or 0)
+    except Exception:
+        return 0
+
+
 def _mask_compact_threshold() -> float:
     """Below this selectivity, compacting beats masking.  On real TPUs
     masked full-table kernels win almost always (stable shapes = one
@@ -530,11 +539,7 @@ class TPUHashAggExec(Executor):
         # ---- block-wise execution (SURVEY §5.7): tables above the device
         # buffer budget stream through HBM in row blocks; partial states
         # carry on host between blocks
-        try:
-            budget = int(self.ctx.session_vars.get(
-                "tidb_device_block_rows", 0) or 0)
-        except Exception:
-            budget = 0
+        budget = _block_budget(self.ctx.session_vars)
         if budget > 0 and n > budget:
             out = self._fused_blockwise(chk, rep, child, filters,
                                         specs, arg_exprs, slots,
@@ -1173,8 +1178,26 @@ class TPUHashJoinExec(Executor):
         lchk, lmask, lrep = self._side_input(
             0, [] if on_left else plan.left_conditions)
         rchk, rmask, rrep = self._side_input(1, plan.right_conditions)
-        lk, lnull = self._key_arrays(plan.left_keys[0], lchk, lrep, 0)
-        rk, rnull = self._key_arrays(plan.right_keys[0], rchk, rrep, 1)
+        right_unique = getattr(plan, "right_unique", False)
+        left_unique = getattr(plan, "left_unique", False)
+        # block-wise probe streaming (SURVEY §5.7; VERDICT r4 next-3):
+        # when the PROBE side exceeds tidb_device_block_rows, its key
+        # column uploads transiently per block against the resident build
+        # structure — the table never becomes fully device-resident
+        probe_side = 1 if (left_unique and plan.tp == "inner"
+                           and not right_unique) else 0
+        budget = _block_budget(self.ctx.session_vars)
+        probe_chk = lchk if probe_side == 0 else rchk
+        stream = budget > 0 and probe_chk.full_rows() > budget
+
+        def keys_of(side, expr, chk, rep):
+            if stream and side == probe_side:
+                v, m = expr.vec_eval(chk)  # host: no full-column upload
+                return np.asarray(v), np.asarray(m)
+            return self._key_arrays(expr, chk, rep, side)
+
+        lk, lnull = keys_of(0, plan.left_keys[0], lchk, lrep)
+        rk, rnull = keys_of(1, plan.right_keys[0], rchk, rrep)
         if on_left:
             on_mask = vectorized_filter(on_left, lchk)
             # poison only the NULL mask (values may stay replica-memoized
@@ -1190,21 +1213,58 @@ class TPUHashJoinExec(Executor):
         if lk.dtype != rk.dtype:
             lk = np.asarray(lk).astype(np.float64)
             rk = np.asarray(rk).astype(np.float64)
-        right_unique = getattr(plan, "right_unique", False)
-        left_unique = getattr(plan, "left_unique", False)
+        def stream_match(fn, pk, pn, n_probe, pmask, bkey, n_build,
+                         bmask, **kw):
+            """Probe-block loop: fn per block of `budget` rows with the
+            block's validity slice; probe-side indices re-base by the
+            block start.  Stable block shapes = one compiled program."""
+            pis, bis = [], []
+            for s_ in range(0, n_probe, budget):
+                e_ = min(s_ + budget, n_probe)
+                pi_b, bi_b = fn((pk[s_:e_], pn[s_:e_]), e_ - s_, bkey,
+                                n_build,
+                                lvalid=None if pmask is None
+                                else pmask[s_:e_],
+                                rvalid=bmask, **kw)
+                pis.append(pi_b + s_)
+                bis.append(bi_b)
+            if not pis:
+                z = np.empty(0, dtype=np.int64)
+                return z, z
+            return np.concatenate(pis), np.concatenate(bis)
+
         if right_unique:
             # unique build side: expansion-free probe, no size sync
-            li, ri = kernels.unique_join_match(
-                (lk, lnull), lchk.full_rows(), (rk, rnull),
-                rchk.full_rows(), outer=(plan.tp == "left"),
-                lvalid=lmask, rvalid=rmask,
-                build_sorted=self._sorted_build(plan.right_keys[0], rchk))
+            bs = self._sorted_build(plan.right_keys[0], rchk)
+            if stream:
+                li, ri = stream_match(
+                    kernels.unique_join_match, lk, lnull,
+                    lchk.full_rows(), lmask, (rk, rnull),
+                    rchk.full_rows(), rmask,
+                    outer=(plan.tp == "left"), build_sorted=bs)
+            else:
+                li, ri = kernels.unique_join_match(
+                    (lk, lnull), lchk.full_rows(), (rk, rnull),
+                    rchk.full_rows(), outer=(plan.tp == "left"),
+                    lvalid=lmask, rvalid=rmask, build_sorted=bs)
         elif left_unique and plan.tp == "inner":
-            ri, li = kernels.unique_join_match(
-                (rk, rnull), rchk.full_rows(), (lk, lnull),
-                lchk.full_rows(), outer=False,
-                lvalid=rmask, rvalid=lmask,
-                build_sorted=self._sorted_build(plan.left_keys[0], lchk))
+            bs = self._sorted_build(plan.left_keys[0], lchk)
+            if stream:
+                ri, li = stream_match(
+                    kernels.unique_join_match, rk, rnull,
+                    rchk.full_rows(), rmask, (lk, lnull),
+                    lchk.full_rows(), lmask, outer=False,
+                    build_sorted=bs)
+            else:
+                ri, li = kernels.unique_join_match(
+                    (rk, rnull), rchk.full_rows(), (lk, lnull),
+                    lchk.full_rows(), outer=False,
+                    lvalid=rmask, rvalid=lmask, build_sorted=bs)
+        elif stream:
+            li, ri = stream_match(
+                kernels.join_match, lk, lnull, lchk.full_rows(), lmask,
+                (rk, rnull), rchk.full_rows(), rmask,
+                outer=(plan.tp == "left"))
         else:
             li, ri = kernels.join_match((lk, lnull), lchk.full_rows(),
                                         (rk, rnull), rchk.full_rows(),
@@ -1333,7 +1393,14 @@ class TPUSortExec(Executor):
                 keys = [(_encode_key(e, chk)[:2]) for e, _ in self.plan.by]
                 keys = [(v, m) for v, m in keys]
                 descs = [d for _, d in self.plan.by]
-                perm = kernels.sort_permutation(keys, descs, n)
+                budget = _block_budget(self.ctx.session_vars)
+                if budget > 0 and n > budget:
+                    # above the device budget a full ORDER BY sorts on
+                    # host (same semantics): whole-key residency would
+                    # violate tidb_device_block_rows
+                    perm = kernels.host_sort_permutation(keys, descs, n)
+                else:
+                    perm = kernels.sort_permutation(keys, descs, n)
                 chk.set_sel(perm)
                 self._out = iter([chk.compact()])
         return next(self._out, None)
@@ -1359,11 +1426,42 @@ class TPUTopNExec(Executor):
                 keys = [(_encode_key(e, chk)[:2]) for e, _ in self.plan.by]
                 descs = [d for _, d in self.plan.by]
                 k = self.plan.offset + self.plan.count
-                perm = kernels.top_k(keys, descs, n, k)
+                budget = _block_budget(self.ctx.session_vars)
+                if budget > 0 and n > budget:
+                    perm = self._blockwise_topk(keys, descs, n, k, budget)
+                else:
+                    perm = kernels.top_k(keys, descs, n, k)
                 sel = perm[self.plan.offset:]
                 chk.set_sel(sel)
                 self._out = iter([chk.compact()] if len(sel) else [])
         return next(self._out, None)
+
+    @staticmethod
+    def _blockwise_topk(keys, descs, n: int, k: int,
+                        budget: int) -> np.ndarray:
+        """Block-wise top-k (SURVEY §5.7; VERDICT r4 next-3): each block
+        of `budget` rows yields its local top-k candidates (device
+        buffers bounded by the block bucket), the carried candidate set
+        merges with each block's winners, and a final top-k over the
+        <= 2k survivors picks the answer — partial TopN state across
+        TIME, the streaming analogue of the reference's per-region TopN
+        (mocktikv/topn.go) merged at the root (task.go:392-452)."""
+        if 2 * k > budget:
+            # the <=2k candidate pools would themselves exceed the device
+            # budget: selection runs fully on host instead
+            return np.asarray(
+                kernels.host_sort_permutation(keys, descs, n)[:k])
+        cand = np.empty(0, dtype=np.int64)
+        for s_ in range(0, n, budget):
+            e_ = min(s_ + budget, n)
+            bkeys = [(v[s_:e_], m[s_:e_]) for v, m in keys]
+            ids = np.asarray(kernels.top_k(bkeys, descs, e_ - s_,
+                                           k)) + s_
+            pool = np.concatenate([cand, ids])
+            pkeys = [(v[pool], m[pool]) for v, m in keys]
+            order = np.asarray(kernels.top_k(pkeys, descs, len(pool), k))
+            cand = pool[order]
+        return cand
 
 
 class TPUProjectionExec(Executor):

@@ -1499,6 +1499,15 @@ def _np_lexsort_perm(key_cols, descs, sub: np.ndarray) -> np.ndarray:
     return np.lexsort(ops)
 
 
+def host_sort_permutation(key_cols, descs, n_rows: int) -> np.ndarray:
+    """Full sort permutation computed ON HOST (numpy lexsort with the
+    device kernel's exact semantics): the budget-respecting path for
+    tables above tidb_device_block_rows, where uploading every sort key
+    whole would violate the device memory budget."""
+    return _np_lexsort_perm(key_cols, descs,
+                            np.arange(n_rows, dtype=np.int64))
+
+
 def _topk_multi(key_cols, descs, n_rows: int, k: int):
     """Multi-key top-k via primary-key threshold selection: rows scoring
     at or above the k-th primary score are a SUPERSET of the true top-k
