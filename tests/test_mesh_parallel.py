@@ -285,3 +285,67 @@ def test_mesh_topn_distributed(join_tk):
     after = {k for k in devpipe.COMPILED_NODE_KEYS
              if k and k[0] == "order_mesh"}
     assert after - before, "distributed TopN kernel never compiled"
+
+
+def test_mesh_join_strategy_cost_based(join_tk, monkeypatch):
+    """Broadcast-vs-shuffle is a PLANNER cost decision (estRows x width
+    x mesh size — the task.go:146 GetCost pattern), not a knob: a small
+    build side broadcasts, a build side comparable to the probe side
+    shuffles, and EXPLAIN surfaces the choice (golden plan shape).  The
+    tidb_broadcast_build_max_rows knob still wins when set away from its
+    default."""
+    join_tk.execute("set @@tidb_mesh_parallel = 1")
+
+    def plan_line(sql, op="HashJoin"):
+        rows = join_tk.query("explain " + sql).rows
+        return next(r for r in rows if op in r[0])
+
+    # small dim build (150 est rows) against the 4096-row probe:
+    # broadcast_bytes = rb*wb*8 << shuffle volume -> broadcast
+    small = plan_line("select big.a, dim.v from big join dim "
+                      "on big.fk = dim.k")
+    assert "mesh:broadcast" in small[3], small
+
+    # self-join: build side as big as the probe side -> replicating it
+    # 8x costs more than one all_to_all pass -> shuffle
+    big = plan_line("select t1.a from big t1 join big t2 on t1.fk = t2.a")
+    assert "mesh:shuffle" in big[3], big
+
+    # left-unique inner join: the EXECUTOR builds on the LEFT (unique
+    # dim), and the cost model must price that side — tiny unique build
+    # broadcasts even though the right child is the big table
+    lu = plan_line("select dim.v, big.a from dim join big "
+                   "on dim.k = big.fk")
+    assert "mesh:broadcast" in lu[3], lu
+
+    # execution still matches single-device under the cost-based choice
+    q = ("select big.a, dim.v from big join dim on big.fk = dim.k "
+         "where big.x < 5 order by big.a limit 20")
+    sharded = join_tk.query(q).rows
+    join_tk.execute("set @@tidb_mesh_parallel = 0")
+    single = join_tk.query(q).rows
+    assert sharded == single
+
+    # knob override: forcing the budget to 0 turns the broadcast-shaped
+    # join into a shuffle at EXECUTION time regardless of plan strategy
+    join_tk.execute("set @@tidb_mesh_parallel = 1")
+    join_tk.execute("set @@tidb_broadcast_build_max_rows = 0")
+    from tinysql_tpu.executor import devpipe
+    calls = []
+    orig = devpipe._JoinNode._prepare_unique_shuffle
+
+    def spy(self, pb, btv, ptv, mesh):
+        calls.append(getattr(self.plan, "mesh_strategy", None))
+        return orig(self, pb, btv, ptv, mesh)
+    monkeypatch.setattr(devpipe._JoinNode, "_prepare_unique_shuffle", spy)
+    forced = join_tk.query("select big.a, dim.v from big join dim "
+                           "on big.fk = dim.k where big.x >= 9 "
+                           "order by big.a limit 5").rows
+    # the knob forced the shuffle path even though the PLAN said broadcast
+    assert calls and calls[0] == "broadcast", calls
+    join_tk.execute("set @@tidb_broadcast_build_max_rows = 1048576")
+    join_tk.execute("set @@tidb_mesh_parallel = 0")
+    single = join_tk.query("select big.a, dim.v from big join dim "
+                           "on big.fk = dim.k where big.x >= 9 "
+                           "order by big.a limit 5").rows
+    assert forced == single

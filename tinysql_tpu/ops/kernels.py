@@ -1480,14 +1480,16 @@ def _primary_score(key, desc: bool, n_rows: int):
     return None
 
 
-def _np_lexsort_perm(key_cols, descs, sub: np.ndarray) -> np.ndarray:
-    """numpy twin of _sort_kernel over the row subset `sub`: same operand
-    order, same NULL first/last semantics, stable — restricted to a
-    candidate subset it reproduces the full sort's relative order."""
+def _np_lexsort_perm(key_cols, descs, sub=None) -> np.ndarray:
+    """numpy twin of _sort_kernel over the row subset `sub` (None = all
+    rows, no subset copies): same operand order, same NULL first/last
+    semantics, stable — restricted to a candidate subset it reproduces
+    the full sort's relative order."""
     ops = []
     for i in range(len(key_cols) - 1, -1, -1):
         v, m = key_cols[i]
-        v, m = v[sub], m[sub]
+        if sub is not None:
+            v, m = v[sub], m[sub]
         vv = np.where(m, 0, v)
         if descs[i]:
             vv = ~vv if vv.dtype == np.int64 else -vv
@@ -1504,8 +1506,8 @@ def host_sort_permutation(key_cols, descs, n_rows: int) -> np.ndarray:
     device kernel's exact semantics): the budget-respecting path for
     tables above tidb_device_block_rows, where uploading every sort key
     whole would violate the device memory budget."""
-    return _np_lexsort_perm(key_cols, descs,
-                            np.arange(n_rows, dtype=np.int64))
+    keys = [(v[:n_rows], m[:n_rows]) for v, m in key_cols]
+    return _np_lexsort_perm(keys, descs)
 
 
 def _topk_multi(key_cols, descs, n_rows: int, k: int):

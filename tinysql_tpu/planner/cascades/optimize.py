@@ -115,7 +115,7 @@ def _expr_cost(ge: GroupExpr, childs) -> Tuple[float, float]:
 
 
 def find_best_plan(logical: LogicalPlan, tpu: bool = True,
-                   tpu_min_rows: float = 0.0):
+                   tpu_min_rows: float = 0.0, mesh_shards: int = 0):
     """Full cascades pipeline: pre-normalization -> memo -> explore ->
     implement -> shared physical tail (reference: Optimize/FindBestPlan
     optimize.go:105; the pre-passes mirror the System-R rewrites whose
@@ -131,5 +131,6 @@ def find_best_plan(logical: LogicalPlan, tpu: bool = True,
     _, _, tree = implement(root)
     phys = to_physical(tree)
     phys = derive_stats(phys)
-    phys = place_devices(phys, enabled=tpu, min_rows=tpu_min_rows)
+    phys = place_devices(phys, enabled=tpu, min_rows=tpu_min_rows,
+                         mesh_shards=mesh_shards)
     return push_to_cop(phys)

@@ -59,14 +59,48 @@ def _input_rows(p: PhysicalPlan) -> float:
     return max(c.stats_row_count for c in p.children)
 
 
+def _mesh_join_strategy(p: PhysicalHashJoin, n_shards: int) -> None:
+    """estRows-driven broadcast-vs-shuffle cost compare for mesh joins
+    (reference GetCost pattern, planner/core/task.go:146; VERDICT r4
+    next-4): broadcasting replicates the build side to every shard
+    (bytes x n_shards over ICI), shuffling moves each row of BOTH sides
+    exactly once (all_to_all).  ANALYZE stats feed the row estimates
+    through derive_stats; tidb_broadcast_build_max_rows remains a manual
+    override at execution time.
+
+    The build side mirrors the EXECUTOR's choice (devpipe _JoinNode
+    compile / tpu_executors probe_side): left only when left-unique inner
+    and not right-unique; right otherwise."""
+    build_side = (0 if (getattr(p, "left_unique", False)
+                        and p.tp == "inner"
+                        and not getattr(p, "right_unique", False)
+                        and len(p.left_keys) == 1)
+                  else 1)
+    build = p.children[build_side]
+    probe = p.children[1 - build_side]
+    rb = max(getattr(build, "stats_row_count", 0.0), 1.0)
+    rp = max(getattr(probe, "stats_row_count", 0.0), 1.0)
+    wb = 8.0 * max(len(build.schema.columns), 1)
+    wp = 8.0 * max(len(probe.schema.columns), 1)
+    broadcast_bytes = rb * wb * n_shards
+    shuffle_bytes = rb * wb + rp * wp
+    p.mesh_cost = {"broadcast_bytes": broadcast_bytes,
+                   "shuffle_bytes": shuffle_bytes}
+    p.mesh_strategy = ("shuffle" if shuffle_bytes < broadcast_bytes
+                       else "broadcast")
+
+
 def place_devices(p: PhysicalPlan, enabled: bool = True,
-                  min_rows: float = 0.0) -> PhysicalPlan:
+                  min_rows: float = 0.0,
+                  mesh_shards: int = 0) -> PhysicalPlan:
     """Decide placement per operator: CAPABILITY (kernel expressible) AND
     COST (estimated input rows >= min_rows — an XLA compile is never worth
     it for a handful of rows; reference task.go prices the cop/root
-    boundary the same way, tidb_tpu_min_rows carries the threshold)."""
+    boundary the same way, tidb_tpu_min_rows carries the threshold).
+    With a live mesh (mesh_shards >= 2) joins additionally get a
+    broadcast-vs-shuffle strategy from the cost model."""
     for c in p.children:
-        place_devices(c, enabled, min_rows)
+        place_devices(c, enabled, min_rows, mesh_shards)
     if not enabled:
         return p
     big = _input_rows(p) >= min_rows
@@ -95,6 +129,8 @@ def place_devices(p: PhysicalPlan, enabled: bool = True,
                      and ((len(p.left_keys) == 1
                            and _pair_ok(p.left_keys[0], p.right_keys[0]))
                           or multi_ok))
+        if p.use_tpu and mesh_shards >= 2:
+            _mesh_join_strategy(p, mesh_shards)
     elif isinstance(p, (PhysicalSort, PhysicalTopN)):
         p.use_tpu = big and all(_key_ok(e) for e, _ in p.by)
     elif isinstance(p, PhysicalProjection):

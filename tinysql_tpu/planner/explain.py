@@ -54,7 +54,9 @@ def _info(p: PhysicalPlan) -> str:
     if isinstance(p, PhysicalHashJoin):
         keys = ",".join(f"{l.key()}={r.key()}" for l, r in
                         zip(p.left_keys, p.right_keys)) or "CARTESIAN"
-        return f"{p.tp} join, equal:[{keys}]"
+        mesh = getattr(p, "mesh_strategy", None)
+        mesh = f", mesh:{mesh}" if mesh else ""
+        return f"{p.tp} join, equal:[{keys}]{mesh}"
     if isinstance(p, (PhysicalSort, PhysicalTopN)):
         by = ",".join(f"{e.key()}{' desc' if d else ''}" for e, d in p.by)
         extra = (f", offset:{p.offset}, count:{p.count}"

@@ -461,17 +461,20 @@ def normalize_logical(logical: LogicalPlan,
 
 
 def optimize(logical: LogicalPlan, tpu: bool = True,
-             tpu_min_rows: float = 0.0) -> PhysicalPlan:
+             tpu_min_rows: float = 0.0,
+             mesh_shards: int = 0) -> PhysicalPlan:
     """The System-R style pipeline (reference: planner/core/optimizer.go:77
     — the fixed-order rewrite list of optimizer.go:44-55), physical
     conversion, estimate derivation, then the device enforcer (cost+
-    capability) + coprocessor pushdown."""
+    capability, incl. the mesh broadcast-vs-shuffle join strategy) +
+    coprocessor pushdown."""
     logical = normalize_logical(logical)
     logical = topn_pushdown(logical)
     phys = to_physical(logical)
     from .derive_stats import derive_stats
     phys = derive_stats(phys)
     from .device import place_devices
-    phys = place_devices(phys, enabled=tpu, min_rows=tpu_min_rows)
+    phys = place_devices(phys, enabled=tpu, min_rows=tpu_min_rows,
+                         mesh_shards=mesh_shards)
     from .cop import push_to_cop
     return push_to_cop(phys)

@@ -358,11 +358,22 @@ class Session:
         """Route between the two optimizer frameworks (reference:
         planner/optimize.go:29-56 EnableCascadesPlanner switch)."""
         min_rows = float(self.get_sysvar("tidb_tpu_min_rows") or 0)
+        shards = 0
+        if use_tpu and bool(self.get_sysvar("tidb_mesh_parallel")):
+            # mesh size feeds the planner's broadcast-vs-shuffle join
+            # cost compare (device.py _mesh_join_strategy)
+            try:
+                from ..ops import kernels
+                shards = len(kernels.jax().devices())
+            except Exception:
+                shards = 0
         if bool(self.get_sysvar("tidb_enable_cascades_planner")):
             from ..planner.cascades import find_best_plan
             return find_best_plan(logical, tpu=use_tpu,
-                                  tpu_min_rows=min_rows)
-        return optimize(logical, tpu=use_tpu, tpu_min_rows=min_rows)
+                                  tpu_min_rows=min_rows,
+                                  mesh_shards=shards)
+        return optimize(logical, tpu=use_tpu, tpu_min_rows=min_rows,
+                        mesh_shards=shards)
 
     def _run_select_plan(self, stmt: ast.SelectStmt, txn) -> List[list]:
         builder = PlanBuilder(self)
