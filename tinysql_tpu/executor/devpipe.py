@@ -1062,8 +1062,12 @@ class _JoinNode:
         if thresh != default:
             return nbb > thresh  # explicit knob override
         strategy = getattr(self.plan, "mesh_strategy", None)
-        if strategy is not None:
-            return strategy == "shuffle"
+        if strategy == "shuffle":
+            return True
+        # a plan-time "broadcast" stays subject to the RUNTIME budget:
+        # estRows can be stale while nbb is the actual build bucket —
+        # replicating an unexpectedly-huge build side to every shard is
+        # the memory blow-up the budget protects against
         return nbb > thresh
 
     def _prepare_unique_shuffle(self, pb, btv, ptv, mesh) \

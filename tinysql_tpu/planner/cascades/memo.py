@@ -33,7 +33,7 @@ class GroupExpr:
 
 
 class Group:
-    __slots__ = ("exprs", "_fps", "schema", "explored", "best")
+    __slots__ = ("exprs", "_fps", "schema", "explored", "best", "impl")
 
     def __init__(self, schema):
         self.exprs: List[GroupExpr] = []
@@ -42,6 +42,9 @@ class Group:
         self.explored = False
         # implementation winner: (cost, est_rows, logical tree)
         self.best: Optional[Tuple[float, float, LogicalPlan]] = None
+        # PHYSICAL winners per required order property:
+        # {prop tuple: (cost, est_rows, PhysicalPlan)} (implementation.py)
+        self.impl: Dict[tuple, tuple] = {}
 
     def insert(self, ge: GroupExpr) -> bool:
         fp = ge.fingerprint()

@@ -128,8 +128,19 @@ def find_best_plan(logical: LogicalPlan, tpu: bool = True,
     memo = Memo()
     root = memo.build(logical)
     explore(memo, root)
-    _, _, tree = implement(root)
-    phys = to_physical(tree)
+    try:
+        # cascades' OWN implementation phase: physical candidates +
+        # enforcers with per-group cost winners (implementation.py) — the
+        # framework can pick different physical operators than System-R
+        from .implementation import implement_group
+        phys = implement_group(root, ())[2]
+    except NotImplementedError:
+        # operator shapes outside the implementation rules (mem-tables,
+        # exotic ops): logical winner + the shared physical tail.
+        # Genuine bugs in the implementation phase propagate — a silent
+        # System-R downgrade would mask them.
+        _, _, tree = implement(root)
+        phys = to_physical(tree)
     phys = derive_stats(phys)
     phys = place_devices(phys, enabled=tpu, min_rows=tpu_min_rows,
                          mesh_shards=mesh_shards)

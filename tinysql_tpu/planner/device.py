@@ -86,7 +86,13 @@ def _mesh_join_strategy(p: PhysicalHashJoin, n_shards: int) -> None:
     shuffle_bytes = rb * wb + rp * wp
     p.mesh_cost = {"broadcast_bytes": broadcast_bytes,
                    "shuffle_bytes": shuffle_bytes}
-    p.mesh_strategy = ("shuffle" if shuffle_bytes < broadcast_bytes
+    # a build side estimated above the per-device broadcast budget never
+    # broadcasts regardless of relative cost — replicating it to every
+    # shard is the memory blow-up the budget exists to prevent (and the
+    # executor re-checks against the ACTUAL runtime row count)
+    over_budget = rb > float(1 << 20)
+    p.mesh_strategy = ("shuffle" if over_budget
+                       or shuffle_bytes < broadcast_bytes
                        else "broadcast")
 
 

@@ -298,6 +298,80 @@ def _unique_on(side: LogicalPlan, key_uids: Set[int], n_keys: int) -> bool:
     return False
 
 
+# ---- physical construction helpers (shared implementation rules) ---------
+# Both optimizer frameworks build physical operators through these — the
+# System-R tail calls them from to_physical, the cascades implementation
+# phase calls them per memo group with its own child winners (reference:
+# implementation_rules.go builds the same physical ops both ways).
+
+def phys_selection(p: LogicalSelection, child: PhysicalPlan) -> PhysicalPlan:
+    return PhysicalSelection(_bind(p.conditions, child.schema), child)
+
+
+def phys_projection(p: LogicalProjection, child: PhysicalPlan) -> PhysicalPlan:
+    return PhysicalProjection(_bind(p.exprs, child.schema), p.schema, child)
+
+
+def phys_aggregation(p: LogicalAggregation,
+                     child: PhysicalPlan) -> PhysicalPlan:
+    gb = _bind(p.group_by, child.schema)
+    aggs = []
+    for d in p.agg_funcs:
+        d2 = d.clone()
+        d2.args = _bind(d.args, child.schema)
+        aggs.append(d2)
+    # map each schema column to ('agg', i) or ('gb', i)
+    output_map: List[Tuple[str, int]] = []
+    for c in p.schema.columns:
+        for i, oc in enumerate(getattr(p, "output_cols", [])):
+            if oc.unique_id == c.unique_id:
+                output_map.append(("agg", i))
+                break
+        else:
+            for i, gc in enumerate(getattr(p, "gb_out_cols", [])):
+                if gc.unique_id == c.unique_id:
+                    output_map.append(("gb", i))
+                    break
+            else:
+                raise PlanError(f"agg schema column {c!r} unmapped")
+    agg = PhysicalHashAgg(gb, aggs, p.schema, child, [])
+    agg.output_map = output_map
+    return agg
+
+
+def phys_join(p: LogicalJoin, left: PhysicalPlan, right: PhysicalPlan,
+              cls=PhysicalHashJoin) -> PhysicalPlan:
+    join = cls(p.tp, left, right, p.schema)
+    join.left_keys = _bind([a for a, _ in p.eq_conditions], left.schema)
+    join.right_keys = _bind([b for _, b in p.eq_conditions], right.schema)
+    # key-uniqueness per side (reference: schema key info feeding the
+    # join executors): unlocks the expansion-free unique-build probe
+    join.left_unique = _unique_on(
+        p.children[0], {a.unique_id for a, _ in p.eq_conditions
+                        if isinstance(a, Column)},
+        len(p.eq_conditions))
+    join.right_unique = _unique_on(
+        p.children[1], {b.unique_id for _, b in p.eq_conditions
+                        if isinstance(b, Column)},
+        len(p.eq_conditions))
+    join.other_conditions = _bind(p.other_conditions, p.schema)
+    # leftover one-side conds (outer joins keep them at the join)
+    join.left_conditions = _bind(p.left_conditions, left.schema)
+    join.right_conditions = _bind(p.right_conditions, right.schema)
+    return join
+
+
+def phys_datasource(p: LogicalDataSource, order_hint=None) -> PhysicalPlan:
+    with_handle = any(c.name == HANDLE_COL_NAME for c in p.schema.columns)
+    from .access import build_reader
+    stats = None
+    storage = getattr(p, "storage", None)
+    if storage is not None:
+        from ..statistics.table_stats import load_stats
+        stats = load_stats(storage, p.table_info.id)
+    return build_reader(p, stats, with_handle, order_hint)
+
+
 def to_physical(p: LogicalPlan,
                 order_hint=None) -> PhysicalPlan:
     """`order_hint`: the sort property a parent Sort/TopN requires —
@@ -306,17 +380,10 @@ def to_physical(p: LogicalPlan,
     required PhysicalProperty; enforcer_rules.go adds the Sort only when
     the child can't provide it)."""
     if isinstance(p, LogicalDataSource):
-        with_handle = any(c.name == HANDLE_COL_NAME for c in p.schema.columns)
-        from .access import build_reader
-        stats = None
-        storage = getattr(p, "storage", None)
-        if storage is not None:
-            from ..statistics.table_stats import load_stats
-            stats = load_stats(storage, p.table_info.id)
-        return build_reader(p, stats, with_handle, order_hint)
+        return phys_datasource(p, order_hint)
     if isinstance(p, LogicalSelection):
         child = to_physical(p.child(0), order_hint)
-        return PhysicalSelection(_bind(p.conditions, child.schema), child)
+        return phys_selection(p, child)
     if isinstance(p, LogicalProjection):
         # projections forward the hint when the ordered columns are
         # identity outputs (their source order survives)
@@ -326,32 +393,9 @@ def to_physical(p: LogicalPlan,
             if all(uid in ident for uid, _ in order_hint):
                 hint = order_hint
         child = to_physical(p.child(0), hint)
-        return PhysicalProjection(_bind(p.exprs, child.schema), p.schema, child)
+        return phys_projection(p, child)
     if isinstance(p, LogicalAggregation):
-        child = to_physical(p.child(0))
-        gb = _bind(p.group_by, child.schema)
-        aggs = []
-        for d in p.agg_funcs:
-            d2 = d.clone()
-            d2.args = _bind(d.args, child.schema)
-            aggs.append(d2)
-        # map each schema column to ('agg', i) or ('gb', i)
-        output_map: List[Tuple[str, int]] = []
-        for c in p.schema.columns:
-            for i, oc in enumerate(getattr(p, "output_cols", [])):
-                if oc.unique_id == c.unique_id:
-                    output_map.append(("agg", i))
-                    break
-            else:
-                for i, gc in enumerate(getattr(p, "gb_out_cols", [])):
-                    if gc.unique_id == c.unique_id:
-                        output_map.append(("gb", i))
-                        break
-                else:
-                    raise PlanError(f"agg schema column {c!r} unmapped")
-        agg = PhysicalHashAgg(gb, aggs, p.schema, child, [])
-        agg.output_map = output_map
-        return agg
+        return phys_aggregation(p, to_physical(p.child(0)))
     if isinstance(p, LogicalJoin):
         left = to_physical(p.children[0])
         right = to_physical(p.children[1])
@@ -361,24 +405,7 @@ def to_physical(p: LogicalPlan,
             mark_keep_order(left)
             mark_keep_order(right)
         cls = PhysicalMergeJoin if merge_ok else PhysicalHashJoin
-        join = cls(p.tp, left, right, p.schema)
-        join.left_keys = _bind([a for a, _ in p.eq_conditions], left.schema)
-        join.right_keys = _bind([b for _, b in p.eq_conditions], right.schema)
-        # key-uniqueness per side (reference: schema key info feeding the
-        # join executors): unlocks the expansion-free unique-build probe
-        join.left_unique = _unique_on(
-            p.children[0], {a.unique_id for a, _ in p.eq_conditions
-                            if isinstance(a, Column)},
-            len(p.eq_conditions))
-        join.right_unique = _unique_on(
-            p.children[1], {b.unique_id for _, b in p.eq_conditions
-                            if isinstance(b, Column)},
-            len(p.eq_conditions))
-        join.other_conditions = _bind(p.other_conditions, p.schema)
-        # leftover one-side conds (outer joins keep them at the join)
-        join.left_conditions = _bind(p.left_conditions, left.schema)
-        join.right_conditions = _bind(p.right_conditions, right.schema)
-        return join
+        return phys_join(p, left, right, cls)
     if isinstance(p, LogicalSort):
         from .props import (mark_keep_order, provided_order, required_of,
                             satisfies)
