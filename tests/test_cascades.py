@@ -172,3 +172,16 @@ def test_cascades_implementation_divergence(tk):
     # identical results either way
     assert sorted(map(tuple, r1s)) == sorted(map(tuple, r1c))
     assert r2s == r2c
+
+
+def test_cascades_order_never_pushes_below_limit(tk):
+    """An ORDER BY above a LIMIT must sort the limit's OUTPUT — pushing
+    the order requirement below the limit would change which rows
+    survive it (reference ImplLimit matches only the empty property)."""
+    q = "select x.a from (select a from t limit 3) x order by x.a desc"
+    tk.execute("set @@tidb_enable_cascades_planner = 0")
+    sysr = tk.query(q).rows
+    tk.execute("set @@tidb_enable_cascades_planner = 1")
+    casc = tk.query(q).rows
+    tk.execute("set @@tidb_enable_cascades_planner = 0")
+    assert sysr == casc, (sysr, casc)

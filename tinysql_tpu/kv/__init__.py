@@ -10,6 +10,8 @@ from .rpc import RPCClient, RegionCache, RegionCtx
 from .backoff import Backoffer
 from .txn import (Transaction, Snapshot, TwoPhaseCommitter, LockResolver,
                   TiKVStorage, new_mock_storage)
+from .rawkv import RawKVClient, RawStore
+from .range_task import RangeTaskRunner, RangeTaskStat
 
 __all__ = [
     "KVError", "KeyNotFound", "KeyExists", "KeyIsLocked", "WriteConflict",
@@ -20,4 +22,5 @@ __all__ = [
     "Cluster", "Region", "Store", "RPCClient", "RegionCache", "RegionCtx",
     "Backoffer", "Transaction", "Snapshot", "TwoPhaseCommitter",
     "LockResolver", "TiKVStorage", "new_mock_storage",
+    "RawKVClient", "RawStore", "RangeTaskRunner", "RangeTaskStat",
 ]

@@ -116,6 +116,40 @@ class RPCClient:
             raise RuntimeError("no coprocessor handler installed")
         return self.cop_handler(r, req)
 
+    # ---- raw commands (non-transactional CF; reference rawkv.go) -------
+    @property
+    def raw(self):
+        """Lazily-attached raw column family (rawkv.RawStore)."""
+        rs = getattr(self, "_raw", None)
+        if rs is None:
+            from .rawkv import RawStore
+            rs = self._raw = RawStore()
+        return rs
+
+    def raw_get(self, ctx: RegionCtx, key: bytes):
+        self._check(ctx, keys=[key])
+        return self.raw.get(key)
+
+    def raw_put(self, ctx: RegionCtx, key: bytes, value: bytes) -> None:
+        self._check(ctx, keys=[key])
+        self.raw.put(key, value)
+
+    def raw_delete(self, ctx: RegionCtx, key: bytes) -> None:
+        self._check(ctx, keys=[key])
+        self.raw.delete(key)
+
+    def raw_batch_put(self, ctx: RegionCtx, pairs) -> None:
+        self._check(ctx, keys=[k for k, _ in pairs])
+        for k, v in pairs:
+            self.raw.put(k, v)
+
+    def raw_scan(self, ctx: RegionCtx, start: bytes, end: bytes,
+                 limit: int):
+        r = self._check(ctx)
+        s = max(start, r.start) if r.start else start
+        e = min(end, r.end) if (end and r.end) else (end or r.end)
+        return self.raw.scan(s, e, limit)
+
 
 class RegionCache:
     """Client-side key->region routing cache with invalidation

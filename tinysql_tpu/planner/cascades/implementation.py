@@ -52,6 +52,13 @@ JOIN_OUT_F = 0.1
 Impl = Tuple[float, float, PhysicalPlan]  # (cost, est_rows, plan)
 
 
+class NoImplementationRule(NotImplementedError):
+    """Raised when a memo group's operator has no implementation rule —
+    the ONLY signal on which find_best_plan may fall back to the shared
+    System-R tail (a bare NotImplementedError from deeper code must
+    propagate, not silently downgrade the framework)."""
+
+
 def implement_group(group: Group, prop: tuple = ()) -> Impl:
     """Min-cost physical implementation of `group` whose output satisfies
     the required order `prop` ([(unique_id, desc)] tuple) — natively or
@@ -77,7 +84,7 @@ def implement_group(group: Group, prop: tuple = ()) -> Impl:
     if best is None:
         # operator shape outside the implementation rules: the caller
         # (find_best_plan) falls back to the logical winner + shared tail
-        raise NotImplementedError(
+        raise NoImplementationRule(
             f"no implementation rule for {type(group.exprs[0].op).__name__}"
             if group.exprs else "empty group")
     group.impl[key] = best
@@ -205,8 +212,13 @@ def _implementations(ge: GroupExpr, prop: tuple) -> Iterator[Impl]:
         return
 
     if isinstance(op, LogicalLimit):
-        # limits preserve their child's order
-        ccost, crows, child = implement_group(ge.children[0], prop)
+        # ONLY the empty property (reference ImplLimit): pushing a
+        # required order BELOW a limit would change which rows survive
+        # it — an ORDER BY above a LIMIT must sort the limit's output
+        # (the enforcer), never reorder its input
+        if prop:
+            return
+        ccost, crows, child = implement_group(ge.children[0], ())
         n = float(op.offset + op.count)
         yield (ccost, min(crows, n),
                PhysicalLimit(op.offset, op.count, child))

@@ -132,9 +132,9 @@ def find_best_plan(logical: LogicalPlan, tpu: bool = True,
         # cascades' OWN implementation phase: physical candidates +
         # enforcers with per-group cost winners (implementation.py) — the
         # framework can pick different physical operators than System-R
-        from .implementation import implement_group
+        from .implementation import NoImplementationRule, implement_group
         phys = implement_group(root, ())[2]
-    except NotImplementedError:
+    except NoImplementationRule:
         # operator shapes outside the implementation rules (mem-tables,
         # exotic ops): logical winner + the shared physical tail.
         # Genuine bugs in the implementation phase propagate — a silent

@@ -89,8 +89,11 @@ def _mesh_join_strategy(p: PhysicalHashJoin, n_shards: int) -> None:
     # a build side estimated above the per-device broadcast budget never
     # broadcasts regardless of relative cost — replicating it to every
     # shard is the memory blow-up the budget exists to prevent (and the
-    # executor re-checks against the ACTUAL runtime row count)
-    over_budget = rb > float(1 << 20)
+    # executor re-checks against the ACTUAL runtime row count).  One
+    # definition of the budget: the sysvar default.
+    from ..session.session import DEFAULT_SYSVARS
+    over_budget = rb > float(
+        DEFAULT_SYSVARS["tidb_broadcast_build_max_rows"])
     p.mesh_strategy = ("shuffle" if over_budget
                        or shuffle_bytes < broadcast_bytes
                        else "broadcast")
