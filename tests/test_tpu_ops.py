@@ -328,3 +328,22 @@ def test_topk_multi_key_matches_full_sort():
     full = kernels.sort_permutation([(ae, zm), (c, cm)],
                                     [False, False], n)[:10]
     assert np.array_equal(np.asarray(ids), np.asarray(full))
+
+
+def test_np_unique_join_float_keys():
+    """Float (and mixed-cast) join keys must take the searchsorted
+    branch — range addressing over float keys crashed (r5 review)."""
+    import numpy as np
+    from tinysql_tpu.ops import kernels
+    rng = np.random.default_rng(4)
+    lk = np.round(rng.random(5000) * 50, 1)
+    ln = rng.random(5000) < 0.05
+    rk = np.unique(np.round(rng.random(300) * 50, 1))
+    rn = np.zeros(len(rk), dtype=bool)
+    li, ri = kernels._np_unique_join(
+        lk, ln, np.ones(5000, bool), rk, rn, np.ones(len(rk), bool),
+        False)
+    for a, b in zip(li.tolist(), ri.tolist()):
+        assert not ln[a] and lk[a] == rk[b]
+    want = sum(1 for i in range(5000) if not ln[i] and lk[i] in set(rk))
+    assert len(li) == want

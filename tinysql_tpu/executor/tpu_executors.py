@@ -1355,6 +1355,14 @@ class TPUHashJoinExec(Executor):
         if rep is not None and isinstance(key_expr, ExprColumn):
             child = self.children[side]
             if isinstance(child, TableReaderExec):
+                try:
+                    host_backend = kernels.jax().default_backend() == "cpu"
+                except Exception:
+                    host_backend = False
+                if host_backend:
+                    # host keys: the raw replica views are free and the
+                    # numpy match twin beats XLA:CPU's kernels
+                    return key_expr.vec_eval(chk)
                 ci = child._decode_cols[key_expr.index]
                 sid = ci.id if ci is not None else "handle"
                 nb = kernels.bucket(max(chk.full_rows(), 1))
