@@ -92,7 +92,7 @@ class _Gen:
                 if r.random() < 0.4:
                     limit += f" offset {r.randint(0, 10)}"
             return f"select a, {exprs} from t{where}{order}{limit}"
-        if shape < 0.8:  # aggregate (+ HAVING sometimes)
+        if shape < 0.78:  # aggregate (+ HAVING sometimes)
             gb = r.choice(["b", "d", "b, d", ""])
             aggs = ", ".join(r.choice(
                 ["count(*)", "count(b)", "count(d)", "sum(b)", "sum(c)",
@@ -105,7 +105,7 @@ class _Gen:
                 return (f"select {gb}, {aggs} from t{where} "
                         f"group by {gb}{having} order by {gb}")
             return f"select {aggs} from t{where}"
-        if shape < 0.92:  # join
+        if shape < 0.88:  # join
             cond = r.choice(["t.b = u.k", "t.a = u.k"])
             jt = r.choice(["join", "left join"])
             # one-side ON conjuncts: for LEFT JOIN an outer-side cond
@@ -116,6 +116,17 @@ class _Gen:
                      "t.c is not null"])
             return (f"select t.a, u.v from t {jt} u on {cond}{where} "
                     f"order by t.a, u.v")
+        if shape < 0.92:  # join over an aggregate subquery: the
+            # device-passthrough shape (agg output consumed by the join
+            # above it stays device-resident; sorted-build fast path)
+            agg = r.choice(["sum(c)", "count(*)", "avg(c)", "max(c)",
+                            "min(b)"])
+            jt = r.choice(["join", "left join"])
+            ob = r.choice(["order by 1, 2", "order by 2, 1"])
+            lim = f" limit {r.randint(1, 15)}" if r.random() < 0.4 else ""
+            return (f"select u.v, f.s from u {jt} "
+                    f"(select b, {agg} as s from t group by b) f "
+                    f"on u.k = f.b {ob}{lim}")
         if shape < 0.96:  # multi-key equi-join (composite device lanes)
             dim = r.choice(["w", "w", "wd"])  # unique and duplicated
             jt = r.choice(["join", "left join"])
