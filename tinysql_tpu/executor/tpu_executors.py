@@ -1190,11 +1190,20 @@ class TPUHashJoinExec(Executor):
         probe_chk = lchk if probe_side == 0 else rchk
         stream = budget > 0 and probe_chk.full_rows() > budget
 
+        # numpy twins exist only for the UNIQUE join branches: route keys
+        # to host just when one of those will run (kernels.host_kernels_ok
+        # honors TINYSQL_DEVICE_JOIN_ONLY); the generic join_match path
+        # keeps its device-resident/memoized keys
+        host_keys = (kernels.host_kernels_ok()
+                     and (right_unique
+                          or (left_unique and plan.tp == "inner")))
+
         def keys_of(side, expr, chk, rep):
             if stream and side == probe_side:
                 v, m = expr.vec_eval(chk)  # host: no full-column upload
                 return np.asarray(v), np.asarray(m)
-            return self._key_arrays(expr, chk, rep, side)
+            return self._key_arrays(expr, chk, rep, side,
+                                    host_keys=host_keys)
 
         lk, lnull = keys_of(0, plan.left_keys[0], lchk, lrep)
         rk, rnull = keys_of(1, plan.right_keys[0], rchk, rrep)
@@ -1340,28 +1349,28 @@ class TPUHashJoinExec(Executor):
         return (isinstance(col, DeviceColumn) and col._data is None
                 and col.sorted_live)
 
-    def _key_arrays(self, key_expr, chk, rep, side):
+    def _key_arrays(self, key_expr, chk, rep, side, host_keys=False):
         """Join key (values, null) — for a bare Column over an uncompacted
         replica, PADDED DEVICE arrays memoized on the replica (no re-upload
         per query); device-resident for a DeviceColumn child (an aggregate
-        output that never landed on host); numpy otherwise."""
+        output that never landed on host); numpy otherwise.  `host_keys`
+        (a unique-join on the CPU backend) lands keys on host instead —
+        XLA:CPU "device" buffers are host memory, so landing is a memcpy
+        and the numpy match twin beats the serial device kernels."""
         from ..chunk import DeviceColumn
         from ..expression import Column as ExprColumn
         from .executors import TableReaderExec
         if isinstance(key_expr, ExprColumn):
             col = chk.columns[key_expr.index]
             if isinstance(col, DeviceColumn) and col._data is None:
+                if host_keys:
+                    return key_expr.vec_eval(chk)
                 return col.device_pair()
         if rep is not None and isinstance(key_expr, ExprColumn):
             child = self.children[side]
             if isinstance(child, TableReaderExec):
-                try:
-                    host_backend = kernels.jax().default_backend() == "cpu"
-                except Exception:
-                    host_backend = False
-                if host_backend:
-                    # host keys: the raw replica views are free and the
-                    # numpy match twin beats XLA:CPU's kernels
+                if host_keys:
+                    # the raw replica views are free on host
                     return key_expr.vec_eval(chk)
                 ci = child._decode_cols[key_expr.index]
                 sid = ci.id if ci is not None else "handle"

@@ -1306,6 +1306,20 @@ def _unique_pick_kernel(ob: int, nlb: int, outer: bool):
     return counted_jit(kernel), schema
 
 
+def host_kernels_ok() -> bool:
+    """True when numpy kernel twins should serve host-array inputs: the
+    XLA:CPU backend (where device sort/searchsorted run serially) and no
+    TINYSQL_DEVICE_JOIN_ONLY override (tests force the device kernels
+    with it).  The ONE definition every host-vs-device routing decision
+    shares."""
+    if os.environ.get("TINYSQL_DEVICE_JOIN_ONLY"):
+        return False
+    try:
+        return jax().default_backend() == "cpu"
+    except Exception:
+        return False
+
+
 def _np_unique_join(lk, ln, lv, rk, rn, rv, outer: bool):
     """Host twin of the unique-join kernel (same li/ri contract and tie
     semantics): on XLA:CPU the device sort+searchsorted runs serially
@@ -1372,8 +1386,7 @@ def unique_join_match(lkey, n_left: int, rkey, n_right: int,
     (TINYSQL_DEVICE_JOIN_ONLY=1 forces the device kernels, e.g. to
     exercise block-streaming device economics in tests)."""
     if (isinstance(lkey[0], np.ndarray) and isinstance(rkey[0], np.ndarray)
-            and jax().default_backend() == "cpu"
-            and not os.environ.get("TINYSQL_DEVICE_JOIN_ONLY")):
+            and host_kernels_ok()):
         lv = np.ones(n_left, dtype=bool) if lvalid is None \
             else np.asarray(lvalid[:n_left], dtype=bool)
         rv = np.ones(n_right, dtype=bool) if rvalid is None \
