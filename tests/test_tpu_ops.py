@@ -347,3 +347,61 @@ def test_np_unique_join_float_keys():
         assert not ln[a] and lk[a] == rk[b]
     want = sum(1 for i in range(5000) if not ln[i] and lk[i] in set(rk))
     assert len(li) == want
+
+
+def test_np_join_expand_matches_device_contract():
+    """The generic-join host twin must reproduce the device expansion's
+    (li, ri) pairs AND order exactly (probe-major, stable key-sorted
+    build rows), for inner and outer, dense and sparse key ranges."""
+    import os
+    import numpy as np
+    from tinysql_tpu.ops import kernels
+    rng = np.random.default_rng(12)
+    n, m = 3000, 400
+    for sparse in (False, True):
+        mult = (1 << 30) if sparse else 1
+        lk = rng.integers(0, 60, n).astype(np.int64) * mult
+        ln = rng.random(n) < 0.06
+        lv = rng.random(n) < 0.9
+        rk = rng.integers(0, 60, m).astype(np.int64) * mult  # duplicates
+        rn = rng.random(m) < 0.06
+        rv = rng.random(m) < 0.9
+        for outer in (False, True):
+            host = kernels._np_join_expand(lk, ln, lv, rk, rn, rv, outer)
+            os.environ["TINYSQL_DEVICE_JOIN_ONLY"] = "1"
+            try:
+                dev = kernels.join_match((lk, ln), n, (rk, rn), m,
+                                         outer=outer, lvalid=lv,
+                                         rvalid=rv)
+            finally:
+                del os.environ["TINYSQL_DEVICE_JOIN_ONLY"]
+            assert np.array_equal(host[0], np.asarray(dev[0])), \
+                (sparse, outer)
+            assert np.array_equal(host[1], np.asarray(dev[1])), \
+                (sparse, outer)
+
+
+def test_join_sentinel_collision_int64_max():
+    """A LIVE build key equal to int64 max must match (and dead rows with
+    the +max sentinel must not shadow it) on BOTH the device kernels and
+    the host twins (r5 review finding)."""
+    import os
+    import numpy as np
+    from tinysql_tpu.ops import kernels
+    mx = np.iinfo(np.int64).max
+    lk = np.array([mx, 5], dtype=np.int64)
+    ln = np.zeros(2, dtype=bool)
+    rk = np.array([7, mx, 5], dtype=np.int64)
+    rn = np.array([True, False, False])  # row 0 is a NULL key
+    want = [(0, 1), (1, 2)]
+    for env in (None, "1"):
+        if env:
+            os.environ["TINYSQL_DEVICE_JOIN_ONLY"] = env
+        try:
+            for fn in (kernels.join_match, kernels.unique_join_match):
+                li, ri = fn((lk, ln), 2, (rk, rn), 3)
+                got = sorted(zip(np.asarray(li).tolist(),
+                                 np.asarray(ri).tolist()))
+                assert got == want, (fn.__name__, env, got)
+        finally:
+            os.environ.pop("TINYSQL_DEVICE_JOIN_ONLY", None)

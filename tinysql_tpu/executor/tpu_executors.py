@@ -1190,13 +1190,10 @@ class TPUHashJoinExec(Executor):
         probe_chk = lchk if probe_side == 0 else rchk
         stream = budget > 0 and probe_chk.full_rows() > budget
 
-        # numpy twins exist only for the UNIQUE join branches: route keys
-        # to host just when one of those will run (kernels.host_kernels_ok
-        # honors TINYSQL_DEVICE_JOIN_ONLY); the generic join_match path
-        # keeps its device-resident/memoized keys
-        host_keys = (kernels.host_kernels_ok()
-                     and (right_unique
-                          or (left_unique and plan.tp == "inner")))
+        # every join branch has a numpy twin on the CPU backend
+        # (kernels.host_kernels_ok honors TINYSQL_DEVICE_JOIN_ONLY):
+        # route keys to host there; device-resident/memoized otherwise
+        host_keys = kernels.host_kernels_ok()
 
         def keys_of(side, expr, chk, rep):
             if stream and side == probe_side:

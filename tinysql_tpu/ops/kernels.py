@@ -1159,20 +1159,25 @@ def _join_count_kernel():
 
     def kernel(lk, ln, lvalid, rk, rn, rvalid):
         r_live = rvalid & ~rn
-        # dead rows get a +max sentinel so the sorted array is globally
-        # ordered with all dead rows at the end (searchsorted precondition)
+        # dead rows get a +max sentinel; a LIVE key can equal the
+        # sentinel, so sort (key, dead-flag) lexicographically — live
+        # rows first within an equal-key run — and count live rows per
+        # window via a prefix sum instead of clipping by the live total
+        # (the clip was wrong when sentinels interleaved a live max key)
         sentinel = (jn.iinfo(jn.int64).max if rk.dtype == jn.int64
                     else jn.inf)
         rk_clean = jn.where(r_live, rk, sentinel)
-        rperm = jn.argsort(rk_clean)
+        dead = (~r_live).astype(jn.int8)
+        rperm = jn.lexsort([dead, rk_clean])  # primary: key; live first
         rs = rk_clean[rperm]
-        n_r_live = jn.sum(r_live.astype(jn.int32))
+        pref = jn.cumsum(r_live[rperm].astype(jn.int64))
+
+        def live_upto(p):
+            return jn.where(p > 0, pref[jn.maximum(p - 1, 0)], 0)
         lo = jn.searchsorted(rs, lk, side="left")
         hi = jn.searchsorted(rs, lk, side="right")
-        lo = jn.minimum(lo, n_r_live)
-        hi = jn.minimum(hi, n_r_live)
         l_live = lvalid & ~ln
-        counts = jn.where(l_live, jn.maximum(hi - lo, 0), 0)
+        counts = jn.where(l_live, live_upto(hi) - live_upto(lo), 0)
         total = jn.sum(counts)
         # outer-mode output size: unmatched VALID left rows emit one row
         eff_total = total + jn.sum((lvalid & (counts == 0)).astype(jn.int64))
@@ -1206,6 +1211,57 @@ def _join_expand_kernel(outer: bool, ob2: int):
     return counted_jit(kernel), schema
 
 
+def _np_join_expand(lk, ln, lv, rk, rn, rv, outer: bool):
+    """Host twin of the expansion join: identical (li, ri) CONTRACT AND
+    ORDER (probe-major; within a probe row, build rows in stable
+    key-sorted order) so switching paths never reorders results.  Dense
+    int64 build keys use a direct-address CSR (bincount starts/counts)
+    instead of two searchsorted passes."""
+    r_live = rv & ~rn
+    bidx = np.nonzero(r_live)[0]
+    bk = rk[bidx]
+    l_live = lv & ~ln
+    n_l = len(lk)
+    if len(bk) == 0:
+        if outer:
+            li = np.nonzero(lv)[0]
+            return (li.astype(np.int64),
+                    np.full(len(li), -1, dtype=np.int64))
+        z = np.empty(0, dtype=np.int64)
+        return z, z
+    order = np.argsort(bk, kind="stable")
+    brow = bidx[order]          # build rows, key-sorted, stable
+    if bk.dtype == np.int64:
+        kmin = int(bk.min())
+        card = int(bk.max()) - kmin + 1
+    else:
+        card = None
+    if card is not None and card <= max(1 << 22, 4 * len(bk)):
+        cnt_k = np.bincount(bk - kmin, minlength=card)
+        starts_k = np.concatenate(([0], np.cumsum(cnt_k)[:-1]))
+        idx = np.clip(lk - kmin, 0, card - 1)
+        in_r = l_live & (lk >= kmin) & (lk < kmin + card)
+        lo = np.where(in_r, starts_k[idx], 0)
+        counts = np.where(in_r, cnt_k[idx], 0)
+    else:
+        bk_s = bk[order]
+        lo = np.searchsorted(bk_s, lk, side="left")
+        hi = np.searchsorted(bk_s, lk, side="right")
+        counts = np.where(l_live, hi - lo, 0)
+    eff = np.where(lv & (counts == 0), 1, counts) if outer else counts
+    total = int(eff.sum())
+    if total == 0:
+        z = np.empty(0, dtype=np.int64)
+        return z, z
+    li = np.repeat(np.arange(n_l, dtype=np.int64), eff)
+    starts = np.cumsum(eff) - eff
+    pos = np.arange(total, dtype=np.int64) - starts[li]
+    matched = counts[li] > 0
+    ridx = np.minimum(lo[li] + pos, len(brow) - 1)
+    ri = np.where(matched, brow[ridx], -1)
+    return li, ri.astype(np.int64)
+
+
 def join_match(lkey: Tuple[np.ndarray, np.ndarray], n_left: int,
                rkey: Tuple[np.ndarray, np.ndarray], n_right: int,
                outer: bool = False, lvalid: np.ndarray = None,
@@ -1214,7 +1270,18 @@ def join_match(lkey: Tuple[np.ndarray, np.ndarray], n_left: int,
     outer, unmatched VALID left rows appear once with right index -1.
     `lvalid`/`rvalid` fold side filters into the kernel's masks so callers
     skip host compaction AND keep bucket shapes stable across differently
-    selective filters (one TPU compile per table size, not per filter)."""
+    selective filters (one TPU compile per table size, not per filter).
+    Host-array inputs on the CPU backend run the numpy twin."""
+    if (isinstance(lkey[0], np.ndarray) and isinstance(rkey[0], np.ndarray)
+            and host_kernels_ok()):
+        lv = np.ones(n_left, dtype=bool) if lvalid is None \
+            else np.asarray(lvalid[:n_left], dtype=bool)
+        rv = np.ones(n_right, dtype=bool) if rvalid is None \
+            else np.asarray(rvalid[:n_right], dtype=bool)
+        return _np_join_expand(
+            np.asarray(lkey[0])[:n_left], np.asarray(lkey[1])[:n_left],
+            lv, np.asarray(rkey[0])[:n_right],
+            np.asarray(rkey[1])[:n_right], rv, outer)
     jn = jnp()
     nlb, nrb = bucket(max(n_left, 1)), bucket(max(n_right, 1))
     lv = np.zeros(nlb, dtype=bool)
@@ -1270,7 +1337,11 @@ def _unique_join_kernel(build_sorted: bool = False):
             rs = rk_clean
             cand_all = jn.arange(rs.shape[0], dtype=jn.int64)
         else:
-            rperm = jn.argsort(rk_clean)
+            # live rows first within an equal-key run, so a live key
+            # equal to the sentinel is FOUND (searchsorted 'left' lands
+            # on it) instead of shadowed by an interleaved dead row
+            dead = (~r_live).astype(jn.int8)
+            rperm = jn.lexsort([dead, rk_clean])
             rs = rk_clean[rperm]
             cand_all = rperm
         n_r_live = jn.sum(r_live.astype(jn.int32))
