@@ -405,3 +405,56 @@ def test_join_sentinel_collision_int64_max():
                 assert got == want, (fn.__name__, env, got)
         finally:
             os.environ.pop("TINYSQL_DEVICE_JOIN_ONLY", None)
+
+
+def test_device_join_kernels_sql_parity(monkeypatch):
+    """With host twins serving the CPU backend, the DEVICE join kernels
+    (what a real chip runs) must keep SQL-level coverage: force them via
+    TINYSQL_DEVICE_JOIN_ONLY and compare against the CPU tier."""
+    import numpy as np
+    from tinysql_tpu.session.session import new_session
+    from tinysql_tpu.columnar.store import bulk_load
+    monkeypatch.setenv("TINYSQL_DEVICE_JOIN_ONLY", "1")
+    s = new_session()
+    s.execute("create database dj")
+    s.execute("use dj")
+    s.execute("set @@tidb_tpu_min_rows = 0")
+    rng = np.random.default_rng(55)
+    n = 4096
+    s.execute("create table f (id bigint primary key, k bigint, v double)")
+    bulk_load(s.storage, s.infoschema().table_by_name("dj", "f"),
+              {"id": np.arange(1, n + 1, dtype=np.int64),
+               "k": rng.integers(1, 64, n).astype(np.int64),
+               "v": np.round(rng.random(n) * 9, 2)})
+    s.execute("create table d (k bigint primary key, t bigint)")
+    bulk_load(s.storage, s.infoschema().table_by_name("dj", "d"),
+              {"k": np.arange(1, 64, dtype=np.int64),
+               "t": rng.integers(0, 5, 63).astype(np.int64)})
+    s.execute("create table dup (id bigint primary key, k bigint, "
+              "w bigint)")
+    bulk_load(s.storage, s.infoschema().table_by_name("dj", "dup"),
+              {"id": np.arange(1, 121, dtype=np.int64),
+               "k": np.tile(np.arange(1, 41, dtype=np.int64), 3),
+               "w": rng.integers(0, 9, 120).astype(np.int64)})
+    for t in ("f", "d", "dup"):
+        s.query(f"select * from {t}")
+    qs = [
+        "select d.t, count(*), sum(f.v) from f join d on f.k = d.k "
+        "group by d.t order by d.t",                       # unique build
+        "select f.id, dup.w from f join dup on f.k = dup.k "
+        "order by f.id, dup.w limit 300, 15",              # expansion
+        "select f.id, d.t from f left join d on f.k = d.k and d.t < 2 "
+        "order by f.id limit 25",                          # outer + ON
+        "select u.t, x.s from d u join (select k, sum(v) as s from f "
+        "group by k) x on u.k = x.k order by x.s desc limit 9",  # sorted
+    ]
+    def canon(rows):
+        return sorted(tuple(f"{v:.9g}" if isinstance(v, float) else str(v)
+                            for v in r) for r in rows)
+    for q in qs:
+        s.execute("set @@tidb_use_tpu = 1")
+        dev = s.query(q).rows
+        s.execute("set @@tidb_use_tpu = 0")
+        cpu = s.query(q).rows
+        s.execute("set @@tidb_use_tpu = 1")
+        assert canon(dev) == canon(cpu), q
