@@ -181,3 +181,52 @@ def test_agg_passthrough_null_group_key(tk):
     # the NULL group must also survive when the agg is the query top
     r = tk.query("select k, sum(v) from nf group by k order by k").rows
     assert _canon(r) == _canon([[None, 7.0], [1, 1.5], [2, 4.0]])
+
+
+def test_host_groupby_twin_memo_no_cross_query_collision(tk):
+    """Two queries whose DIFFERENT argument columns land at the SAME
+    pruned offset must not share a host-twin memo entry (the slot-id
+    invariant; r5 review finding)."""
+    import numpy as np
+    from tinysql_tpu.columnar.store import bulk_load
+    tk.execute("create table hc (id bigint primary key, a double, "
+               "b double, g bigint)")
+    info = tk.infoschema().table_by_name("lm", "hc")
+    rng = np.random.default_rng(9)
+    n = 2000
+    bulk_load(tk.storage, info,
+              {"id": np.arange(1, n + 1, dtype=np.int64),
+               "a": np.round(rng.random(n), 2),
+               "b": np.round(rng.random(n) * 100, 2),
+               "g": np.arange(n, dtype=np.int64) % 500})  # >64 segments
+    tk.query("select * from hc")
+    sa = tk.query("select g, sum(a) from hc group by g order by g "
+                  "limit 3").rows
+    sb = tk.query("select g, sum(b) from hc group by g order by g "
+                  "limit 3").rows
+    tk.execute("set @@tidb_use_tpu = 0")
+    ca = tk.query("select g, sum(a) from hc group by g order by g "
+                  "limit 3").rows
+    cb = tk.query("select g, sum(b) from hc group by g order by g "
+                  "limit 3").rows
+    tk.execute("set @@tidb_use_tpu = 1")
+    assert _canon(sa) == _canon(ca)
+    assert _canon(sb) == _canon(cb)   # collided memo would return sum(a)
+
+
+def test_host_groupby_twin_int64_sum_stays_exact(tk):
+    """SUM over int64 beyond float64's mantissa must keep the exact
+    device kernel (the twin's upfront gate)."""
+    big = (1 << 60)
+    tk.execute("create table ix (id bigint primary key, g bigint, "
+               "v bigint)")
+    rows = ", ".join(f"({i}, {i % 100}, {big + i})" for i in range(1, 301))
+    tk.execute("insert into ix values " + rows)
+    tk.query("select * from ix")
+    dev = tk.query("select g, sum(v) from ix group by g order by g "
+                   "limit 2").rows
+    tk.execute("set @@tidb_use_tpu = 0")
+    cpu = tk.query("select g, sum(v) from ix group by g order by g "
+                   "limit 2").rows
+    tk.execute("set @@tidb_use_tpu = 1")
+    assert dev == cpu  # exact, not float-rounded

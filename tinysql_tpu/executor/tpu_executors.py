@@ -509,18 +509,6 @@ class TPUHashAggExec(Executor):
         slot_ids = [ci.id if ci is not None else "handle"
                     for ci in child._decode_cols]
 
-        # ---- filter mask: on-device program when every condition lowers
-        # (constants as runtime params — zero recompiles across constant
-        # changes, ~100-byte upload); host numpy + nb-bool upload otherwise
-        dev_mask = _build_device_mask(child, rep, chk, filters)
-        if dev_mask is None:
-            fmask = _fold_filter_masks(child, rep, chk, filters) \
-                if filters else None
-            mask_needed = set()
-        else:
-            mask_fn, mask_prog_key, mask_params, mask_needed = dev_mask
-            fmask = None
-
         # ---- per-key codes (memoized per replica) -----------------------
         key_layouts = []
         for e in plan.group_by:
@@ -543,12 +531,43 @@ class TPUHashAggExec(Executor):
         if budget > 0 and n > budget:
             out = self._fused_blockwise(chk, rep, child, filters,
                                         specs, arg_exprs, slots,
-                                        key_layouts, n_segments, n, budget,
-                                        fmask=fmask)
+                                        key_layouts, n_segments, n, budget)
             if out is not None:
                 return out
             child._replica = rep
             return None
+
+        # ---- CPU-backend host twin for SCATTER-BOUND group-bys: above
+        # SEG_UNROLL segments the device kernel scatter-adds, which
+        # XLA:CPU runs serially, while np.bincount with REPLICA-MEMOIZED
+        # argument columns is the host-optimal kernel.  Below that
+        # threshold the fused device program still wins ON THIS BACKEND
+        # (measured: Q1 0.73s fused vs 1.47s host — its on-device
+        # args/mask avoid numpy's materialized temporaries; PROFILE.md
+        # §6).  Runs BEFORE the device-mask build so the twin never pays
+        # for a device filter program it would discard.
+        if (plan.group_by and n_segments > kernels.SEG_UNROLL
+                and kernels.host_kernels_ok()
+                and self._mesh_if_enabled(nb) is None
+                and self._host_groupby_ok(specs, slots, arg_exprs)):
+            out = self._fused_host_groupby(chk, child, rep, filters,
+                                           specs, arg_exprs, slots,
+                                           key_layouts, n_segments, n,
+                                           slot_ids)
+            if out is not None:
+                return out
+
+        # ---- filter mask: on-device program when every condition lowers
+        # (constants as runtime params — zero recompiles across constant
+        # changes, ~100-byte upload); host numpy + nb-bool upload otherwise
+        dev_mask = _build_device_mask(child, rep, chk, filters)
+        if dev_mask is None:
+            fmask = _fold_filter_masks(child, rep, chk, filters) \
+                if filters else None
+            mask_needed = set()
+        else:
+            mask_fn, mask_prog_key, mask_params, mask_needed = dev_mask
+            fmask = None
 
         # ---- device columns (memoized per replica + bucket) -------------
         needed = set(mask_needed)
@@ -973,6 +992,93 @@ class TPUHashAggExec(Executor):
                     key_cols, specs, arg_cols, n, filter_mask=filter_mask)
         return self._assemble_output(chk, plan, slots, out_keys, out_aggs,
                                      first_orig, [d for _, _, d in keys])
+
+    @staticmethod
+    def _host_groupby_ok(specs, slots, arg_exprs) -> bool:
+        """Host-twin eligibility: bincount-able specs only (min/max need
+        ufunc.at, which loses to the device kernel), no first_row
+        gathers, and no exact int64 SUMs (float64 accumulation caps at
+        the 2^53 mantissa) — checked UPFRONT so an ineligible query
+        never pays O(n) twin work before bailing."""
+        for (kind, _), a in zip(specs, arg_exprs):
+            if kind not in ("sum", "sum0", "count", "count_star"):
+                return False
+            if (kind == "sum" and a is not None
+                    and not isinstance(a, tuple)
+                    and a.eval_type is EvalType.INT):
+                return False
+        return all(sl[0] != "first" for sl in slots)
+
+    @staticmethod
+    def _host_arg_key(a, slot_ids) -> tuple:
+        """Replica-memo key for an argument expression: the shape key
+        plus the STABLE column ids its offsets refer to — replicas are
+        shared across queries with different column pruning, so the
+        offsets inside stable_key alone would collide (the slot-id
+        invariant at the top of _try_fused_device)."""
+        from ..ops.exprjit import stable_key
+        cols = sorted({c.index for c in a.collect_columns()})
+        return ("hostarg", stable_key(a),
+                tuple((i, slot_ids[i]) for i in cols))
+
+    def _fused_host_groupby(self, chk, child, rep, filters, specs,
+                            arg_exprs, slots, key_layouts,
+                            n_segments: int, n: int, slot_ids):
+        """numpy twin of the scatter-bound fused segment aggregate (CPU
+        backend): host filter mask + replica-MEMOIZED argument columns +
+        np.bincount per spec over the composite group ids.  Returns an
+        output chunk, or None to fall back to the device kernels."""
+        fmask = _fold_filter_masks(child, rep, chk, filters) \
+            if filters else None
+        gid = key_layouts[0][0] if len(key_layouts) == 1 else rep.memo(
+            ("gid_host", tuple(slot_ids[e.index]
+                               for e in self.plan.group_by)),
+            lambda: self._compose_gid(key_layouts, n))
+        ns = n_segments
+        g_valid = gid if fmask is None else gid[fmask]
+        presence = np.bincount(g_valid, minlength=ns)
+        present = np.nonzero(presence > 0)[0]
+        out_aggs = []
+        for (kind, _has_arg), a in zip(specs, arg_exprs):
+            if kind == "count_star":
+                out_aggs.append((presence[present].astype(np.int64),
+                                 np.zeros(len(present), dtype=bool)))
+                continue
+            if isinstance(a, tuple):  # ("mask", slot): COUNT(col)
+                m = chk.columns[a[1]].null_mask()
+                vals = None
+            else:
+                # memoized per (replica version, expression shape, the
+                # STABLE ids of its columns): the twin's economics depend
+                # on never re-evaluating args per query
+                vals, m = rep.memo(self._host_arg_key(a, slot_ids),
+                                   lambda a=a: a.vec_eval(chk))
+            live = ~np.asarray(m, dtype=bool)
+            if fmask is not None:
+                live = live & fmask
+            gl = gid[live]
+            if kind == "count":
+                c = np.bincount(gl, minlength=ns)
+                out_aggs.append((c[present].astype(np.int64),
+                                 np.zeros(len(present), dtype=bool)))
+                continue
+            # sum / sum0: float64 accumulation — exact for counts and
+            # doubles (int64 SUMs were rejected upfront by the gate)
+            v = np.asarray(vals)[live]
+            if v.dtype != np.float64:
+                v = v.astype(np.float64)
+            ssum = np.bincount(gl, weights=v, minlength=ns)
+            if kind == "sum0":  # merged COUNT: 0 over empty, never NULL
+                out_aggs.append((ssum[present].astype(np.int64),
+                                 np.zeros(len(present), dtype=bool)))
+            else:
+                c = np.bincount(gl, minlength=ns)
+                out_aggs.append((ssum[present], (c == 0)[present]))
+        out_keys = self._decode_present(present, key_layouts)
+        first_orig = np.zeros(len(present), dtype=np.int64)
+        return self._assemble_output(chk, self.plan, slots, out_keys,
+                                     out_aggs, first_orig,
+                                     [l[3] for l in key_layouts])
 
     def _can_device_passthrough(self, plan, slots, key_layouts) -> bool:
         """Late-materialization gate (VERDICT r4 next-2): the aggregate's
