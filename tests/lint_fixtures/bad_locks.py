@@ -1,0 +1,43 @@
+"""Known-bad lock-discipline fixture: every LD3xx rule must fire here.
+NOT imported by anything — parsed by qlint's self-tests only."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._items = {}
+        self._count = 0
+
+    def add(self, k, v):
+        with self._mu:
+            self._items[k] = v
+            self._count += 1
+
+    def remove_unlocked(self, k):
+        self._items.pop(k, None)       # LD301: mutation outside _mu
+        self._count -= 1               # LD301: mutation outside _mu
+
+    def peek(self):
+        return self._count             # LD302: read outside _mu
+
+
+def _slot(storage):
+    s = getattr(storage, "_slot", None)
+    if s is None:
+        s = storage._slot = {"lock": threading.Lock(), "owner": None}
+    return s
+
+
+def campaign(storage, me):
+    s = _slot(storage)
+    with s["lock"]:
+        if s["owner"] is None:
+            s["owner"] = me
+            return True
+    return False
+
+
+def retire_unlocked(storage):
+    s = _slot(storage)
+    s["owner"] = None                  # LD303: locked slot, no lock held

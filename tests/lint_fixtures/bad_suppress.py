@@ -1,0 +1,7 @@
+"""Known-bad suppression fixture: a disable with no justification must
+raise QL001 and must NOT silence the underlying violation."""
+import numpy as np
+
+
+def emit(args):
+    return np.asarray(args[0])  # qlint: disable=TS101

@@ -1,0 +1,29 @@
+"""Known-bad trace-safety fixture: every TS1xx rule must fire here.
+NOT imported by anything — parsed by qlint's self-tests only."""
+import numpy as np
+
+_KERNEL_CACHE = {}
+
+
+def emit(args):
+    vals = args[0]
+    host = np.asarray(vals)            # TS101: host sync mid-trace
+    x = vals.item()                    # TS102: scalar sync
+    y = float(vals[0])                 # TS102: scalar coercion
+    if vals[0] > 0:                    # TS103: branch on traced value
+        host = host + 1
+    while x > 0:                       # TS103: loop on traced value
+        x = x - 1
+    return host, y
+
+
+def run_per_call(fn, data):
+    import jax
+    w = jax.jit(fn)                    # TS104: fresh wrapper per call
+    return w(data)
+
+
+def bad_cache_key(nb, ids):
+    key = _KERNEL_CACHE.get([nb, "agg"])   # TS105: list key
+    _KERNEL_CACHE[(nb, np.array(ids))] = 1  # TS105: ndarray in key
+    return key

@@ -1,0 +1,133 @@
+"""planner/device.py placement decisions: a parametrized admit/fallback
+matrix asserting TPU-vs-CPU placement per operator and key shape, via the
+EXPLAIN device annotations (the same surface the plan-device checker
+verifies for consistency).  Reference analogue: the copTask/rootTask
+boundary decisions of planner/core/task.go."""
+import pytest
+
+from tinysql_tpu.utils.testkit import TestKit
+
+
+@pytest.fixture()
+def tk():
+    t = TestKit()
+    t.must_exec("create database place")
+    t.must_exec("use place")
+    t.must_exec("create table t (a int primary key, b int, c double, "
+                "s varchar(10))")
+    t.must_exec("insert into t values (1,1,0.5,'x'),(2,1,1.5,'y'),"
+                "(3,2,2.5,'x'),(4,2,3.5,'z')")
+    t.must_exec("create table r (k int primary key, v varchar(6))")
+    t.must_exec("insert into r values (1,'one'),(2,'two')")
+    t.must_exec("create table uu (a bigint unsigned, g int)")
+    t.must_exec("insert into uu values (1,1),(2,2)")
+    t.must_exec("create table m (id int primary key, k1 int, k2 int)")
+    t.must_exec("insert into m values (1,1,1),(2,1,2)")
+    t.must_exec("set @@tidb_use_tpu = 1")
+    t.must_exec("set @@tidb_tpu_min_rows = 0")
+    return t
+
+
+def _explain_ops(tk, sql):
+    return [row[0].strip() for row in
+            tk.must_query("explain " + sql).data]
+
+
+def _placement(tk, sql, op):
+    """True/False when `op` appears placed/unplaced; None when absent."""
+    for name in _explain_ops(tk, sql):
+        if name == f"{op}(TPU)":
+            return True
+        if name == op:
+            return False
+    return None
+
+
+ADMIT_MATRIX = [
+    # (sql, operator, expect_tpu, why)
+    ("select b, sum(a) from t group by b",
+     "HashAgg", True, "numeric group key + device-kernel aggs"),
+    ("select s, count(*) from t group by s",
+     "HashAgg", True, "string group key rides dictionary codes"),
+    ("select count(distinct b) from t",
+     "HashAgg", False, "distinct agg has no device kernel"),
+    ("select min(s) from t",
+     "HashAgg", False, "string agg arg is not device-jittable"),
+    ("select b, sum(length(s)) from t group by b",
+     "HashAgg", False, "length() does not lower through exprjit"),
+    ("select t.b, r.v from t join r on t.b = r.k",
+     "HashJoin", True, "single numeric equi-key join"),
+    ("select t1.a from t t1 join t t2 on t1.s = t2.s",
+     "HashJoin", False, "string join keys stay on the CPU tier"),
+    ("select t.a, m.id from t join m on t.b = m.k1 and t.a = m.k2",
+     "HashJoin", True, "multi-key signed-int composite lanes"),
+    ("select t.a from t join uu on t.a = uu.a",
+     "HashJoin", False, "mixed-signedness int keys: per-pair compare "
+                        "semantics the sort kernel lacks"),
+    ("select a from t order by c",
+     "Sort", True, "numeric sort key"),
+    ("select a from t order by s",
+     "Sort", True, "string sort key rides dictionary codes"),
+    ("select a from t order by length(s)",
+     "Sort", True, "order-by exprs are projected into columns below the "
+                   "Sort, so the sort key itself is a numeric column"),
+    ("select a from t order by c limit 2",
+     "TopN", True, "numeric top-n key"),
+    ("select a + b, c * 2 from t",
+     "Projection", True, "jittable projection exprs"),
+    ("select concat(s, 'x') from t",
+     "Projection", False, "string expr does not lower"),
+    ("select b, count(*) n from t group by b having n > 1",
+     "Selection", True, "jittable HAVING filter over the agg"),
+    ("select s, min(s) ms from t group by s having ms > 'a'",
+     "Selection", False, "string compare filter stays on CPU"),
+]
+
+
+@pytest.mark.parametrize("sql,op,expect,why", ADMIT_MATRIX,
+                         ids=[w for _, _, _, w in ADMIT_MATRIX])
+def test_admit_fallback_matrix(tk, sql, op, expect, why):
+    got = _placement(tk, sql, op)
+    assert got is not None, \
+        f"{op} missing from plan: {_explain_ops(tk, sql)}"
+    assert got is expect, (f"{sql!r}: want {op} "
+                           f"{'TPU' if expect else 'CPU'} ({why}); "
+                           f"plan: {_explain_ops(tk, sql)}")
+
+
+def test_merge_join_never_tpu(tk):
+    # pk-pk join provides key order on both sides -> MergeJoin, which is
+    # the sorted-stream operator the device tier never takes
+    sql = "select t1.a from t t1 join t t2 on t1.a = t2.a"
+    ops = _explain_ops(tk, sql)
+    assert any(o == "MergeJoin" for o in ops), ops
+    assert not any("MergeJoin(TPU)" in o for o in ops), ops
+
+
+def test_min_rows_cost_gate(tk):
+    # capability admits, cost declines: tiny inputs never pay an XLA
+    # compile (tidb_tpu_min_rows carries the threshold)
+    sql = "select b, sum(a) from t group by b"
+    assert _placement(tk, sql, "HashAgg") is True
+    tk.must_exec("set @@tidb_tpu_min_rows = 1000000")
+    assert _placement(tk, sql, "HashAgg") is False
+    tk.must_exec("set @@tidb_tpu_min_rows = 0")
+    assert _placement(tk, sql, "HashAgg") is True
+
+
+def test_placement_disabled_globally(tk):
+    tk.must_exec("set @@tidb_use_tpu = 0")
+    for sql, op, expect, _ in ADMIT_MATRIX:
+        got = _placement(tk, sql, op)
+        assert got in (False, None), (sql, op, got)
+
+
+def test_results_identical_across_tiers(tk):
+    # the placement decision must never change ANSWERS, only placement
+    queries = [sql for sql, _, _, _ in ADMIT_MATRIX]
+    for sql in queries:
+        tk.must_exec("set @@tidb_use_tpu = 1")
+        a = tk.must_query(sql).sorted_str()
+        tk.must_exec("set @@tidb_use_tpu = 0")
+        b = tk.must_query(sql).sorted_str()
+        assert a == b, sql

@@ -1,0 +1,249 @@
+"""qlint self-tests: every pass must fire on its known-bad fixture, the
+CLI must exit non-zero on each fixture, and the TREE must be lint-clean —
+this file is the local mirror of the CI `tools/lint.py --strict` gate."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tinysql_tpu.analysis import (gather_sources, lint_lock_discipline,
+                                  lint_trace_safety)
+from tinysql_tpu.analysis.diag import SourceFile
+from tinysql_tpu.analysis.plan_device import (PlanDeviceError, check_plan,
+                                              check_explain_consistency,
+                                              verify_plan)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "lint_fixtures")
+LINT = os.path.join(REPO, "tools", "lint.py")
+
+
+def _rules(diags):
+    return {d.rule for d in diags}
+
+
+# ---- pass 1: trace safety ----------------------------------------------
+
+def test_trace_fixture_fires_every_rule():
+    sf = SourceFile(os.path.join(FIXDIR, "bad_trace.py"))
+    got = _rules(lint_trace_safety(sf))
+    assert {"TS101", "TS102", "TS103", "TS104", "TS105"} <= got
+
+
+def test_trace_suppression_requires_justification():
+    sf = SourceFile(os.path.join(FIXDIR, "bad_suppress.py"))
+    # the unjustified disable does NOT silence TS101 and raises QL001
+    assert "TS101" in _rules(lint_trace_safety(sf))
+    assert "QL001" in _rules(sf.check_suppression_syntax())
+
+
+def test_trace_justified_suppression_silences(tmp_path):
+    src = ("import numpy as np\n\n\n"
+           "def emit(args):\n"
+           "    return np.asarray(args[0])"
+           "  # qlint: disable=TS101 -- fixture: pretend post-download\n")
+    p = tmp_path / "ok.py"
+    p.write_text(src)
+    sf = SourceFile(str(p))
+    assert lint_trace_safety(sf) == []
+    assert sf.check_suppression_syntax() == []
+
+
+def test_trace_host_code_not_flagged(tmp_path):
+    # np over host values OUTSIDE traced regions (and np over closure
+    # constants inside them) is the legitimate post-download idiom
+    src = ("import numpy as np\n\n\n"
+           "def materialize(dev):\n"
+           "    return np.asarray(dev)\n\n\n"
+           "def emit(args):\n"
+           "    pad = np.zeros(4)\n"     # host constant: fine
+           "    return args[0], pad\n")
+    p = tmp_path / "host.py"
+    p.write_text(src)
+    assert lint_trace_safety(SourceFile(str(p))) == []
+
+
+# ---- pass 3: lock discipline -------------------------------------------
+
+def test_lock_fixture_fires_every_rule():
+    sf = SourceFile(os.path.join(FIXDIR, "bad_locks.py"))
+    got = _rules(lint_lock_discipline(sf))
+    assert {"LD301", "LD302", "LD303"} <= got
+
+
+def test_lock_clean_class_not_flagged(tmp_path):
+    src = ("import threading\n\n\n"
+           "class Ok:\n"
+           "    def __init__(self):\n"
+           "        self._mu = threading.Lock()\n"
+           "        self._n = 0\n\n"
+           "    def bump(self):\n"
+           "        with self._mu:\n"
+           "            self._n += 1\n\n"
+           "    def get(self):\n"
+           "        with self._mu:\n"
+           "            return self._n\n")
+    p = tmp_path / "ok_locks.py"
+    p.write_text(src)
+    assert lint_lock_discipline(SourceFile(str(p))) == []
+
+
+# ---- pass 2: plan-device invariants ------------------------------------
+
+@pytest.fixture()
+def planned():
+    from tinysql_tpu.utils.testkit import TestKit
+    tk = TestKit()
+    tk.must_exec("create database pd")
+    tk.must_exec("use pd")
+    tk.must_exec("create table t (a int primary key, b int, c double)")
+    tk.must_exec("insert into t values (1,1,0.5),(2,1,1.5),(3,2,2.5)")
+    tk.must_exec("set @@tidb_use_tpu = 1")
+    tk.must_exec("set @@tidb_tpu_min_rows = 0")
+
+    def plan(sql):
+        from tinysql_tpu.parser import parse
+        from tinysql_tpu.planner.builder import PlanBuilder
+        s = tk.session
+        try:
+            return s._optimize(PlanBuilder(s).build_select(parse(sql)[0]),
+                               True)
+        finally:
+            s._pinned_is = None
+    return plan
+
+
+def _find(p, op_name):
+    if p.op_name() == op_name:
+        return p
+    for c in p.children:
+        got = _find(c, op_name)
+        if got is not None:
+            return got
+    return None
+
+
+def test_placed_plan_is_clean(planned):
+    phys = planned("select b, sum(a) from t group by b order by b")
+    assert check_plan(phys) == []
+    assert check_explain_consistency(phys) == []
+    verify_plan(phys)  # must not raise
+
+
+def test_pd201_inadmissible_placement(planned):
+    phys = planned("select count(distinct b) from t")
+    agg = _find(phys, "HashAgg")
+    assert agg is not None and not agg.use_tpu
+    agg.use_tpu = True  # corrupt: distinct agg has no device kernel
+    assert "PD201" in _rules(check_plan(phys))
+    with pytest.raises(PlanDeviceError):
+        verify_plan(phys)
+
+
+def test_pd202_placement_without_estimate(planned):
+    phys = planned("select b, sum(a) from t group by b")
+    agg = _find(phys, "HashAgg")
+    assert agg.use_tpu
+    agg.has_estimate = False  # corrupt: placement before derive_stats
+    assert "PD202" in _rules(check_plan(phys))
+
+
+def test_pd203_malformed_mesh_strategy(planned):
+    phys = planned("select t1.b from t t1 join t t2 on t1.b = t2.b")
+    join = _find(phys, "HashJoin")
+    assert join is not None
+    join.use_tpu = True
+    join.mesh_strategy = "bogus"  # corrupt
+    got = _rules(check_plan(phys))
+    assert "PD203" in got
+
+
+def test_pd204_placement_on_unloweable_op(planned):
+    phys = planned("select a from t limit 2")
+    lim = _find(phys, "Limit")
+    assert lim is not None
+    lim.use_tpu = True  # corrupt: Limit has no device lowering
+    assert "PD204" in _rules(check_plan(phys))
+
+
+def test_pd205_explain_drift(planned, monkeypatch):
+    from tinysql_tpu.planner import explain
+    phys = planned("select b, sum(a) from t group by b")
+    assert _find(phys, "HashAgg").use_tpu
+    monkeypatch.setattr(explain, "_task", lambda p: "root")
+    assert "PD205" in _rules(check_explain_consistency(phys))
+
+
+def test_runtime_verifier_sysvar(planned):
+    # tidb_qlint_verify=1 verifies every statement's plan inline; a
+    # healthy plan must still execute
+    from tinysql_tpu.utils.testkit import TestKit
+    tk = TestKit()
+    tk.must_exec("create database rv")
+    tk.must_exec("use rv")
+    tk.must_exec("create table r (a int primary key, b int)")
+    tk.must_exec("insert into r values (1,2),(2,2)")
+    tk.must_exec("set @@tidb_qlint_verify = 1")
+    tk.must_exec("set @@tidb_tpu_min_rows = 0")
+    assert tk.must_query(
+        "select b, count(*) from r group by b").as_str() == [["2", "2"]]
+
+
+# ---- the tree itself is lint-clean -------------------------------------
+
+LOCK_SCOPE = [
+    "tinysql_tpu/ddl/owner.py",
+    "tinysql_tpu/ddl/worker.py",
+    "tinysql_tpu/domain/domain.py",
+    "tinysql_tpu/server/server.py",
+    "tinysql_tpu/kv/rpc.py",
+]
+
+
+def test_tree_trace_safety_clean():
+    diags = []
+    for sf in gather_sources(os.path.join(REPO, "tinysql_tpu")):
+        diags.extend(sf.check_suppression_syntax())
+        diags.extend(lint_trace_safety(sf))
+    assert not diags, "\n".join(d.format() for d in diags)
+
+
+def test_tree_lock_discipline_clean():
+    diags = []
+    for rel in LOCK_SCOPE:
+        sf = SourceFile(os.path.join(REPO, rel))
+        diags.extend(sf.check_suppression_syntax())
+        diags.extend(lint_lock_discipline(sf))
+    assert not diags, "\n".join(d.format() for d in diags)
+
+
+def test_corpus_plans_clean():
+    # every query in the two corpus files must place without a violation
+    # (acceptance criterion; CI runs the same via tools/lint.py --strict)
+    from tinysql_tpu.analysis.plan_device import check_corpus
+    diags = check_corpus(REPO)
+    assert not diags, "\n".join(d.format() for d in diags)
+
+
+# ---- the CLI contract ---------------------------------------------------
+
+@pytest.mark.parametrize("passname,fixture", [
+    ("trace", "bad_trace.py"),
+    ("locks", "bad_locks.py"),
+    ("trace", "bad_suppress.py"),
+])
+def test_cli_exits_nonzero_on_fixture(passname, fixture):
+    r = subprocess.run(
+        [sys.executable, LINT, "--pass", passname,
+         os.path.join(FIXDIR, fixture)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "violation" in r.stdout
+
+
+def test_cli_clean_on_tree_trace_locks():
+    r = subprocess.run(
+        [sys.executable, LINT, "--pass", "trace", "--pass", "locks"],
+        capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -347,6 +347,33 @@ def test_config_strict_load(tmp_path):
         cfgmod.load(str(bad))
 
 
+def test_config_fallback_toml_parser(tmp_path, monkeypatch):
+    """The pre-3.11 strict-subset parser must agree with stdlib tomllib
+    on the config files this server reads — on EVERY interpreter, so the
+    3.10-only code path cannot rot unexercised."""
+    from tinysql_tpu import config as cfgmod
+    data = cfgmod._parse_toml_minimal(
+        'port = 4001          # inline comment\n'
+        'host = "0.0.0.0"     # comment after a quoted string\n'
+        'use-tpu = false\n'
+        '\n'
+        '[log]\n'
+        'level = "debug"\n'
+        'slow-threshold-ms = 500\n')
+    assert data == {"port": 4001, "host": "0.0.0.0", "use-tpu": False,
+                    "log": {"level": "debug", "slow-threshold-ms": 500}}
+    with pytest.raises(cfgmod.ConfigError, match="bad TOML"):
+        cfgmod._parse_toml_minimal('x = "unterminated\n')
+    with pytest.raises(cfgmod.ConfigError, match="bad TOML"):
+        cfgmod._parse_toml_minimal('x = "quoted" trailing-junk\n')
+    # load() through the fallback path end to end
+    monkeypatch.setattr(cfgmod, "tomllib", None)
+    f = tmp_path / "fb.toml"
+    f.write_text('port = 4002\n[security]\nssl-cert = "/tmp/c.pem"  # x\n')
+    cfg = cfgmod.load(str(f))
+    assert cfg.port == 4002 and cfg.security.ssl_cert == "/tmp/c.pem"
+
+
 def test_com_field_list(server):
     """COM_FIELD_LIST over the real socket (reference conn.go:846
     handleFieldList): one column-definition packet per table column, with

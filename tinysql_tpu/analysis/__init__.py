@@ -1,0 +1,38 @@
+"""qlint: the static-analysis subsystem.
+
+Three passes over the invariants nothing else checks mechanically:
+
+- **trace-safety** (`trace_safety.py`, TS1xx): AST lint flagging host-sync
+  and retrace hazards inside jit-traced regions — np calls / `.item()` /
+  scalar coercion on traced values, Python branches on tracers, per-call
+  `jax.jit` wrappers that defeat the dispatch cache, unhashable jit-cache
+  keys.  A host sync inside a fused program costs a whole extra dispatch
+  (~40-70ms on the device link, PROFILE.md §1), which is exactly the bug
+  class "Premature Dimensional Collapse" (PAPERS.md) says silently
+  destroys tensor-backend wins.
+- **plan-device** (`plan_device.py`, PD2xx): walks PHYSICAL plans after
+  placement and verifies the device enforcer's invariants (planner/
+  device.py admissibility, CPU-fallback edge shape, EXPLAIN annotation
+  consistency).  Runs offline over the SQL corpus in tests/ and as an
+  opt-in runtime verifier inside the optimizer (`tidb_qlint_verify`).
+- **lock-discipline** (`lock_discipline.py`, LD3xx): infers per-class
+  lock-to-field guard maps for the threaded subsystems and flags
+  shared-state mutations outside declared lock scopes.
+
+Every pass honors inline suppressions with REQUIRED justification text:
+
+    something_hazardous()  # qlint: disable=TS101 -- post-download host copy
+
+See docs/LINT.md and tools/lint.py.
+"""
+from .diag import (Diagnostic, Severity, SourceFile, format_diagnostics,
+                   gather_sources)
+from .lock_discipline import lint_lock_discipline
+from .plan_device import PlanDeviceError, check_plan, verify_plan
+from .trace_safety import lint_trace_safety
+
+__all__ = [
+    "Diagnostic", "Severity", "SourceFile", "format_diagnostics",
+    "gather_sources", "lint_trace_safety", "lint_lock_discipline",
+    "check_plan", "verify_plan", "PlanDeviceError",
+]

@@ -1,0 +1,274 @@
+"""Pass 3 — lock-discipline lint (LD3xx).
+
+For the threaded subsystems the pass infers, per class, a lock-to-field
+guard map from the code itself: a field that is ever MUTATED while a
+``threading`` lock attribute of the same class is held is declared
+guarded by that lock.  It then flags:
+
+- LD301: a mutation of a guarded field outside every lock scope
+  (``__init__`` is exempt — construction is single-threaded by the
+  publish-before-share rule);
+- LD302: a READ of a guarded field outside every lock scope (torn reads
+  of multi-step state; a deliberate GIL-atomic read needs a suppression
+  with its reasoning);
+- LD303: the dict-slot idiom (``with s["lock"]: s["owner"] = ...``,
+  ddl/owner.py): a subscript write through a name that is elsewhere
+  locked via ``name["lock"]`` but written here with no lock held.
+
+Mutations are attribute stores/aug-stores/deletes, subscript stores into
+the field, and calls of known mutating container methods
+(append/pop/update/...).  Lock attributes themselves and classes with no
+lock attributes are skipped — single-threaded helper classes carry no
+discipline to enforce.  Nested function definitions (inline thread
+targets) are analyzed with an EMPTY held-lock set: they run later, on
+their own thread, regardless of what the enclosing method held.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .diag import Diagnostic, SourceFile, register_rules
+
+register_rules({
+    "LD301": "guarded field mutated outside its lock scope",
+    "LD302": "guarded field read outside its lock scope",
+    "LD303": "locked dict slot written with no lock held",
+})
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_MUTATORS = {"append", "extend", "insert", "pop", "popitem", "clear",
+             "update", "setdefault", "add", "remove", "discard",
+             "appendleft", "popleft"}
+
+
+def _self_attr(e: ast.expr) -> Optional[str]:
+    if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) \
+            and e.value.id == "self":
+        return e.attr
+    return None
+
+
+def _lock_fields(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            fn = node.value.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else \
+                (fn.id if isinstance(fn, ast.Name) else None)
+            if name in _LOCK_CTORS:
+                for tgt in node.targets:
+                    a = _self_attr(tgt)
+                    if a:
+                        out.add(a)
+    return out
+
+
+#: event = ("write"|"read", field, node, held_locks, method_name)
+_Event = Tuple[str, str, ast.AST, FrozenSet[str], str]
+
+
+class _MethodWalker:
+    def __init__(self, locks: Set[str], method: str):
+        self.locks = locks
+        self.method = method
+        self.events: List[_Event] = []
+
+    # ---- statements -----------------------------------------------------
+    def walk(self, stmts, held: FrozenSet[str]) -> None:
+        for s in stmts:
+            self._stmt(s, held)
+
+    def _with_locks(self, node: ast.With) -> FrozenSet[str]:
+        got = set()
+        for item in node.items:
+            a = _self_attr(item.context_expr)
+            if a in self.locks:
+                got.add(a)
+        return frozenset(got)
+
+    def _stmt(self, s: ast.stmt, held: FrozenSet[str]) -> None:
+        if isinstance(s, ast.With):
+            for item in s.items:
+                self._reads(item.context_expr, held, skip_locks=True)
+            self.walk(s.body, held | self._with_locks(s))
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.walk(s.body, frozenset())  # inline thread target
+        elif isinstance(s, (ast.If, ast.While)):
+            self._reads(s.test, held)
+            self.walk(s.body, held)
+            self.walk(s.orelse, held)
+        elif isinstance(s, ast.For):
+            self._reads(s.iter, held)
+            self.walk(s.body, held)
+            self.walk(s.orelse, held)
+        elif isinstance(s, ast.Try):
+            for blk in ([s.body, s.orelse, s.finalbody]
+                        + [h.body for h in s.handlers]):
+                self.walk(blk, held)
+        elif isinstance(s, ast.Assign):
+            for tgt in s.targets:
+                self._write_target(tgt, held)
+            self._reads(s.value, held)
+        elif isinstance(s, ast.AugAssign):
+            self._write_target(s.target, held)
+            self._reads(s.value, held)
+        elif isinstance(s, ast.Delete):
+            for tgt in s.targets:
+                self._write_target(tgt, held)
+        else:
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self._call_mutations(child, held)
+                    self._reads(child, held)
+
+    # ---- expressions ----------------------------------------------------
+    def _write_target(self, tgt: ast.expr, held: FrozenSet[str]) -> None:
+        a = _self_attr(tgt)
+        if a is not None:
+            self.events.append(("write", a, tgt, held, self.method))
+            return
+        if isinstance(tgt, ast.Subscript):
+            a = _self_attr(tgt.value)
+            if a is not None:
+                self.events.append(("write", a, tgt, held, self.method))
+            else:
+                self._reads(tgt.value, held)
+            self._reads(tgt.slice, held)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._write_target(e, held)
+
+    def _call_mutations(self, e: ast.expr, held: FrozenSet[str]) -> None:
+        for node in ast.walk(e):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                a = _self_attr(node.func.value)
+                if a is not None:
+                    self.events.append(("write", a, node, held,
+                                        self.method))
+
+    def _reads(self, e: ast.expr, held: FrozenSet[str],
+               skip_locks: bool = False) -> None:
+        self._call_mutations(e, held)
+        for node in ast.walk(e):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load):
+                a = _self_attr(node)
+                if a is None or (skip_locks and a in self.locks):
+                    continue
+                self.events.append(("read", a, node, held, self.method))
+
+
+def _lint_class(sf: SourceFile, cls: ast.ClassDef) -> List[Diagnostic]:
+    locks = _lock_fields(cls)
+    if not locks:
+        return []  # single-threaded helper: nothing to enforce
+    events: List[_Event] = []
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mw = _MethodWalker(locks, node.name)
+            mw.walk(node.body, frozenset())
+            events.extend(mw.events)
+    guarded: Dict[str, Set[str]] = {}
+    for kind, field, node, held, method in events:
+        if kind == "write" and held and field not in locks:
+            guarded.setdefault(field, set()).update(held)
+    out: List[Diagnostic] = []
+    seen: Set[tuple] = set()
+    for kind, field, node, held, method in events:
+        if field not in guarded or field in locks or method == "__init__":
+            continue
+        if held & guarded[field]:
+            continue
+        key = (kind, field, node.lineno, node.col_offset)
+        if key in seen:
+            continue
+        seen.add(key)
+        lock_names = ",".join(sorted(guarded[field]))
+        rule = "LD301" if kind == "write" else "LD302"
+        verb = "mutated" if kind == "write" else "read"
+        out.append(Diagnostic(
+            rule,
+            f"{cls.name}.{field} is guarded by self.{lock_names} "
+            f"(inferred) but {verb} in `{method}` with no lock held",
+            sf.path, node.lineno, node.col_offset))
+    return out
+
+
+def _dict_lock_names(tree: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Subscript) \
+                        and isinstance(ce.value, ast.Name) \
+                        and isinstance(ce.slice, ast.Constant) \
+                        and ce.slice.value == "lock":
+                    out.add(ce.value.id)
+    return out
+
+
+def _walk_dict_writes(sf, stmts, held: FrozenSet[str],
+                      locked_names: Set[str],
+                      fname: str) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    for s in stmts:
+        if isinstance(s, ast.With):
+            got = set(held)
+            for item in s.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Subscript) \
+                        and isinstance(ce.value, ast.Name) \
+                        and isinstance(ce.slice, ast.Constant) \
+                        and ce.slice.value == "lock":
+                    got.add(ce.value.id)
+            out.extend(_walk_dict_writes(sf, s.body, frozenset(got),
+                                         locked_names, fname))
+            continue
+        if isinstance(s, ast.Assign):
+            for tgt in s.targets:
+                if isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id in locked_names \
+                        and tgt.value.id not in held:
+                    out.append(Diagnostic(
+                        "LD303",
+                        f"`{tgt.value.id}[...]` written in `{fname}` "
+                        f"without holding `{tgt.value.id}[\"lock\"]`",
+                        sf.path, tgt.lineno, tgt.col_offset))
+        if isinstance(s, (ast.If, ast.While, ast.For)):
+            out.extend(_walk_dict_writes(sf, s.body, held, locked_names,
+                                         fname))
+            out.extend(_walk_dict_writes(sf, s.orelse, held, locked_names,
+                                         fname))
+        elif isinstance(s, ast.Try):
+            for blk in ([s.body, s.orelse, s.finalbody]
+                        + [h.body for h in s.handlers]):
+                out.extend(_walk_dict_writes(sf, blk, held, locked_names,
+                                             fname))
+    return out
+
+
+def _lint_dict_slots(sf: SourceFile) -> List[Diagnostic]:
+    locked_names = _dict_lock_names(sf.tree)
+    if not locked_names:
+        return []
+    out: List[Diagnostic] = []
+    for fn in ast.walk(sf.tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.extend(_walk_dict_writes(sf, fn.body, frozenset(),
+                                         locked_names, fn.name))
+    return out
+
+
+def lint_lock_discipline(sf: SourceFile) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for node in sf.tree.body:
+        if isinstance(node, ast.ClassDef):
+            diags.extend(_lint_class(sf, node))
+    diags.extend(_lint_dict_slots(sf))
+    return sf.filter(diags)

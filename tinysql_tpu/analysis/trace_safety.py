@@ -1,0 +1,416 @@
+"""Pass 1 — trace-safety lint (TS1xx).
+
+Flags host-sync and retrace hazards inside jit-traced regions.  A traced
+region is (a) a function decorated with a jit-like wrapper, (b) a
+function named ``emit`` (the devpipe convention: the pure traced half of
+a prepare/emit node), or (c) a function whose name is passed to a
+jit-like call in the same module (``counted_jit(kernel)``,
+``shard_map(kernel, ...)``).
+
+Inside a traced region the pass taints the function's parameters (they
+are tracers at trace time) and propagates:
+
+- bare parameter names carry CONTAINER taint — branching on a pytree's
+  truthiness (``if cols``) is host-static and fine;
+- subscripts, arithmetic, comparisons, and calls over tainted values
+  carry VALUE taint — these are device arrays;
+- the static tracer attributes (``.shape``/``.dtype``/``.ndim``/
+  ``.size``) and host-structural builtins (``len``/``zip``/...) launder
+  taint: their results are host values.
+
+Hazards:
+
+- TS101: ``np.*`` call over a tainted value (host sync mid-trace; on a
+  real tracer this either raises or silently forces a device round-trip).
+- TS102: ``.item()`` / ``float()`` / ``int()`` / ``bool()`` /
+  ``kernels.d2h`` over a tainted value (explicit host sync).
+- TS103: ``if`` / ``while`` / ``assert`` / conditional expression whose
+  test is VALUE-tainted (data-dependent Python control flow retraces or
+  raises; use ``jnp.where``/masking).
+- TS104: a jit wrapper created inside a function body whose result is
+  neither returned (factory pattern — the caller owns caching) nor
+  stored into a module-level ``*CACHE*`` table: a fresh wrapper per call
+  defeats jax's dispatch cache and retraces every query.
+- TS105: a ``*CACHE*`` table keyed by an expression containing a
+  list/set/dict display or an ndarray constructor — unhashable (raises)
+  or hash-by-identity (never hits).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .diag import Diagnostic, SourceFile, register_rules
+
+register_rules({
+    "TS101": "numpy call over a traced value inside a jit-traced region",
+    "TS102": "host sync (.item()/float()/int()/bool()/d2h) on a traced value",
+    "TS103": "Python control flow on a traced value (use jnp.where/masking)",
+    "TS104": "jit wrapper built per call — cache it at module level",
+    "TS105": "unhashable jit cache key (list/set/dict/ndarray in key)",
+})
+
+_JIT_CALL_NAMES = {"jit", "counted_jit", "shard_map", "pmap", "vmap"}
+_HOST_SAFE_CALLS = {"len", "isinstance", "enumerate", "zip", "range",
+                    "list", "tuple", "getattr", "hasattr", "type", "str",
+                    "sorted", "min", "max", "repr", "id"}
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
+_SYNC_CASTS = {"float", "int", "bool"}
+
+_NONE = 0
+_CONTAINER = 1
+_VALUE = 2
+
+
+def _call_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _root_name(e: ast.expr) -> Optional[str]:
+    while isinstance(e, (ast.Attribute, ast.Subscript, ast.Call)):
+        e = e.func if isinstance(e, ast.Call) else e.value
+    return e.id if isinstance(e, ast.Name) else None
+
+
+def _numpy_aliases(tree: ast.Module) -> Set[str]:
+    out = {"np", "numpy"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+def _jitted_names(tree: ast.Module) -> Set[str]:
+    """Function names passed (as bare names) to jit-like calls anywhere in
+    the module — those defs trace when the wrapper runs."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and _call_name(node.func) in _JIT_CALL_NAMES:
+            for a in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(a, ast.Name):
+                    out.add(a.id)
+    return out
+
+
+def _is_jit_decorated(fn: ast.FunctionDef) -> bool:
+    for d in fn.decorator_list:
+        name = _call_name(d.func) if isinstance(d, ast.Call) else \
+            (d.attr if isinstance(d, ast.Attribute)
+             else d.id if isinstance(d, ast.Name) else None)
+        if name in _JIT_CALL_NAMES:
+            return True
+        # functools.partial(jax.jit, ...) style
+        if isinstance(d, ast.Call) and _call_name(d.func) == "partial":
+            for a in d.args:
+                if (isinstance(a, ast.Attribute) and a.attr in
+                        _JIT_CALL_NAMES) or (isinstance(a, ast.Name)
+                                             and a.id in _JIT_CALL_NAMES):
+                    return True
+    return False
+
+
+class _TaintScanner(ast.NodeVisitor):
+    """Hazard scan of ONE traced function body with taint propagation."""
+
+    def __init__(self, sf: SourceFile, fn: ast.FunctionDef,
+                 np_aliases: Set[str]):
+        self.sf = sf
+        self.fn = fn
+        self.np_aliases = np_aliases
+        self.taint: Dict[str, int] = {}
+        for arg in (list(fn.args.posonlyargs) + list(fn.args.args)
+                    + list(fn.args.kwonlyargs)
+                    + ([fn.args.vararg] if fn.args.vararg else [])
+                    + ([fn.args.kwarg] if fn.args.kwarg else [])):
+            self.taint[arg.arg] = _CONTAINER
+        self.diags: List[Diagnostic] = []
+
+    # ---- taint algebra --------------------------------------------------
+    def taint_of(self, e: ast.expr) -> int:
+        if isinstance(e, ast.Name):
+            return self.taint.get(e.id, _NONE)
+        if isinstance(e, ast.Subscript):
+            base = max(self.taint_of(e.value), self.taint_of(e.slice))
+            return _VALUE if base else _NONE
+        if isinstance(e, ast.Attribute):
+            base = self.taint_of(e.value)
+            if base and e.attr in _STATIC_ATTRS:
+                return _NONE  # host-static tracer metadata
+            return base
+        if isinstance(e, ast.Call):
+            name = _call_name(e.func)
+            args = list(e.args) + [k.value for k in e.keywords]
+            amax = max((self.taint_of(a) for a in args), default=_NONE)
+            if name in _HOST_SAFE_CALLS:
+                return _NONE
+            recv = (self.taint_of(e.func.value)
+                    if isinstance(e.func, ast.Attribute) else _NONE)
+            return _VALUE if (amax or recv) else _NONE
+        if isinstance(e, (ast.BinOp,)):
+            t = max(self.taint_of(e.left), self.taint_of(e.right))
+            return _VALUE if t else _NONE
+        if isinstance(e, ast.UnaryOp):
+            return _VALUE if self.taint_of(e.operand) else _NONE
+        if isinstance(e, ast.Compare):
+            t = max([self.taint_of(e.left)]
+                    + [self.taint_of(c) for c in e.comparators])
+            return _VALUE if t else _NONE
+        if isinstance(e, ast.BoolOp):
+            return max((self.taint_of(v) for v in e.values), default=_NONE)
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return max((self.taint_of(v) for v in e.elts), default=_NONE)
+        if isinstance(e, ast.IfExp):
+            return max(self.taint_of(e.body), self.taint_of(e.orelse))
+        if isinstance(e, ast.Starred):
+            return self.taint_of(e.value)
+        return _NONE
+
+    def _mark_targets(self, tgt: ast.expr, t: int) -> None:
+        if isinstance(tgt, ast.Name):
+            self.taint[tgt.id] = max(self.taint.get(tgt.id, _NONE), t)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._mark_targets(e, t)
+        elif isinstance(tgt, ast.Starred):
+            self._mark_targets(tgt.value, t)
+
+    # ---- statement walk -------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        for tgt in node.targets:
+            # element-wise unpack when arities line up: `v, m, d =
+            # key_vals[i], key_nulls[i], descs[i]` must not smear taint
+            # from the traced operands onto the host-static one
+            if isinstance(tgt, (ast.Tuple, ast.List)) \
+                    and isinstance(node.value, (ast.Tuple, ast.List)) \
+                    and len(tgt.elts) == len(node.value.elts) \
+                    and not any(isinstance(e, ast.Starred)
+                                for e in tgt.elts):
+                for t_e, v_e in zip(tgt.elts, node.value.elts):
+                    if self.taint_of(v_e):
+                        self._mark_targets(t_e, _VALUE)
+            elif self.taint_of(node.value):
+                self._mark_targets(tgt, _VALUE)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.generic_visit(node)
+        if self.taint_of(node.value):
+            self._mark_targets(node.target, _VALUE)
+
+    def _check_test(self, test: ast.expr, node: ast.AST,
+                    kind: str) -> None:
+        if self.taint_of(test) >= _VALUE:
+            self.diags.append(Diagnostic(
+                "TS103",
+                f"{kind} over a traced value inside "
+                f"`{self.fn.name}` — data-dependent Python control flow "
+                f"forces a host sync / retrace (use jnp.where or masks)",
+                self.sf.path, node.lineno, node.col_offset))
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_test(node.test, node, "`if` branch")
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_test(node.test, node, "`while` loop")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self._check_test(node.test, node, "`assert`")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._check_test(node.test, node, "conditional expression")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        name = _call_name(node.func)
+        args = list(node.args) + [k.value for k in node.keywords]
+        tainted = any(self.taint_of(a) for a in args)
+        root = _root_name(node.func) if isinstance(node.func,
+                                                   ast.Attribute) else None
+        if root in self.np_aliases and tainted:
+            self.diags.append(Diagnostic(
+                "TS101",
+                f"numpy call `{ast.unparse(node.func)}` over a traced "
+                f"value inside `{self.fn.name}` — host sync mid-trace "
+                f"(use the jnp equivalent)",
+                self.sf.path, node.lineno, node.col_offset))
+        if name == "item" and isinstance(node.func, ast.Attribute) \
+                and self.taint_of(node.func.value):
+            self.diags.append(Diagnostic(
+                "TS102",
+                f".item() on a traced value inside `{self.fn.name}` — "
+                "explicit device->host sync",
+                self.sf.path, node.lineno, node.col_offset))
+        if isinstance(node.func, ast.Name) and name in _SYNC_CASTS \
+                and any(self.taint_of(a) >= _VALUE for a in node.args):
+            self.diags.append(Diagnostic(
+                "TS102",
+                f"{name}() scalar coercion of a traced value inside "
+                f"`{self.fn.name}` — explicit device->host sync",
+                self.sf.path, node.lineno, node.col_offset))
+        if name == "d2h" and tainted:
+            self.diags.append(Diagnostic(
+                "TS102",
+                f"kernels.d2h on a traced value inside `{self.fn.name}` "
+                "— the packed download belongs OUTSIDE the program",
+                self.sf.path, node.lineno, node.col_offset))
+
+
+def _returned_by(fn: ast.FunctionDef, name: str) -> bool:
+    """Does `fn` return `name` (bare or wrapped in a call, e.g.
+    ``return counted_jit(step)``)?  The factory pattern: the caller owns
+    caching the wrapper, so building it here is not a per-call retrace."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Name) and sub.id == name:
+                    return True
+    return False
+
+
+def _cache_target(stmt: ast.stmt) -> bool:
+    """Does `stmt` store into a module-level *CACHE* table?"""
+    if not isinstance(stmt, ast.Assign):
+        return False
+    for tgt in stmt.targets:
+        for sub in ast.walk(tgt):
+            if isinstance(sub, ast.Subscript):
+                root = _root_name(sub.value)
+                if root and "cache" in root.lower():
+                    return True
+                if isinstance(sub.value, ast.Attribute) \
+                        and "cache" in sub.value.attr.lower():
+                    return True
+    return False
+
+
+def _lint_retrace(sf: SourceFile) -> List[Diagnostic]:
+    """TS104: jit wrappers built per call without a module-level cache."""
+    out: List[Diagnostic] = []
+    parent: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(sf.tree):
+        for child in ast.iter_child_nodes(node):
+            parent[child] = node
+
+    def enclosing_stmt_chain(n: ast.AST):
+        chain = []
+        while n in parent:
+            n = parent[n]
+            chain.append(n)
+        return chain
+
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and _call_name(node.func) in {"jit", "counted_jit"}):
+            # @jit-decorated def nested inside a function body
+            if isinstance(node, ast.FunctionDef) and _is_jit_decorated(node):
+                chain = enclosing_stmt_chain(node)
+                encl = next((c for c in chain
+                             if isinstance(c, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef))),
+                            None)
+                if encl is not None and not _returned_by(encl, node.name):
+                    out.append(Diagnostic(
+                        "TS104",
+                        f"`@jit` def `{node.name}` inside a function "
+                        "body compiles a fresh program per call — hoist "
+                        "behind a module-level cache keyed by structure",
+                        sf.path, node.lineno, node.col_offset))
+            continue
+        chain = enclosing_stmt_chain(node)
+        in_function = any(isinstance(c, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                          for c in chain)
+        if not in_function:
+            continue  # module-level wrapper: compiled once at import
+        ok = False
+        for c in chain:
+            if isinstance(c, ast.Return):
+                ok = True  # factory pattern: the caller owns caching
+                break
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            if isinstance(c, ast.stmt) and _cache_target(c):
+                ok = True
+                break
+        if not ok:
+            out.append(Diagnostic(
+                "TS104",
+                f"`{ast.unparse(node.func)}(...)` result is neither "
+                "returned nor stored in a module-level *CACHE* table — "
+                "a fresh jit wrapper per call retraces every query",
+                sf.path, node.lineno, node.col_offset))
+    return out
+
+
+def _key_unhashable(key: ast.expr) -> bool:
+    for sub in ast.walk(key):
+        if isinstance(sub, (ast.List, ast.Set, ast.Dict, ast.ListComp,
+                            ast.SetComp, ast.DictComp)):
+            return True
+        if isinstance(sub, ast.Call):
+            name = _call_name(sub.func)
+            root = _root_name(sub.func)
+            if name in {"array", "asarray"} and root in {"np", "numpy",
+                                                         "jnp", "jn"}:
+                return True
+    return False
+
+
+def _lint_cache_keys(sf: SourceFile) -> List[Diagnostic]:
+    """TS105: unhashable keys into *CACHE* tables."""
+    out: List[Diagnostic] = []
+    for node in ast.walk(sf.tree):
+        key = None
+        where = None
+        if isinstance(node, ast.Subscript):
+            root = _root_name(node.value)
+            attr = (node.value.attr if isinstance(node.value, ast.Attribute)
+                    else "")
+            if (root and "cache" in root.lower()) \
+                    or "cache" in attr.lower():
+                key, where = node.slice, node
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in {"get", "setdefault"} and node.args:
+            root = _root_name(node.func.value)
+            if root and "cache" in root.lower():
+                key, where = node.args[0], node
+        if key is not None and _key_unhashable(key):
+            out.append(Diagnostic(
+                "TS105",
+                "jit cache key contains a list/set/dict/ndarray — "
+                "unhashable (or identity-hashed, so it never hits); "
+                "use tuples of scalars",
+                sf.path, where.lineno, where.col_offset))
+    return out
+
+
+def lint_trace_safety(sf: SourceFile) -> List[Diagnostic]:
+    np_aliases = _numpy_aliases(sf.tree)
+    jitted = _jitted_names(sf.tree)
+    diags: List[Diagnostic] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        traced = (node.name == "emit" or node.name in jitted
+                  or _is_jit_decorated(node))
+        if not traced:
+            continue
+        scanner = _TaintScanner(sf, node, np_aliases)
+        for stmt in node.body:
+            scanner.visit(stmt)
+        diags.extend(scanner.diags)
+    diags.extend(_lint_retrace(sf))
+    diags.extend(_lint_cache_keys(sf))
+    return sf.filter(diags)

@@ -4,8 +4,12 @@ overrides in tidb-server/main.go:176-234, atomic global :108)."""
 from __future__ import annotations
 
 import threading
-import tomllib
 from dataclasses import dataclass, field, fields, is_dataclass
+
+try:
+    import tomllib  # Python 3.11+
+except ImportError:  # 3.10 runners: minimal strict-subset parser below
+    tomllib = None
 
 
 class ConfigError(Exception):
@@ -71,13 +75,63 @@ def _apply(obj, data: dict, prefix: str = "") -> None:
             setattr(obj, key, v)
 
 
+def _parse_toml_minimal(text: str) -> dict:
+    """Config-file TOML subset for pre-3.11 interpreters: `[section]`
+    headers (dotted allowed) and `key = scalar` lines with string / int /
+    float / bool scalars.  Enough for every config this server reads;
+    anything fancier needs the stdlib tomllib."""
+    root: dict = {}
+    cur = root
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            cur = root
+            for part in line[1:-1].strip().split("."):
+                cur = cur.setdefault(part.strip(), {})
+            continue
+        if "=" not in line:
+            raise ConfigError(f"bad TOML line {lineno}: {raw!r}")
+        key, _, val = line.partition("=")
+        key = key.strip().strip('"')
+        val = val.strip()
+        if val[:1] in ('"', "'"):
+            # quoted string: close at the matching quote; anything after
+            # it may only be an inline comment
+            end = val.find(val[0], 1)
+            rest = val[end + 1:].strip() if end > 0 else "!"
+            if end < 0 or (rest and not rest.startswith("#")):
+                raise ConfigError(
+                    f"bad TOML string at line {lineno}: {raw!r}")
+            cur[key] = val[1:end]
+            continue
+        val = val.split("#", 1)[0].strip()
+        if val in ("true", "false"):
+            cur[key] = val == "true"
+        else:
+            try:
+                cur[key] = int(val)
+            except ValueError:
+                try:
+                    cur[key] = float(val)
+                except ValueError:
+                    raise ConfigError(
+                        f"bad TOML value at line {lineno}: {raw!r}")
+    return root
+
+
 def load(path: str = "") -> Config:
     """TOML file -> Config with strict unknown-key detection
     (reference: ErrConfigValidationFailed)."""
     cfg = Config()
     if path:
-        with open(path, "rb") as f:
-            data = tomllib.load(f)
+        if tomllib is not None:
+            with open(path, "rb") as f:
+                data = tomllib.load(f)
+        else:
+            with open(path, "r", encoding="utf-8") as f:
+                data = _parse_toml_minimal(f.read())
         _apply(cfg, data)
     return cfg
 

@@ -108,6 +108,13 @@ class Domain:
         domain.go:474 Init starts ddl with the owner manager)."""
         with self._mu:
             if self._ddl is None:
+                # closed domains must not mint NEW facades: close() has
+                # already taken its retire snapshot under this lock, so
+                # a facade created now would campaign unretired and its
+                # ownership could only lapse by TTL
+                if self._closed.is_set():
+                    raise RuntimeError(
+                        f"domain {self.server_id} is closed")
                 from ..ddl.ddl import DDL
                 from ..ddl.owner import OwnerManager
                 self._ddl = DDL(self.storage,
@@ -116,13 +123,19 @@ class Domain:
             return self._ddl
 
     def close(self) -> None:
+        # ordering closes the race with ddl(): _closed is set BEFORE the
+        # locked snapshot, so any facade created earlier is visible here
+        # and retired, and any ddl() still waiting on _mu sees _closed
+        # and refuses to mint a facade that would campaign unretired
         self._closed.set()
-        if self._ddl is not None:
+        with self._mu:
+            ddl = self._ddl
+        if ddl is not None:
             # clean shutdown resigns DDL ownership (reference:
             # owner.Manager ResignOwner on server close) so surviving
             # servers take over immediately, not after the lease TTL
             try:
-                self._ddl.owner.retire()
+                ddl.owner.retire()
             except Exception:
                 pass
         _registry_of(self.storage).pop(self.server_id, None)

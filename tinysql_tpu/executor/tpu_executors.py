@@ -21,7 +21,7 @@ from ..expression.aggregation import (AGG_AVG, AGG_COUNT, AGG_FIRST_ROW,
                                       AGG_MAX, AGG_MIN, AGG_SUM)
 from ..mytypes import EvalType, new_real_type
 from ..ops import kernels
-from ..ops.exprjit import compile_expr, compile_filter
+from ..ops.exprjit import compile_filter
 from ..planner.physical import (PhysicalHashAgg, PhysicalHashJoin,
                                 PhysicalProjection, PhysicalSelection,
                                 PhysicalSort, PhysicalTopN)
@@ -1584,6 +1584,9 @@ class TPUTopNExec(Executor):
         return cand
 
 
+_PROJ_CACHE: dict = {}
+
+
 class TPUProjectionExec(Executor):
     """Expression trees fused by XLA into elementwise device kernels."""
 
@@ -1591,16 +1594,27 @@ class TPUProjectionExec(Executor):
         super().__init__(plan.schema, [child])
         self.plan = plan
         self._fn = None
+        self._params = None
 
     def _compiled(self):
         if self._fn is None:
-            jax = kernels.jax()
-            exprs = [compile_expr(e) for e in self.plan.exprs]
-
-            @jax.jit
-            def run(cols):
-                return [f(cols) for f in exprs]
-            self._fn = run
+            # module-level params-compiled program (the _FILTER_CACHE
+            # pattern): executors are rebuilt per query, so a per-instance
+            # @jit wrapper would retrace EVERY query — qlint TS104, the
+            # ~40-70ms-per-dispatch bug class PROFILE.md §1 prices
+            from ..ops.exprjit import (ParamTable, compile_expr_params,
+                                       stable_shape_key)
+            key = ("proj",) + tuple(stable_shape_key(e)
+                                    for e in self.plan.exprs)
+            pt = ParamTable()
+            fns = [compile_expr_params(e, pt) for e in self.plan.exprs]
+            self._params = [kernels.jnp().asarray(a) for a in pt.arrays()]
+            fn = _PROJ_CACHE.get(key)
+            if fn is None:
+                def kernel(cols, params, fns=fns):
+                    return [f(cols, params) for f in fns]
+                fn = _PROJ_CACHE[key] = kernels.counted_jit(kernel)
+            self._fn = fn
         return self._fn
 
     def next(self) -> Optional[Chunk]:
@@ -1618,7 +1632,7 @@ class TPUProjectionExec(Executor):
                 cols.append(HostCol.from_numpy(oc.ret_type, v, m))
             return Chunk.from_columns(cols)
         cols_dev = _marshal(chk)
-        outs = self._compiled()(cols_dev)
+        outs = self._compiled()(cols_dev, tuple(self._params))
         out_cols = []
         for (v, m), oc in zip(outs, self.plan.schema.columns):
             out_cols.append(CCol.from_numpy(oc.ret_type, np.asarray(v),

@@ -33,6 +33,7 @@ class RPCClient:
         self.cluster = cluster
         self.mvcc = store
         self.cop_handler = None  # installed by distsql layer
+        self._raw_mu = threading.Lock()  # guards the lazy _raw attach
 
     # ---- validation ----------------------------------------------------
     def _check(self, ctx: RegionCtx, keys: List[bytes] = (),
@@ -119,11 +120,17 @@ class RPCClient:
     # ---- raw commands (non-transactional CF; reference rawkv.go) -------
     @property
     def raw(self):
-        """Lazily-attached raw column family (rawkv.RawStore)."""
+        """Lazily-attached raw column family (rawkv.RawStore).  The
+        attach is locked: connection threads share one RPCClient, and
+        two racing first-touches would each build a RawStore — one
+        thread's raw writes silently vanishing with its loser copy."""
         rs = getattr(self, "_raw", None)
         if rs is None:
             from .rawkv import RawStore
-            rs = self._raw = RawStore()
+            with self._raw_mu:
+                rs = getattr(self, "_raw", None)
+                if rs is None:
+                    rs = self._raw = RawStore()
         return rs
 
     def raw_get(self, ctx: RegionCtx, key: bytes):

@@ -256,6 +256,7 @@ def counted_jit(fn, **kw):
     tracking is on, the dispatched program's flops / bytes accessed —
     first sight of a (program, shape) only ENQUEUES the analysis; counts
     accrue on dispatches after resolve_pending_costs ran)."""
+    # qlint: disable=TS104 -- counted_jit IS the wrapper factory; callers cache its result
     w = jax().jit(fn, **kw)
     costs: Dict[tuple, Optional[tuple]] = {}
 

@@ -19,6 +19,8 @@ executors) — mirroring the reference's own Vectorized()==false fallback
 """
 from __future__ import annotations
 
+from typing import Optional
+
 from ..expression import Column, Expression
 from ..expression.aggregation import (AGG_AVG, AGG_COUNT, AGG_FIRST_ROW,
                                       AGG_MAX, AGG_MIN, AGG_SUM)
@@ -57,6 +59,69 @@ def _input_rows(p: PhysicalPlan) -> float:
     if not p.children:
         return 0.0
     return max(c.stats_row_count for c in p.children)
+
+
+def tpu_admissibility(p: PhysicalPlan) -> Optional[str]:
+    """CAPABILITY check alone: None when `p`'s hot loop is expressible as
+    device kernels, else the reason it is not.  The ONE definition shared
+    by the enforcer (place_devices) and the plan-device invariant checker
+    (analysis/plan_device.py) — placement and verification can never
+    drift apart.  Cost gating (min_rows) is deliberately not part of
+    admissibility: cost only shrinks the TPU set, never makes an
+    inadmissible operator legal."""
+    if isinstance(p, PhysicalMergeJoin):
+        return "MergeJoin is a sorted-stream operator: CPU tier only"
+    if isinstance(p, PhysicalHashAgg):
+        for e in p.group_by:
+            if not _key_ok(e):
+                return (f"group key {e.key()!r} is neither device-jittable"
+                        " nor a plain string column")
+        for d in p.aggs:
+            if not _agg_ok(d):
+                return (f"aggregate {d.name}({', '.join(a.key() for a in d.args)})"
+                        f"{' distinct' if d.distinct else ''} has no"
+                        " device kernel")
+        return None
+    if isinstance(p, PhysicalHashJoin):
+        def _uns(e):
+            return (e.eval_type is EvalType.INT
+                    and getattr(e.ret_type, "is_unsigned", False))
+        if p.tp not in ("inner", "left"):
+            return f"{p.tp} join has no device kernel"
+        if not p.left_keys:
+            return "cartesian join has no device kernel"
+        if len(p.left_keys) == 1:
+            lk, rk = p.left_keys[0], p.right_keys[0]
+            if not (is_jittable(lk) and is_jittable(rk)):
+                return "join keys not device-jittable"
+            if _uns(lk) != _uns(rk):
+                return ("mixed-signedness int keys need per-pair compare"
+                        " semantics the sort+searchsorted kernel lacks")
+            return None
+        for k in list(p.left_keys) + list(p.right_keys):
+            if not (isinstance(k, Column)
+                    and k.eval_type is EvalType.INT
+                    and not _uns(k)):
+                return ("multi-key join needs plain signed-int columns"
+                        " (devpipe composite lanes)")
+        return None
+    if isinstance(p, (PhysicalSort, PhysicalTopN)):
+        for e, _ in p.by:
+            if not _key_ok(e):
+                return (f"sort key {e.key()!r} is neither device-jittable"
+                        " nor a plain string column")
+        return None
+    if isinstance(p, PhysicalProjection):
+        for e in p.exprs:
+            if not is_jittable(e):
+                return f"projection expr {e.key()!r} not device-jittable"
+        return None
+    if isinstance(p, PhysicalSelection):
+        for c in p.conditions:
+            if not is_jittable(c):
+                return f"filter condition {c.key()!r} not device-jittable"
+        return None
+    return f"{p.op_name()} has no device lowering"
 
 
 def _mesh_join_strategy(p: PhysicalHashJoin, n_shards: int) -> None:
@@ -113,37 +178,11 @@ def place_devices(p: PhysicalPlan, enabled: bool = True,
     if not enabled:
         return p
     big = _input_rows(p) >= min_rows
-    if isinstance(p, PhysicalHashAgg):
-        p.use_tpu = (big and all(_key_ok(e) for e in p.group_by)
-                     and all(_agg_ok(d) for d in p.aggs))
-    elif isinstance(p, PhysicalMergeJoin):
-        p.use_tpu = False  # sorted-stream operator stays on the CPU tier
-    elif isinstance(p, PhysicalHashJoin):
-        def _uns(e):
-            return (e.eval_type is EvalType.INT
-                    and getattr(e.ret_type, "is_unsigned", False))
-        def _pair_ok(lk, rk):
-            # mixed-signedness int keys need per-pair compare semantics
-            # the sort+searchsorted kernel lacks: CPU tier
-            return (is_jittable(lk) and is_jittable(rk)
-                    and _uns(lk) == _uns(rk))
-        multi_ok = (len(p.left_keys) > 1
-                    # multi-key: devpipe composite lanes — signed-int
-                    # plain columns only (bounded composite ranges)
-                    and all(isinstance(k, Column)
-                            and k.eval_type is EvalType.INT
-                            and not _uns(k)
-                            for k in list(p.left_keys) + list(p.right_keys)))
-        p.use_tpu = (big and p.tp in ("inner", "left")
-                     and ((len(p.left_keys) == 1
-                           and _pair_ok(p.left_keys[0], p.right_keys[0]))
-                          or multi_ok))
-        if p.use_tpu and mesh_shards >= 2:
+    if isinstance(p, (PhysicalHashAgg, PhysicalHashJoin, PhysicalSort,
+                      PhysicalTopN, PhysicalProjection,
+                      PhysicalSelection)):
+        p.use_tpu = big and tpu_admissibility(p) is None
+        if (isinstance(p, PhysicalHashJoin) and p.use_tpu
+                and mesh_shards >= 2):
             _mesh_join_strategy(p, mesh_shards)
-    elif isinstance(p, (PhysicalSort, PhysicalTopN)):
-        p.use_tpu = big and all(_key_ok(e) for e, _ in p.by)
-    elif isinstance(p, PhysicalProjection):
-        p.use_tpu = big and all(is_jittable(e) for e in p.exprs)
-    elif isinstance(p, PhysicalSelection):
-        p.use_tpu = big and all(is_jittable(c) for c in p.conditions)
     return p

@@ -489,12 +489,18 @@ def normalize_logical(logical: LogicalPlan,
 
 def optimize(logical: LogicalPlan, tpu: bool = True,
              tpu_min_rows: float = 0.0,
-             mesh_shards: int = 0) -> PhysicalPlan:
+             mesh_shards: int = 0,
+             verify: bool = False) -> PhysicalPlan:
     """The System-R style pipeline (reference: planner/core/optimizer.go:77
     — the fixed-order rewrite list of optimizer.go:44-55), physical
     conversion, estimate derivation, then the device enforcer (cost+
     capability, incl. the mesh broadcast-vs-shuffle join strategy) +
-    coprocessor pushdown."""
+    coprocessor pushdown.
+
+    `verify=True` (the tidb_qlint_verify sysvar) runs the qlint
+    plan-device invariant checker over the placed plan and raises
+    analysis.PlanDeviceError instead of handing a mis-placed plan to the
+    executor — the runtime arm of `tools/lint.py --plans`."""
     logical = normalize_logical(logical)
     logical = topn_pushdown(logical)
     phys = to_physical(logical)
@@ -504,4 +510,8 @@ def optimize(logical: LogicalPlan, tpu: bool = True,
     phys = place_devices(phys, enabled=tpu, min_rows=tpu_min_rows,
                          mesh_shards=mesh_shards)
     from .cop import push_to_cop
-    return push_to_cop(phys)
+    phys = push_to_cop(phys)
+    if verify:
+        from ..analysis.plan_device import verify_plan
+        verify_plan(phys)
+    return phys

@@ -59,6 +59,10 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # stay resident in device memory (DeviceColumn chunks); 0 forces the
     # host-extraction path
     "tidb_device_passthrough": 1,
+    # opt-in runtime arm of the qlint plan-device checker: verify every
+    # placed plan's device invariants before execution (analysis/
+    # plan_device.py) and fail the statement on violation
+    "tidb_qlint_verify": 0,
     "sql_mode": "STRICT_TRANS_TABLES",
     "max_execution_time": 0,
 }
@@ -367,13 +371,18 @@ class Session:
                 shards = len(kernels.jax().devices())
             except Exception:
                 shards = 0
+        verify = bool(self.get_sysvar("tidb_qlint_verify"))
         if bool(self.get_sysvar("tidb_enable_cascades_planner")):
             from ..planner.cascades import find_best_plan
-            return find_best_plan(logical, tpu=use_tpu,
+            phys = find_best_plan(logical, tpu=use_tpu,
                                   tpu_min_rows=min_rows,
                                   mesh_shards=shards)
+            if verify:
+                from ..analysis.plan_device import verify_plan
+                verify_plan(phys)
+            return phys
         return optimize(logical, tpu=use_tpu, tpu_min_rows=min_rows,
-                        mesh_shards=shards)
+                        mesh_shards=shards, verify=verify)
 
     def _run_select_plan(self, stmt: ast.SelectStmt, txn) -> List[list]:
         builder = PlanBuilder(self)

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""qlint CLI — run the static-analysis passes (see docs/LINT.md).
+
+Usage:
+    python tools/lint.py [--strict] [--pass trace|locks|plans|all]
+                         [--rules] [--fuzz-n N] [paths...]
+
+- `--strict` (the CI entry point): run every pass over its default scope
+  and exit non-zero on any violation.
+- `--pass trace|locks` over explicit paths: lint just those files.
+- `--pass plans`: plan the SQL corpus (tests/test_sql.py statement
+  replay + tests/test_sqlite_diff.py's seeded generator) with the TPU
+  tier enabled and check every placed plan's device invariants.
+- `--rules`: print the rule catalogue.
+
+Exit status: 0 clean, 1 violations, 2 usage/internal error.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+# hermetic backend selection BEFORE anything imports jax: the runner
+# image's sitecustomize registers an axon PJRT plugin whose tunnel hangs
+# when the relay is down (tests/conftest.py documents the same override)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+#: modules whose threading model the lock-discipline pass enforces
+LOCK_SCOPE = [
+    "tinysql_tpu/ddl/owner.py",
+    "tinysql_tpu/ddl/worker.py",
+    "tinysql_tpu/domain/domain.py",
+    "tinysql_tpu/server/server.py",
+    "tinysql_tpu/kv/rpc.py",
+]
+
+
+def _force_cpu_backend() -> None:
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def run_trace(paths):
+    from tinysql_tpu.analysis import gather_sources, lint_trace_safety
+    diags = []
+    for p in paths:
+        for sf in gather_sources(p):
+            diags.extend(sf.check_suppression_syntax())
+            diags.extend(lint_trace_safety(sf))
+    return diags
+
+
+def run_locks(paths):
+    from tinysql_tpu.analysis import gather_sources, lint_lock_discipline
+    diags = []
+    for p in paths:
+        for sf in gather_sources(p):
+            diags.extend(sf.check_suppression_syntax())
+            diags.extend(lint_lock_discipline(sf))
+    return diags
+
+
+def run_plans(fuzz_n=None):
+    _force_cpu_backend()
+    from tinysql_tpu.analysis.plan_device import check_corpus
+    return check_corpus(REPO_ROOT, fuzz_queries=fuzz_n)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="qlint", description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--strict", action="store_true",
+                    help="run all passes over their default scopes")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=["trace", "locks", "plans", "all"],
+                    help="which pass(es) to run (default: trace+locks "
+                         "over paths; all under --strict)")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    ap.add_argument("--fuzz-n", type=int, default=None,
+                    help="fuzz-corpus query count for the plans pass "
+                         "(default: the test suite's own N_QUERIES)")
+    args = ap.parse_args(argv)
+
+    from tinysql_tpu.analysis import format_diagnostics
+    from tinysql_tpu.analysis.diag import RULES
+
+    if args.rules:
+        for code in sorted(RULES):
+            print(f"{code}  {RULES[code]}")
+        return 0
+
+    passes = set(args.passes or [])
+    if args.strict or "all" in passes:
+        passes = {"trace", "locks", "plans"}
+    elif not passes:
+        passes = {"trace", "locks"}
+
+    pkg = os.path.join(REPO_ROOT, "tinysql_tpu")
+    paths = args.paths or [pkg]
+    diags = []
+    if "trace" in passes:
+        diags.extend(run_trace(paths))
+    if "locks" in passes:
+        lock_paths = (args.paths if args.paths
+                      else [os.path.join(REPO_ROOT, p)
+                            for p in LOCK_SCOPE])
+        diags.extend(run_locks(lock_paths))
+    if "plans" in passes:
+        diags.extend(run_plans(args.fuzz_n))
+
+    if diags:
+        print(format_diagnostics(diags))
+        return 1
+    print("qlint: clean "
+          f"({'+'.join(sorted(passes))} over {len(paths)} path(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
