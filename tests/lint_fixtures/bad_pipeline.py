@@ -1,0 +1,26 @@
+"""qlint fixture: host syncs inside a BlockPipeline stage callback.
+
+The stage thread exists to PREPARE the next block (slice, pad, enqueue
+H2D) while the device computes the current one; every sync below parks
+it on the device instead — TS106.  Never imported, only parsed.
+"""
+import numpy as np
+
+
+def stage(item):
+    dev = jn.asarray(item)            # device upload: the stage's job, OK
+    host = np.asarray(dev)            # TS106: D2H sync mid-pipeline
+    dev.block_until_ready()           # TS106: explicit device barrier
+    kernels.d2h(dev)                  # TS106: counted download
+    n = int(dev[0])                   # TS106: scalar coercion syncs
+    return dev, host, n
+
+
+def ok_stage(item):
+    pad = np.zeros(16)                # host constant: fine
+    pad[: len(item)] = item
+    return jn.asarray(pad)            # upload only: fine
+
+
+pipe = BlockPipeline(stage, [1, 2, 3], depth=2)
+pipe2 = BlockPipeline(stage_fn=ok_stage, items=[4, 5], depth=2)

@@ -1,0 +1,142 @@
+"""bucket()/pad1() edge cases and padded-row inertness.
+
+Every device kernel pads its inputs to a power-of-two bucket with a
+validity mask; these tests pin the bucket function's edges (n=0, n=1,
+exact powers of two, growth monotonicity) and prove padding rows stay
+INERT through the valid mask for the hash-agg and topk/sort kernels —
+the invariant the async block pipeline's per-block padding rides on.
+"""
+import numpy as np
+
+from tinysql_tpu.ops import kernels
+
+
+# ---- bucket() ------------------------------------------------------------
+
+def test_bucket_edges():
+    assert kernels.bucket(0) == 16
+    assert kernels.bucket(1) == 16
+    assert kernels.bucket(15) == 16
+    assert kernels.bucket(16) == 16       # exact power of two: no growth
+    assert kernels.bucket(17) == 32
+
+
+def test_bucket_exact_powers_fixed():
+    for k in range(4, 22):
+        assert kernels.bucket(2 ** k) == 2 ** k
+        assert kernels.bucket(2 ** k + 1) == 2 ** (k + 1)
+
+
+def test_bucket_growth_monotone():
+    prev = 0
+    for n in range(0, 4100):
+        b = kernels.bucket(n)
+        assert b >= max(n, 16)
+        assert b >= prev, (n, b, prev)  # buckets never shrink as n grows
+        prev = b
+
+
+# ---- pad1() --------------------------------------------------------------
+
+def test_pad1_empty_input():
+    out = kernels.pad1(np.empty(0, dtype=np.int64), 16)
+    assert out.shape == (16,) and (out == 0).all()
+    outb = kernels.pad1(np.empty(0, dtype=bool), 16, True)
+    assert outb.dtype == bool and outb.all()
+
+
+def test_pad1_single_row():
+    out = kernels.pad1(np.array([7], dtype=np.int64), 16)
+    assert out[0] == 7 and (out[1:] == 0).all()
+
+
+def test_pad1_exact_bucket_is_identity():
+    a = np.arange(16, dtype=np.int64)
+    assert kernels.pad1(a, 16) is a  # no copy when already bucket-sized
+
+
+def test_pad1_fill_value():
+    out = kernels.pad1(np.array([1.5]), 4, fill=np.inf)
+    assert out[0] == 1.5 and np.isinf(out[1:]).all()
+
+
+# ---- padding rows are inert through the valid mask -----------------------
+
+def _group_ref(keys, vals):
+    out = {}
+    for k, v in zip(keys, vals):
+        s, c = out.get(k, (0.0, 0))
+        out[k] = (s + v, c + 1)
+    return out
+
+
+def test_hash_agg_padding_inert():
+    # n=5 in a 16-bucket: 11 padding rows must contribute to NO group
+    keys = np.array([1, 1, 2, 2, 2], dtype=np.int64)
+    kn = np.zeros(5, dtype=bool)
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+    vn = np.zeros(5, dtype=bool)
+    out_keys, out_aggs, _first = kernels.group_aggregate(
+        [(keys, kn)], [("sum", True), ("count", True)],
+        [(vals, vn), (vals, vn)], 5)
+    got_k = np.asarray(out_keys[0][0])
+    ref = _group_ref(keys, vals)
+    assert sorted(got_k.tolist()) == sorted(ref)
+    for k, s, c in zip(got_k, np.asarray(out_aggs[0][0]),
+                       np.asarray(out_aggs[1][0])):
+        assert (s, c) == ref[int(k)], (k, s, c)
+
+
+def test_hash_agg_padding_inert_exact_bucket():
+    # n == bucket exactly: zero padding rows, same answer
+    n = 16
+    keys = np.arange(n, dtype=np.int64) % 3
+    vals = np.ones(n)
+    zb = np.zeros(n, dtype=bool)
+    out_keys, out_aggs, _ = kernels.group_aggregate(
+        [(keys, zb)], [("count", True)], [(vals, zb)], n)
+    counts = dict(zip(np.asarray(out_keys[0][0]).tolist(),
+                      np.asarray(out_aggs[0][0]).tolist()))
+    assert counts == {0: 6, 1: 5, 2: 5}
+
+
+def test_hash_agg_filter_mask_excludes_rows():
+    # the valid mask is the SAME lane padding rides: masked-off real rows
+    # must vanish exactly like padding does
+    keys = np.array([1, 1, 2], dtype=np.int64)
+    vals = np.array([10.0, 20.0, 30.0])
+    zb = np.zeros(3, dtype=bool)
+    mask = np.array([True, False, True])
+    out_keys, out_aggs, _ = kernels.group_aggregate(
+        [(keys, zb)], [("sum", True)], [(vals, zb)], 3, filter_mask=mask)
+    got = dict(zip(np.asarray(out_keys[0][0]).tolist(),
+                   np.asarray(out_aggs[0][0]).tolist()))
+    assert got == {1: 10.0, 2: 30.0}
+
+
+def test_topk_sort_padding_inert():
+    # k far beyond n: only real rows may surface (padding carries the
+    # worst-score sentinel and must never win a slot)
+    v = np.array([5.0, 1.0, 3.0])
+    m = np.zeros(3, dtype=bool)
+    ids = np.asarray(kernels.top_k([(v, m)], [False], 3, 10))
+    assert ids.tolist() == [1, 2, 0]      # ascending, all 3, nothing else
+    ids_d = np.asarray(kernels.top_k([(v, m)], [True], 3, 2))
+    assert ids_d.tolist() == [0, 2]
+
+
+def test_sort_permutation_padding_inert():
+    # n=1 in a 16-bucket: the permutation is exactly [0]
+    v = np.array([42], dtype=np.int64)
+    m = np.zeros(1, dtype=bool)
+    perm = np.asarray(kernels.sort_permutation([(v, m)], [False], 1))
+    assert perm.tolist() == [0]
+    # multi-key, n below bucket: a permutation of range(n) exactly
+    a = np.array([2, 1, 2, 1, 0], dtype=np.int64)
+    b = np.array([1.0, 2.0, 0.5, 1.0, 9.0])
+    z = np.zeros(5, dtype=bool)
+    perm = np.asarray(kernels.sort_permutation([(a, z), (b, z)],
+                                               [False, True], 5))
+    assert sorted(perm.tolist()) == [0, 1, 2, 3, 4]
+    assert perm.tolist() == sorted(
+        range(5), key=lambda i: (a[i], -b[i]))

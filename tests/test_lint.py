@@ -31,6 +31,29 @@ def test_trace_fixture_fires_every_rule():
     assert {"TS101", "TS102", "TS103", "TS104", "TS105"} <= got
 
 
+def test_pipeline_fixture_fires_ts106():
+    sf = SourceFile(os.path.join(FIXDIR, "bad_pipeline.py"))
+    got = [d for d in lint_trace_safety(sf) if d.rule == "TS106"]
+    # np.asarray-over-device, block_until_ready, d2h, int() coercion
+    assert len(got) == 4, [d.format() for d in got]
+
+
+def test_pipeline_clean_stage_not_flagged(tmp_path):
+    # uploads are the stage's JOB; np over host values stays legal, and a
+    # function not passed to BlockPipeline is out of scope entirely
+    src = ("import numpy as np\n\n\n"
+           "def stage(item):\n"
+           "    pad = np.zeros(16)\n"
+           "    pad[: len(item)] = item\n"
+           "    return jn.asarray(pad)\n\n\n"
+           "def not_a_stage(dev):\n"
+           "    return np.asarray(jn.asarray(dev))\n\n\n"
+           "pipe = BlockPipeline(stage, [1], depth=2)\n")
+    p = tmp_path / "ok_stage.py"
+    p.write_text(src)
+    assert lint_trace_safety(SourceFile(str(p))) == []
+
+
 def test_trace_suppression_requires_justification():
     sf = SourceFile(os.path.join(FIXDIR, "bad_suppress.py"))
     # the unjustified disable does NOT silence TS101 and raises QL001
@@ -192,13 +215,17 @@ def test_runtime_verifier_sysvar(planned):
 
 # ---- the tree itself is lint-clean -------------------------------------
 
-LOCK_SCOPE = [
-    "tinysql_tpu/ddl/owner.py",
-    "tinysql_tpu/ddl/worker.py",
-    "tinysql_tpu/domain/domain.py",
-    "tinysql_tpu/server/server.py",
-    "tinysql_tpu/kv/rpc.py",
-]
+# ONE definition of the lock-discipline scope: the CLI's (tools/lint.py)
+# — a module added there is automatically enforced by the tree test too
+def _lint_cli_module():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("tinysql_lint_cli", LINT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+LOCK_SCOPE = _lint_cli_module().LOCK_SCOPE
 
 
 def test_tree_trace_safety_clean():
@@ -232,6 +259,7 @@ def test_corpus_plans_clean():
     ("trace", "bad_trace.py"),
     ("locks", "bad_locks.py"),
     ("trace", "bad_suppress.py"),
+    ("trace", "bad_pipeline.py"),
 ])
 def test_cli_exits_nonzero_on_fixture(passname, fixture):
     r = subprocess.run(

@@ -34,6 +34,13 @@ Hazards:
 - TS105: a ``*CACHE*`` table keyed by an expression containing a
   list/set/dict display or an ndarray constructor — unhashable (raises)
   or hash-by-identity (never hits).
+- TS106: a host sync inside a PIPELINE STAGE CALLBACK (a function passed
+  to ``BlockPipeline`` as its stage_fn).  The stage thread's whole job
+  is to prepare the NEXT block while the device computes the current
+  one; ``jax.block_until_ready``, ``kernels.d2h``, or ``np.asarray``
+  over a device value parks the stage thread on the device and defeats
+  the overlap.  Device UPLOADS (``jn.asarray`` over host values) are the
+  point of the stage and stay legal.
 """
 from __future__ import annotations
 
@@ -48,6 +55,8 @@ register_rules({
     "TS103": "Python control flow on a traced value (use jnp.where/masking)",
     "TS104": "jit wrapper built per call — cache it at module level",
     "TS105": "unhashable jit cache key (list/set/dict/ndarray in key)",
+    "TS106": "host sync inside a pipeline stage callback (defeats the "
+             "host-staging/device-compute overlap)",
 })
 
 _JIT_CALL_NAMES = {"jit", "counted_jit", "shard_map", "pmap", "vmap"}
@@ -266,6 +275,129 @@ class _TaintScanner(ast.NodeVisitor):
                 self.sf.path, node.lineno, node.col_offset))
 
 
+# ---- TS106: pipeline stage callbacks ------------------------------------
+
+_PIPELINE_CTORS = {"BlockPipeline"}
+_DEV_UPLOAD_CALLS = {"asarray", "array", "device_put"}
+_DEV_UPLOAD_ROOTS = {"jn", "jnp"}
+
+
+def _stage_fn_names(tree: ast.Module) -> Set[str]:
+    """Function names passed to a BlockPipeline construction as its stage
+    callback (first positional argument or ``stage_fn=`` keyword)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _call_name(node.func) in _PIPELINE_CTORS):
+            continue
+        cands = list(node.args[:1]) + [k.value for k in node.keywords
+                                       if k.arg == "stage_fn"]
+        for a in cands:
+            if isinstance(a, ast.Name):
+                out.add(a.id)
+    return out
+
+
+class _StageScanner(ast.NodeVisitor):
+    """TS106 scan of ONE stage callback.  Device-PRODUCING calls
+    (``jn.asarray``/``jnp.asarray``/``device_put``/``_dev_upload``) taint
+    the names they assign; a host sync — ``block_until_ready`` or
+    ``kernels.d2h`` anywhere, ``np.asarray``/``np.array`` or a
+    ``float()``/``int()`` coercion over a device-tainted value — parks
+    the stage thread on the device mid-pipeline."""
+
+    def __init__(self, sf: SourceFile, fn: ast.FunctionDef,
+                 np_aliases: Set[str]):
+        self.sf = sf
+        self.fn = fn
+        self.np_aliases = np_aliases
+        self.dev: Set[str] = set()
+        self.diags: List[Diagnostic] = []
+
+    def _devval(self, e: ast.expr) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in self.dev
+        if isinstance(e, ast.Call):
+            name = _call_name(e.func)
+            root = _root_name(e.func)
+            if name in _DEV_UPLOAD_CALLS and root in _DEV_UPLOAD_ROOTS:
+                return True
+            if name == "_dev_upload":
+                return True
+            args = list(e.args) + [k.value for k in e.keywords]
+            return any(self._devval(a) for a in args)
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return any(self._devval(v) for v in e.elts)
+        if isinstance(e, ast.Subscript):
+            return self._devval(e.value)
+        if isinstance(e, ast.Attribute):
+            return self._devval(e.value)
+        if isinstance(e, ast.BinOp):
+            return self._devval(e.left) or self._devval(e.right)
+        if isinstance(e, ast.IfExp):
+            return self._devval(e.body) or self._devval(e.orelse)
+        if isinstance(e, ast.Starred):
+            return self._devval(e.value)
+        return False
+
+    def _mark(self, tgt: ast.expr) -> None:
+        if isinstance(tgt, ast.Name):
+            self.dev.add(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._mark(e)
+        elif isinstance(tgt, ast.Starred):
+            self._mark(tgt.value)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.generic_visit(node)
+        if self._devval(node.value):
+            for tgt in node.targets:
+                self._mark(tgt)
+
+    def _flag(self, node: ast.AST, what: str) -> None:
+        self.diags.append(Diagnostic(
+            "TS106",
+            f"{what} inside pipeline stage callback `{self.fn.name}` — "
+            "the stage thread must only PREPARE the next block "
+            "(host syncs mid-pipeline serialize staging behind the "
+            "device and defeat the overlap)",
+            self.sf.path, node.lineno, node.col_offset))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        name = _call_name(node.func)
+        args = list(node.args) + [k.value for k in node.keywords]
+        root = _root_name(node.func) if isinstance(node.func,
+                                                   ast.Attribute) else None
+        if name == "block_until_ready":
+            self._flag(node, "`block_until_ready` host sync")
+        elif name == "d2h":
+            self._flag(node, "`kernels.d2h` download")
+        elif root in self.np_aliases and name in ("asarray", "array") \
+                and any(self._devval(a) for a in args):
+            self._flag(node, f"`np.{name}` over a device value")
+        elif isinstance(node.func, ast.Name) and name in _SYNC_CASTS \
+                and any(self._devval(a) for a in node.args):
+            self._flag(node, f"`{name}()` scalar coercion of a device "
+                             "value")
+
+
+def _lint_stage_callbacks(sf: SourceFile,
+                          np_aliases: Set[str]) -> List[Diagnostic]:
+    names = _stage_fn_names(sf.tree)
+    if not names:
+        return []
+    out: List[Diagnostic] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef) and node.name in names:
+            scanner = _StageScanner(sf, node, np_aliases)
+            for stmt in node.body:
+                scanner.visit(stmt)
+            out.extend(scanner.diags)
+    return out
+
+
 def _returned_by(fn: ast.FunctionDef, name: str) -> bool:
     """Does `fn` return `name` (bare or wrapped in a call, e.g.
     ``return counted_jit(step)``)?  The factory pattern: the caller owns
@@ -413,4 +545,5 @@ def lint_trace_safety(sf: SourceFile) -> List[Diagnostic]:
         diags.extend(scanner.diags)
     diags.extend(_lint_retrace(sf))
     diags.extend(_lint_cache_keys(sf))
+    diags.extend(_lint_stage_callbacks(sf, np_aliases))
     return sf.filter(diags)

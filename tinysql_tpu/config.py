@@ -48,6 +48,10 @@ class Config:
     lease: str = "45s"
     num_stores: int = 1
     use_tpu: bool = True
+    # persistent XLA compile-cache directory; "" = <repo>/.jax_cache
+    # (ops/kernels.py _cache_dir resolution: sysvar tidb_compile_cache_dir
+    # > TINYSQL_JAX_CACHE env > this entry > default)
+    compile_cache_dir: str = ""
     log: Log = field(default_factory=Log)
     status: Status = field(default_factory=Status)
     security: Security = field(default_factory=Security)

@@ -53,6 +53,11 @@ Key design points (why this maps well onto TPU + XLA):
 """
 from __future__ import annotations
 
+import os
+import queue
+import threading
+import time
+
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -61,7 +66,7 @@ from ..chunk import Chunk, Column as CCol
 from ..expression import Column as ExprColumn, Constant
 from ..expression.aggregation import AGG_COUNT, AGG_SUM
 from ..mytypes import EvalType
-from ..ops import kernels
+from ..ops import kernels, progcache
 from ..ops.exprjit import (ParamTable, compile_expr_params, is_jittable,
                            stable_shape_key)
 from ..planner.physical import (PhysicalHashAgg, PhysicalHashJoin,
@@ -73,11 +78,151 @@ from ..planner.physical import (PhysicalHashAgg, PhysicalHashJoin,
 MAX_DENSE_RANGE = 1 << 25   # dense key->pos tables up to 32M slots (128MB)
 MAX_EXPAND = 1 << 23        # CSR-join output bucket cap (8M rows)
 
-_JIT_CACHE: Dict[tuple, tuple] = {}
-
 # structural node keys that have actually been compiled into some fused
 # pipeline — introspection surface for tests and the multichip dryrun
 COMPILED_NODE_KEYS: set = set()
+
+
+# =========================================================================
+# async block pipeline: host-staging / device-compute overlap
+# =========================================================================
+
+def pipeline_depth(session_vars=None) -> int:
+    """Staging-queue depth for the async block pipeline: how many staged
+    blocks may be in flight ahead of the consumer (the double-buffer
+    bound on transient device slots).  0 = synchronous inline staging —
+    no thread, the exact sequential order, byte-identical results.
+    Resolution: TINYSQL_PIPELINE_DEPTH env (tests/CI kill-switch) >
+    tidb_pipeline_depth sysvar > default 2 (double-buffered)."""
+    env = os.environ.get("TINYSQL_PIPELINE_DEPTH")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            return 0
+    if session_vars is not None:
+        try:
+            return max(0, int(session_vars.get("tidb_pipeline_depth", 2)
+                              or 0))
+        except Exception:
+            return 2
+    return 2
+
+
+#: end-of-stream marker on the staging queue
+_PIPE_DONE = object()
+
+
+class BlockPipeline:
+    """Bounded-depth staging queue: ONE producer thread runs
+    ``stage_fn(item)`` for each item IN ORDER — the host half of a block
+    (slice, decode/encode, pad, enqueue the H2D upload) — while the
+    consumer iterates the staged results in the same order and dispatches
+    device compute.  With JAX's async dispatch the device runs block k's
+    kernel while the stage thread prepares block k+1's uploads; the only
+    sync points are each block's result materialization (the drain).
+
+    ``depth <= 0`` degrades to synchronous inline staging with NO thread:
+    the same calls in the same order, so results are byte-identical with
+    the pipeline on or off (the TINYSQL_PIPELINE_DEPTH=0 contract).
+
+    Error contract: an exception inside ``stage_fn`` is captured, the
+    producer stops, and the exception re-raises ON THE CALLER at the
+    point the failed block would have been consumed — blocks staged
+    before it still deliver.  Abandoning the iterator (break / caller
+    exception) cancels the producer and joins the thread; ``close()`` is
+    idempotent.  Host syncs inside ``stage_fn`` defeat the overlap —
+    qlint TS106 flags them statically."""
+
+    def __init__(self, stage_fn: Callable, items, depth: int = 2):
+        self._stage = stage_fn
+        self._items = list(items)
+        self._sync = depth <= 0
+        self._mu = threading.Lock()
+        self._stage_s = 0.0
+        self._hwm = 0
+        self._cancel = threading.Event()
+        self._q = None
+        self._thread = None
+        if not self._sync:
+            self._q = queue.Queue(maxsize=max(1, depth))
+            self._thread = threading.Thread(
+                target=self._run, name="tinysql-pipe-stage", daemon=True)
+            self._thread.start()
+
+    # ---- producer -------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            for item in self._items:
+                if self._cancel.is_set():
+                    return
+                t0 = time.time()
+                out = self._stage(item)
+                dt = time.time() - t0
+                with self._mu:
+                    self._stage_s += dt
+                if not self._put((out, None)):
+                    return
+        except BaseException as exc:  # delivered to the consumer
+            self._put((None, exc))
+            return
+        self._put(_PIPE_DONE)
+
+    def _put(self, entry) -> bool:
+        """Cancellation-aware bounded put: a consumer that stopped
+        pulling must never leave this thread parked on a full queue."""
+        while not self._cancel.is_set():
+            try:
+                self._q.put(entry, timeout=0.05)
+            except queue.Full:
+                continue
+            with self._mu:
+                self._hwm = max(self._hwm, self._q.qsize())
+            return True
+        return False
+
+    # ---- consumer -------------------------------------------------------
+    def __iter__(self):
+        if self._sync:
+            for item in self._items:
+                t0 = time.time()
+                out = self._stage(item)
+                dt = time.time() - t0
+                with self._mu:
+                    self._stage_s += dt
+                yield out
+            return
+        try:
+            while True:
+                entry = self._q.get()
+                if entry is _PIPE_DONE:
+                    break
+                out, exc = entry
+                if exc is not None:
+                    raise exc
+                yield out
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Cancel the producer and join its thread (idempotent)."""
+        self._cancel.set()
+        if self._thread is None:
+            return
+        while True:  # wake a producer parked on a full queue
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=10)
+
+    def stats(self) -> dict:
+        """{"blocks", "stage_s", "depth_hwm"} — feed kernels.pipe_record
+        AFTER the consumer loop (the producer is joined by then)."""
+        with self._mu:
+            return {"blocks": len(self._items),
+                    "stage_s": self._stage_s,
+                    "depth_hwm": self._hwm}
 
 
 class _PipeBuilder:
@@ -2319,9 +2464,8 @@ class DevPipeExec:
                      tuple(getattr(a, "shape", ())))
                     for a in pb.inputs)
         key = ("pipe", small, tuple(pb.kparts), sig)
-        ent = _JIT_CACHE.get(key)
         if small:
-            if ent is None:
+            def build_small():
                 schema: list = []
                 emit = tv.emit
 
@@ -2332,31 +2476,31 @@ class DevPipeExec:
                         flat.append(v)
                         flat.append(m)
                     return kernels.pack_arrays(schema, flat)
-                ent = _JIT_CACHE[key] = (kernels.counted_jit(mega), schema)
                 COMPILED_NODE_KEYS.update(pb.kparts)
-            fn, schema = ent
+                return kernels.counted_jit(mega), schema
+            fn, schema = progcache.get(key, build_small)
             vals = kernels.unpack_flat(fn(pb.inputs), schema)
             keep = np.nonzero(vals[0])[0]
             host = [(vals[1 + 2 * i][keep], vals[2 + 2 * i][keep])
                     for i in range(ncols)]
         else:
-            if ent is None:
+            def build_big():
                 emit = tv.emit
 
                 def mega(args):
                     valid, cols = emit(args)
                     return [valid] + [x for vm in cols for x in vm]
-                ent = _JIT_CACHE[key] = (kernels.counted_jit(mega), None)
                 COMPILED_NODE_KEYS.update(pb.kparts)
-            fn, _ = ent
+                return kernels.counted_jit(mega)
+            fn = progcache.get(key, build_big)
             res = fn(pb.inputs)
             valid, items = res[0], list(res[1:])
-            ckey = ("nvalid", nb)
-            cent = _JIT_CACHE.get(ckey)
-            if cent is None:
-                cent = _JIT_CACHE[ckey] = (
-                    kernels.counted_jit(lambda v: jn.sum(v.astype(jn.int64))), None)
-            n_valid = int(kernels.d2h(cent[0](valid)))
+
+            def build_count():
+                return kernels.counted_jit(
+                    lambda v: jn.sum(v.astype(jn.int64)))
+            cfn = progcache.get(("nvalid", nb), build_count)
+            n_valid = int(kernels.d2h(cfn(valid)))
             if n_valid == 0:
                 host = [(np.empty(0, dtype=np.int64),
                          np.empty(0, dtype=bool))] * ncols

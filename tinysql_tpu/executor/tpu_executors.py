@@ -11,6 +11,8 @@ host (np.unique), so TPC-H-style char keys still hit the device path.
 """
 from __future__ import annotations
 
+import time
+
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -20,7 +22,7 @@ from ..expression import vectorized_filter
 from ..expression.aggregation import (AGG_AVG, AGG_COUNT, AGG_FIRST_ROW,
                                       AGG_MAX, AGG_MIN, AGG_SUM)
 from ..mytypes import EvalType, new_real_type
-from ..ops import kernels
+from ..ops import kernels, progcache
 from ..ops.exprjit import compile_filter
 from ..planner.physical import (PhysicalHashAgg, PhysicalHashJoin,
                                 PhysicalProjection, PhysicalSelection,
@@ -670,8 +672,16 @@ class TPUHashAggExec(Executor):
         reduces each block on device, and per-segment partial states
         (sum/count add, min/max fold, first-row min, presence union)
         carry on host between blocks — the aggregate's partial/final mode
-        split applied across TIME instead of across workers."""
+        split applied across TIME instead of across workers.
+
+        PIPELINED: block staging (slice + pad + H2D enqueue) runs on the
+        BlockPipeline thread while the device reduces the previous block
+        and the main thread folds its partials — host work and device
+        work overlap instead of alternating (tidb_pipeline_depth /
+        TINYSQL_PIPELINE_DEPTH=0 restores the serial order; the fold
+        order is block order either way, so results are identical)."""
         from ..ops.exprjit import stable_key
+        from .devpipe import BlockPipeline, pipeline_depth
         jn = kernels.jnp()
         # host filter mask over the full table; reuse the caller's when
         # it already folded one (the dev-mask path leaves it None)
@@ -695,6 +705,12 @@ class TPUHashAggExec(Executor):
             elif a is not None:
                 for c in a.collect_columns():
                     needed.add((c.index, "full"))
+        # eligibility BEFORE the pipeline spins up: a string column in a
+        # compute expression bails the whole path, never a single block
+        for idx, kind in needed:
+            v = chk.columns[idx].values()
+            if kind == "full" and (v.dtype == object or v.dtype.kind == "U"):
+                return None
         gid_full = self._compose_gid(key_layouts, n) if key_layouts \
             else None
         ns = n_segments if key_layouts else 1
@@ -717,7 +733,10 @@ class TPUHashAggExec(Executor):
             acc[i] = (av, np.ones(ns, dtype=bool))
             return acc[i]
 
-        for start in range(0, n, budget):
+        def stage(start):
+            """Host half of one block: slice, pad, ENQUEUE the uploads.
+            Runs on the pipeline thread while the device reduces the
+            previous block (no host syncs here — qlint TS106)."""
             end = min(start + budget, n)
             m_rows = end - start
             dev_cols = [None] * len(chk.columns)
@@ -726,9 +745,7 @@ class TPUHashAggExec(Executor):
                 v = col.values()
                 m_ = col.null_mask()
                 if v.dtype == object or v.dtype.kind == "U":
-                    if kind == "full":
-                        return None  # string values in a compute expr
-                    dv = None
+                    dv = None  # mask-only slot (COUNT over a string col)
                 else:
                     dv = jn.asarray(kernels.pad1(v[start:end], bb))
                 dn = jn.asarray(kernels.pad1(m_[start:end], bb, True))
@@ -738,8 +755,17 @@ class TPUHashAggExec(Executor):
             bmask[:m_rows] = fmask[start:end] if fmask is not None \
                 else True
             mask_spec = ("host", jn.asarray(bmask))
+            gid_b = jn.asarray(kernels.pad1(gid_full[start:end], bb)) \
+                if key_layouts else None
+            return start, m_rows, dev_cols, mask_spec, gid_b
+
+        t_pipe = time.time()
+        dispatch_s = drain_s = 0.0
+        pipe = BlockPipeline(stage, range(0, n, budget),
+                             depth=pipeline_depth(self.ctx.session_vars))
+        for start, m_rows, dev_cols, mask_spec, gid_b in pipe:
+            t0 = time.time()
             if key_layouts:
-                gid_b = jn.asarray(kernels.pad1(gid_full[start:end], bb))
                 present, outs, first = kernels.fused_segment_aggregate(
                     dev_cols, gid_b, ns, specs, progs, m_rows, mask_spec,
                     program_key=program_key)
@@ -752,6 +778,8 @@ class TPUHashAggExec(Executor):
                 present = np.zeros(len(first), dtype=np.int64)
                 outs = [(np.asarray(v_), np.asarray(m_))
                         for v_, m_ in outs]
+            dispatch_s += time.time() - t0
+            t0 = time.time()
             if len(present) == 0:
                 continue
             seen[present] = True
@@ -773,6 +801,12 @@ class TPUHashAggExec(Executor):
                 else:
                     av[ids] = np.maximum(av[ids], vv)
                 am[ids] = False
+            drain_s += time.time() - t0
+        ps = pipe.stats()
+        kernels.pipe_record(blocks=ps["blocks"], stage_s=ps["stage_s"],
+                            dispatch_s=dispatch_s, drain_s=drain_s,
+                            wall_s=time.time() - t_pipe,
+                            depth_hwm=ps["depth_hwm"])
         if self.plan.group_by:
             present_ids = np.nonzero(seen)[0]
         else:
@@ -1107,8 +1141,6 @@ class TPUHashAggExec(Executor):
                 return False
         return True
 
-    _DEVOUT_CACHE: Dict[tuple, object] = {}
-
     def _assemble_device_output(self, plan, slots, key_layouts, ids, live,
                                 out_aggs, np_):
         """Device-resident output chunk: ONE jitted program decodes group
@@ -1141,10 +1173,10 @@ class TPUHashAggExec(Executor):
                                  sl[2] if sl[0] == "avg" else None, real))
             else:
                 slot_sig.append(("gb", idx, None, False))
-        key = (ob, len(key_layouts), tuple(slot_sig),
+        key = ("devout", ob, len(key_layouts), tuple(slot_sig),
                tuple(str(v.dtype) for v, _ in out_aggs))
-        fn = self._DEVOUT_CACHE.get(key)
-        if fn is None:
+
+        def build():
             def kernel(ids_in, live_in, aggs, lay_in):
                 outs = []
                 for kind, i, extra, real in slot_sig:
@@ -1167,7 +1199,8 @@ class TPUHashAggExec(Executor):
                             v = v.astype(jn.float64)
                         outs.append((v, m))
                 return outs
-            fn = self._DEVOUT_CACHE[key] = kernels.counted_jit(kernel)
+            return kernels.counted_jit(kernel)
+        fn = progcache.get(key, build)
         outs = fn(ids, live, list(out_aggs), jn.asarray(lay))
         cols = []
         for (src, idx), (v, m) in zip(plan.output_map, outs):
@@ -1301,6 +1334,9 @@ class TPUHashJoinExec(Executor):
         # route keys to host there; device-resident/memoized otherwise
         host_keys = kernels.host_kernels_ok()
 
+        from .devpipe import BlockPipeline, pipeline_depth
+        depth = pipeline_depth(self.ctx.session_vars)
+
         def keys_of(side, expr, chk, rep):
             if stream and side == probe_side:
                 v, m = expr.vec_eval(chk)  # host: no full-column upload
@@ -1308,8 +1344,34 @@ class TPUHashJoinExec(Executor):
             return self._key_arrays(expr, chk, rep, side,
                                     host_keys=host_keys)
 
-        lk, lnull = keys_of(0, plan.left_keys[0], lchk, lrep)
-        rk, rnull = keys_of(1, plan.right_keys[0], rchk, rrep)
+        key_exprs = (plan.left_keys[0], plan.right_keys[0])
+        side_chks = (lchk, rchk)
+        side_reps = (lrep, rrep)
+        build_side = 1 - probe_side
+        if stream and depth > 0:
+            # build-side ingestion overlaps probe staging (the
+            # reference's build/probe worker split, join.go:149/:244
+            # completed for real): the build keys' replica-memoized
+            # uploads run on the pipeline thread while the probe side's
+            # key column extracts here
+            bpipe = BlockPipeline(
+                lambda side: keys_of(side, key_exprs[side],
+                                     side_chks[side], side_reps[side]),
+                [build_side], depth=1)
+            try:
+                pk_pair = keys_of(probe_side, key_exprs[probe_side],
+                                  side_chks[probe_side],
+                                  side_reps[probe_side])
+                bk_pair = list(bpipe)[0]  # drain: joins the thread
+            finally:
+                bpipe.close()  # probe failure must not leak the stager
+            if probe_side == 0:
+                (lk, lnull), (rk, rnull) = pk_pair, bk_pair
+            else:
+                (lk, lnull), (rk, rnull) = bk_pair, pk_pair
+        else:
+            lk, lnull = keys_of(0, key_exprs[0], lchk, lrep)
+            rk, rnull = keys_of(1, key_exprs[1], rchk, rrep)
         if on_left:
             on_mask = vectorized_filter(on_left, lchk)
             # poison only the NULL mask (values may stay replica-memoized
@@ -1329,17 +1391,46 @@ class TPUHashJoinExec(Executor):
                          bmask, **kw):
             """Probe-block loop: fn per block of `budget` rows with the
             block's validity slice; probe-side indices re-base by the
-            block start.  Stable block shapes = one compiled program."""
-            pis, bis = [], []
-            for s_ in range(0, n_probe, budget):
+            block start.  Stable block shapes = one compiled program.
+
+            PIPELINED: the staging thread slices the next probe block
+            (and pre-uploads its padded key arrays when the device match
+            kernel will run) while the current block's match executes;
+            results concatenate in block order, so depth 0 (synchronous)
+            is byte-identical."""
+            dev_stage = not (kernels.host_kernels_ok()
+                             and isinstance(bkey[0], np.ndarray))
+            jn = kernels.jnp() if dev_stage else None
+
+            def stage(s_):
                 e_ = min(s_ + budget, n_probe)
-                pi_b, bi_b = fn((pk[s_:e_], pn[s_:e_]), e_ - s_, bkey,
-                                n_build,
-                                lvalid=None if pmask is None
-                                else pmask[s_:e_],
+                m = e_ - s_
+                kv, kn = pk[s_:e_], pn[s_:e_]
+                if dev_stage:
+                    blk = kernels.bucket(max(m, 1))
+                    kv = jn.asarray(kernels.pad1(kv, blk))
+                    kn = jn.asarray(kernels.pad1(kn, blk, True))
+                pm = None if pmask is None else pmask[s_:e_]
+                return s_, (kv, kn), m, pm
+
+            pis, bis = [], []
+            t_pipe = time.time()
+            dispatch_s = 0.0
+            pipe = BlockPipeline(stage, range(0, n_probe, budget),
+                                 depth=depth)
+            for s_, kpair, m, pm in pipe:
+                t0 = time.time()
+                pi_b, bi_b = fn(kpair, m, bkey, n_build, lvalid=pm,
                                 rvalid=bmask, **kw)
+                dispatch_s += time.time() - t0
                 pis.append(pi_b + s_)
                 bis.append(bi_b)
+            ps = pipe.stats()
+            kernels.pipe_record(blocks=ps["blocks"],
+                                stage_s=ps["stage_s"],
+                                dispatch_s=dispatch_s,
+                                wall_s=time.time() - t_pipe,
+                                depth_hwm=ps["depth_hwm"])
             if not pis:
                 z = np.empty(0, dtype=np.int64)
                 return z, z
@@ -1584,9 +1675,6 @@ class TPUTopNExec(Executor):
         return cand
 
 
-_PROJ_CACHE: dict = {}
-
-
 class TPUProjectionExec(Executor):
     """Expression trees fused by XLA into elementwise device kernels."""
 
@@ -1598,10 +1686,10 @@ class TPUProjectionExec(Executor):
 
     def _compiled(self):
         if self._fn is None:
-            # module-level params-compiled program (the _FILTER_CACHE
-            # pattern): executors are rebuilt per query, so a per-instance
-            # @jit wrapper would retrace EVERY query — qlint TS104, the
-            # ~40-70ms-per-dispatch bug class PROFILE.md §1 prices
+            # shared params-compiled program (ops/progcache): executors
+            # are rebuilt per query, so a per-instance @jit wrapper would
+            # retrace EVERY query — qlint TS104, the ~40-70ms-per-
+            # dispatch bug class PROFILE.md §1 prices
             from ..ops.exprjit import (ParamTable, compile_expr_params,
                                        stable_shape_key)
             key = ("proj",) + tuple(stable_shape_key(e)
@@ -1609,12 +1697,12 @@ class TPUProjectionExec(Executor):
             pt = ParamTable()
             fns = [compile_expr_params(e, pt) for e in self.plan.exprs]
             self._params = [kernels.jnp().asarray(a) for a in pt.arrays()]
-            fn = _PROJ_CACHE.get(key)
-            if fn is None:
+
+            def build():
                 def kernel(cols, params, fns=fns):
                     return [f(cols, params) for f in fns]
-                fn = _PROJ_CACHE[key] = kernels.counted_jit(kernel)
-            self._fn = fn
+                return kernels.counted_jit(kernel)
+            self._fn = progcache.get(key, build)
         return self._fn
 
     def next(self) -> Optional[Chunk]:
@@ -1640,9 +1728,6 @@ class TPUProjectionExec(Executor):
         return Chunk.from_columns(out_cols)
 
 
-_FILTER_CACHE: dict = {}
-
-
 class TPUSelectionExec(Executor):
     def __init__(self, plan: PhysicalSelection, child: Executor):
         super().__init__(plan.schema, [child])
@@ -1659,12 +1744,13 @@ class TPUSelectionExec(Executor):
             # fresh wrapper per query (executors are rebuilt per query).
             from ..ops.exprjit import (ParamTable, compile_expr_params,
                                        stable_shape_key)
-            key = tuple(stable_shape_key(c) for c in self.plan.conditions)
+            key = ("filter",) + tuple(stable_shape_key(c)
+                                      for c in self.plan.conditions)
             pt = ParamTable()
             fns = [compile_expr_params(c, pt) for c in self.plan.conditions]
             self._params = [kernels.jnp().asarray(a) for a in pt.arrays()]
-            fn = _FILTER_CACHE.get(key)
-            if fn is None:
+
+            def build():
                 jn = kernels.jnp()
 
                 def kernel(cols, params, fns=fns):
@@ -1674,8 +1760,8 @@ class TPUSelectionExec(Executor):
                         v, null = f(cols, params)
                         mask = mask & (v != 0) & ~null
                     return mask
-                fn = _FILTER_CACHE[key] = kernels.counted_jit(kernel)
-            self._fn = fn
+                return kernels.counted_jit(kernel)
+            self._fn = progcache.get(key, build)
         return self._fn
 
     def next(self) -> Optional[Chunk]:

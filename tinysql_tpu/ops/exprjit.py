@@ -401,10 +401,22 @@ def stable_key(e: Expression) -> str:
     return repr(e)
 
 
+def cached_compile_expr(e: Expression) -> Callable[[Sequence[VV]], VV]:
+    """compile_expr memoized through the shared program registry
+    (ops/progcache): the closure build is pure over the expression SHAPE
+    — stable_key pins schema offsets, types, the unsigned flag, and
+    constant values — so identical trees across queries share ONE
+    closure, and the kernels that embed it key their jit programs off
+    the same identity."""
+    from . import progcache
+    key = ("exprfn", stable_key(e), str(e.eval_type))
+    return progcache.get(key, lambda: compile_expr(e))
+
+
 def compile_filter(conds: List[Expression]) -> Callable[[Sequence[VV]], object]:
     """CNF list -> device boolean keep-mask (NULL = drop), mirroring
     expression.vectorized_filter (reference VecEvalBool)."""
-    fns = [compile_expr(c) for c in conds]
+    fns = [cached_compile_expr(c) for c in conds]
 
     def run(cols):
         j = jnp()

@@ -21,6 +21,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import progcache
+
 _jax = None
 
 
@@ -154,12 +156,46 @@ def ensure_live_backend(jax_mod=None, timeout: float = None,
         _touch(sentinel)
 
 
+# runtime override for the persistent-compile-cache directory (sysvar
+# tidb_compile_cache_dir); a dict cell so set_compile_cache_dir never
+# races module reloads
+_CACHE_DIR_STATE = {"override": None}
+
+
+def set_compile_cache_dir(path: str) -> None:
+    """Point jax's persistent compilation cache at ``path`` (sysvar
+    ``tidb_compile_cache_dir`` / config ``compile_cache_dir``): bucketed
+    kernels then survive process restarts — the second process's
+    "first run" skips the 20-40s XLA compiles entirely.  Empty path
+    restores the resolution chain below.  Applies immediately when the
+    backend is already initialized."""
+    _CACHE_DIR_STATE["override"] = str(path) if path else None
+    if _jax is not None:
+        try:
+            _jax.config.update("jax_compilation_cache_dir", _cache_dir())
+        except Exception:
+            pass
+
+
 def _cache_dir() -> str:
+    """Persistent compile-cache directory.  Resolution: the sysvar
+    override (set_compile_cache_dir) > TINYSQL_JAX_CACHE env > the
+    config file's compile_cache_dir > <repo>/.jax_cache."""
     import os
-    return os.environ.get(
-        "TINYSQL_JAX_CACHE",
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+    if _CACHE_DIR_STATE["override"]:
+        return _CACHE_DIR_STATE["override"]
+    env = os.environ.get("TINYSQL_JAX_CACHE")
+    if env:
+        return env
+    try:
+        from ..config import get_global_config
+        cfg = get_global_config().compile_cache_dir
+        if cfg:
+            return cfg
+    except Exception:
+        pass
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
 
 
 def jax():
@@ -188,8 +224,31 @@ def jnp():
 # device-economics counters (bench diagnosability, VERDICT r2 weak-3):
 # every compiled-program dispatch and packed D2H transfer increments
 # these, so BENCH json can split engine time from link time per query.
+# The pipe_* family is fed by the async block pipeline (devpipe
+# BlockPipeline consumers) via pipe_record: per-stage walls for the
+# host-staging / device-compute overlap accounting, block count, and the
+# staging-queue depth high-water mark (reported as an absolute value by
+# stats_delta — a high-water is not a per-interval delta).
 STATS = {"dispatches": 0, "d2h_transfers": 0, "d2h_bytes": 0,
-         "flops": 0.0, "bytes_accessed": 0.0}
+         "flops": 0.0, "bytes_accessed": 0.0,
+         "pipe_blocks": 0, "pipe_stage_s": 0.0, "pipe_dispatch_s": 0.0,
+         "pipe_drain_s": 0.0, "pipe_wall_s": 0.0, "pipe_depth_hwm": 0}
+
+#: STATS keys that are high-water marks, not accumulators
+_HWM_KEYS = ("pipe_depth_hwm",)
+
+
+def pipe_record(blocks: int = 0, stage_s: float = 0.0,
+                dispatch_s: float = 0.0, drain_s: float = 0.0,
+                wall_s: float = 0.0, depth_hwm: int = 0) -> None:
+    """Accrue one pipelined run's stage/compute/drain walls into STATS
+    (called once per BlockPipeline consumer loop, not per block)."""
+    STATS["pipe_blocks"] += blocks
+    STATS["pipe_stage_s"] += stage_s
+    STATS["pipe_dispatch_s"] += dispatch_s
+    STATS["pipe_drain_s"] += drain_s
+    STATS["pipe_wall_s"] += wall_s
+    STATS["pipe_depth_hwm"] = max(STATS["pipe_depth_hwm"], depth_hwm)
 
 # when on, every counted_jit dispatch also accrues the program's XLA cost
 # analysis (flops / bytes accessed) into STATS — the bench's MFU and
@@ -204,11 +263,23 @@ def enable_cost_tracking(flag: bool = True) -> None:
 
 
 def stats_snapshot() -> dict:
-    return dict(STATS)
+    from . import progcache
+    out = dict(STATS)
+    pc = progcache.stats_snapshot()
+    out["progcache_hits"] = pc["hits"]
+    out["progcache_misses"] = pc["misses"]
+    # high-water marks are PER INTERVAL: a snapshot opens a new interval
+    # (sequential snapshot/delta pairs, the bench's usage), so a deep
+    # queue in query N never bleeds into query N+1's detail
+    for k in _HWM_KEYS:
+        STATS[k] = 0
+    return out
 
 
 def stats_delta(since: dict) -> dict:
-    return {k: STATS[k] - since.get(k, 0) for k in STATS}
+    now = stats_snapshot()
+    return {k: (v if k in _HWM_KEYS else v - since.get(k, 0))
+            for k, v in now.items()}
 
 
 def _arg_spec(tree):
@@ -273,6 +344,9 @@ def counted_jit(fn, **kw):
                 _PENDING_COSTS.append((costs, spec, w,
                                        _abstractify((a, k))))
         return w(*a, **k)
+    # AOT hook for the bucket prewarmer (tools/warm.py):
+    # fn.lower(*abstract).compile() compiles without dispatching
+    call.lower = w.lower
     return call
 
 
@@ -282,6 +356,18 @@ def d2h(dev_arr) -> np.ndarray:
     STATS["d2h_transfers"] += 1
     STATS["d2h_bytes"] += out.nbytes
     return out
+
+
+def d2h_many(dev_arrs) -> List[np.ndarray]:
+    """ONE counted device->host pull for several arrays:
+    jax.device_get gathers the copies behind a single sync point, so a
+    kernel result split across the int64 and float64 streams pays the
+    link's per-transfer latency once, not once per stream (the Q6
+    dispatches=1 / d2h_transfers=2 accounting bug, BENCH_r05)."""
+    outs = [np.asarray(a) for a in jax().device_get(list(dev_arrs))]
+    STATS["d2h_transfers"] += 1
+    STATS["d2h_bytes"] += sum(o.nbytes for o in outs)
+    return outs
 
 
 I64_MIN = -(1 << 63)
@@ -325,12 +411,16 @@ def pack_arrays(schema: list, arrays) -> tuple:
 
 
 def unpack_flat(pair, schema: list) -> List[np.ndarray]:
-    """At most two D2H transfers, then split per the recorded schema."""
+    """ONE D2H pull (both streams batch through d2h_many when a result
+    spans int64 and float64), then split per the recorded schema."""
     dev_i, dev_f = pair
-    flat_i = d2h(dev_i) if any(s == "i" for _, _, s in schema) \
-        else None
-    flat_f = d2h(dev_f) if any(s == "f" for _, _, s in schema) \
-        else None
+    need_i = any(s == "i" for _, _, s in schema)
+    need_f = any(s == "f" for _, _, s in schema)
+    if need_i and need_f:
+        flat_i, flat_f = d2h_many([dev_i, dev_f])
+    else:
+        flat_i = d2h(dev_i) if need_i else None
+        flat_f = d2h(dev_f) if need_f else None
     out = []
     pi = pf = 0
     for dt, ln, stream in schema:
@@ -374,22 +464,19 @@ def pad1(a: np.ndarray, n: int, fill=0) -> np.ndarray:
 # (the link's per-transfer latency dwarfs the extra bytes)
 SMALL_PACK = 1 << 16
 
-_PACK_CACHE: Dict[tuple, tuple] = {}
-
-
 def _slice_pack(items, ob: int):
     """Pack device arrays sliced to [:ob] — one download.  Returns host
     arrays (still ob-long; callers slice to the live count)."""
     key = ("slice_pack", ob, tuple(str(a.dtype) for a in items),
            tuple(int(a.shape[0]) for a in items))
-    ent = _PACK_CACHE.get(key)
-    if ent is None:
+
+    def build():
         schema: list = []
 
         def kernel(arrs):
             return pack_arrays(schema, [a[:ob] for a in arrs])
-        ent = _PACK_CACHE[key] = (counted_jit(kernel), schema)
-    fn, schema = ent
+        return counted_jit(kernel), schema
+    fn, schema = progcache.get(key, build)
     return unpack_flat(fn(items), schema)
 
 
@@ -400,16 +487,16 @@ def _present_pack(presence, items, ob: int):
     jn_ = jnp()
     ns = int(presence.shape[0])
     key = ("present_pack", ob, ns, tuple(str(a.dtype) for a in items))
-    ent = _PACK_CACHE.get(key)
-    if ent is None:
+
+    def build():
         schema: list = []
 
         def kernel(pres, arrs):
             idx = jn_.nonzero(pres > 0, size=ob, fill_value=ns)[0]
             safe = jn_.minimum(idx, ns - 1)
             return pack_arrays(schema, [idx] + [a[safe] for a in arrs])
-        ent = _PACK_CACHE[key] = (counted_jit(kernel), schema)
-    fn, schema = ent
+        return counted_jit(kernel), schema
+    fn, schema = progcache.get(key, build)
     vals = unpack_flat(fn(presence, items), schema)
     return vals[0], vals[1:]
 
@@ -419,7 +506,6 @@ def _present_pack(presence, items, ob: int):
 # =========================================================================
 # agg spec tuple: (func, has_arg) where func in
 #   count_star | count | sum | sum_int | min | max | first
-_AGG_CACHE: Dict[tuple, Callable] = {}
 
 
 def _sort_perm(keys, valid):
@@ -527,12 +613,10 @@ def group_aggregate(key_cols: List[Tuple[np.ndarray, np.ndarray]],
     kn = [jn.asarray(pad1(m, nb, True)) for _, m in key_cols]
     av = [jn.asarray(pad1(v, nb)) for v, _ in arg_cols]
     an = [jn.asarray(pad1(m, nb, True)) for _, m in arg_cols]
-    key = (len(key_cols), tuple(agg_specs), nb,
+    key = ("group_agg", len(key_cols), tuple(agg_specs), nb,
            tuple(str(v.dtype) for v in kv), tuple(str(v.dtype) for v in av))
-    fn = _AGG_CACHE.get(key)
-    if fn is None:
-        fn = _AGG_CACHE[key] = _group_agg_kernel(len(key_cols),
-                                                 tuple(agg_specs))
+    fn = progcache.get(key, lambda: _group_agg_kernel(len(key_cols),
+                                                      tuple(agg_specs)))
     n_groups, first_orig, gkeys, outs = fn(kv, kn, jn.asarray(valid), av, an)
     items = [first_orig]
     for v, m in gkeys:
@@ -556,9 +640,6 @@ def group_aggregate(key_cols: List[Tuple[np.ndarray, np.ndarray]],
     out_aggs = [(rest[2 * nk + 2 * i][:ng], rest[2 * nk + 2 * i + 1][:ng])
                 for i in range(len(outs))]
     return out_keys, out_aggs, first
-
-
-_SEGMENT_AGG_CACHE: Dict[tuple, Callable] = {}
 
 
 def _segment_agg_kernel(specs: tuple, n_segments: int):
@@ -636,11 +717,10 @@ def segment_group_aggregate(gids: np.ndarray, n_segments: int,
     # cardinality in the bucket (gids above the true count never occur,
     # their segments simply stay empty and are compressed away)
     ns = bucket(max(n_segments, 1))
-    key = (tuple(agg_specs), ns, nb, tuple(str(v.dtype) for v in av))
-    fn = _SEGMENT_AGG_CACHE.get(key)
-    if fn is None:
-        fn = _SEGMENT_AGG_CACHE[key] = _segment_agg_kernel(
-            tuple(agg_specs), ns)
+    key = ("segment_agg", tuple(agg_specs), ns, nb,
+           tuple(str(v.dtype) for v in av))
+    fn = progcache.get(key, lambda: _segment_agg_kernel(tuple(agg_specs),
+                                                        ns))
     presence, first_orig, outs, n_present = fn(g, jn.asarray(valid), av, an)
     return _present_extract(presence, first_orig, outs, n_present, ns)
 
@@ -804,8 +884,6 @@ def _fused_agg_outs(j, jn, agg_specs, arg_fns, cols, gid, valid,
 #     traced into the kernel; `key` joins the program cache key; params
 #     are the per-query constant arrays.
 
-_FUSED_CACHE: Dict[tuple, Callable] = {}
-
 _EMPTY_I64 = None
 _EMPTY_F64 = None
 _EMPTY_MASK = None
@@ -841,11 +919,11 @@ def _fused_segment_raw(dev_cols, gid_dev, n_segments: int,
     ns = bucket(max(n_segments, 1))
     mask_fn, mask_key, mask_arr, params = _mask_parts(mask)
     key = ("seg", tuple(agg_specs), program_key, mask_key, ns, nb)
-    fn = _FUSED_CACHE.get(key)
-    if fn is None:
-        from .exprjit import compile_expr
+
+    def build():
+        from .exprjit import cached_compile_expr
         arg_fns = [e if callable(e) else
-                   (compile_expr(e) if e is not None else None)
+                   (cached_compile_expr(e) if e is not None else None)
                    for e in arg_exprs]
 
         def kernel(cols, gid, mask_in, pr):
@@ -862,7 +940,8 @@ def _fused_segment_raw(dev_cols, gid_dev, n_segments: int,
                                    seg=seg)
             n_present = jn.sum((presence > 0).astype(jn.int64))
             return presence, first_orig, outs, n_present
-        fn = _FUSED_CACHE[key] = counted_jit(kernel)
+        return counted_jit(kernel)
+    fn = progcache.get(key, build)
     presence, first_orig, outs, n_present = fn(dev_cols, gid_dev,
                                                mask_arr, params)
     return presence, first_orig, outs, n_present, ns
@@ -901,15 +980,16 @@ def fused_segment_aggregate_keep(dev_cols, gid_dev, n_segments: int,
     ob = min(bucket(max(np_, 1)), ns)
     key = ("present_keep", ob, ns, len(outs),
            tuple(str(v.dtype) for v, _ in outs))
-    fn = _PACK_CACHE.get(key)
-    if fn is None:
+
+    def build():
         def kernel(pres, items):
             idx = jn.nonzero(pres > 0, size=ob, fill_value=ns)[0]
             live = idx < ns
             safe = jn.minimum(idx, ns - 1)
             gathered = [(v[safe], m[safe] | ~live) for v, m in items]
             return idx, live, gathered
-        fn = _PACK_CACHE[key] = counted_jit(kernel)
+        return counted_jit(kernel)
+    fn = progcache.get(key, build)
     ids, live, out_aggs = fn(presence, list(outs))
     return ids, live, out_aggs, np_, ob
 
@@ -922,11 +1002,11 @@ def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
     jn = jnp()
     mask_fn, mask_key, mask_arr, params = _mask_parts(mask)
     key = ("scalar", tuple(agg_specs), program_key, mask_key, nb)
-    ent = _FUSED_CACHE.get(key)
-    if ent is None:
-        from .exprjit import compile_expr
+
+    def build():
+        from .exprjit import cached_compile_expr
         arg_fns = [e if callable(e) else
-                   (compile_expr(e) if e is not None else None)
+                   (cached_compile_expr(e) if e is not None else None)
                    for e in arg_exprs]
         kernel_schema: list = []
 
@@ -971,8 +1051,8 @@ def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
             for v, m in outs:
                 items += [v, m]
             return pack_arrays(kernel_schema, items)
-        ent = _FUSED_CACHE[key] = (counted_jit(kernel), kernel_schema)
-    fn, schema = ent
+        return counted_jit(kernel), kernel_schema
+    fn, schema = progcache.get(key, build)
     return _unpack_scalar_agg(unpack_flat(fn(dev_cols, mask_arr, params),
                                           schema))
 
@@ -1008,11 +1088,11 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
     mask_fn, mask_key, mask_arr, params = _mask_parts(mask)
     key = ("seg_sharded", tuple(agg_specs), program_key, mask_key, ns, nb,
            n_dev, dev_shape)
-    fn = _FUSED_CACHE.get(key)
-    if fn is None:
-        from .exprjit import compile_expr
+
+    def build():
+        from .exprjit import cached_compile_expr
         arg_fns = [e if callable(e) else
-                   (compile_expr(e) if e is not None else None)
+                   (cached_compile_expr(e) if e is not None else None)
                    for e in arg_exprs]
 
         def kernel(cols, gid, mask_in, pr):
@@ -1056,8 +1136,8 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
             for v, m in outs:
                 items += [v, m]
             return pack_arrays(kernel_schema, items)
-        fn = _FUSED_CACHE[key] = (counted_jit(packed), kernel_schema)
-    pfn, schema = fn
+        return counted_jit(packed), kernel_schema
+    pfn, schema = progcache.get(key, build)
     vals = unpack_flat(pfn(tuple(dev_cols), gid_dev, mask_arr, params),
                        schema)
     presence, first_orig = vals[0], vals[1]
@@ -1067,9 +1147,6 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
     out_aggs = [(rest[2 * i][present], rest[2 * i + 1][present])
                 for i in range(len(rest) // 2)]
     return present, out_aggs, first_orig[present]
-
-
-_SCALAR_AGG_CACHE: Dict[tuple, Callable] = {}
 
 
 def _scalar_agg_kernel(specs: tuple):
@@ -1138,11 +1215,10 @@ def scalar_aggregate(agg_specs, arg_cols, n_rows: int,
         valid[:n_rows] = True
     av = [jn.asarray(pad1(v, nb)) for v, _ in arg_cols]
     an = [jn.asarray(pad1(m, nb, True)) for _, m in arg_cols]
-    key = (tuple(agg_specs), nb, tuple(str(v.dtype) for v in av))
-    ent = _SCALAR_AGG_CACHE.get(key)
-    if ent is None:
-        ent = _SCALAR_AGG_CACHE[key] = _scalar_agg_kernel(tuple(agg_specs))
-    fn, schema = ent
+    key = ("scalar_agg", tuple(agg_specs), nb,
+           tuple(str(v.dtype) for v in av))
+    fn, schema = progcache.get(key,
+                               lambda: _scalar_agg_kernel(tuple(agg_specs)))
     return _unpack_scalar_agg(unpack_flat(fn(jn.asarray(valid), av, an),
                                           schema))
 
@@ -1150,8 +1226,6 @@ def scalar_aggregate(agg_specs, arg_cols, n_rows: int,
 # =========================================================================
 # equi-join (single int64/float64 key): sort + searchsorted + expand
 # =========================================================================
-_JOIN_COUNT_CACHE: Dict[tuple, Callable] = {}
-_JOIN_EXPAND_CACHE: Dict[tuple, Callable] = {}
 
 
 def _join_count_kernel():
@@ -1299,10 +1373,8 @@ def join_match(lkey: Tuple[np.ndarray, np.ndarray], n_left: int,
     ln = dev(lkey[1], nlb, True)
     rk = dev(rkey[0], nrb, 0)
     rn = dev(rkey[1], nrb, True)
-    ck = ("count", nlb, nrb, str(lk.dtype), str(rk.dtype))
-    cfn = _JOIN_COUNT_CACHE.get(ck)
-    if cfn is None:
-        cfn = _JOIN_COUNT_CACHE[ck] = _join_count_kernel()
+    ck = ("join_count", nlb, nrb, str(lk.dtype), str(rk.dtype))
+    cfn = progcache.get(ck, _join_count_kernel)
     lv_dev = jn.asarray(lv)
     counts, lo, rperm, totals = cfn(lk, ln, lv_dev, rk, rn, jn.asarray(rv))
     totals = d2h(totals)  # ONE scalar-pair sync
@@ -1310,16 +1382,11 @@ def join_match(lkey: Tuple[np.ndarray, np.ndarray], n_left: int,
     if n_out == 0:
         return (np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64))
     ob2 = bucket(n_out)
-    ek = ("expand", outer, nlb, nrb, ob2)
-    ent = _JOIN_EXPAND_CACHE.get(ek)
-    if ent is None:
-        ent = _JOIN_EXPAND_CACHE[ek] = _join_expand_kernel(outer, ob2)
-    efn, schema = ent
+    ek = ("join_expand", outer, nlb, nrb, ob2)
+    efn, schema = progcache.get(ek,
+                                lambda: _join_expand_kernel(outer, ob2))
     li, ri = unpack_flat(efn(counts, lo, rperm, lv_dev), schema)
     return li[:n_out], ri[:n_out]
-
-
-_UNIQUE_JOIN_CACHE: Dict[tuple, Callable] = {}
 
 
 def _unique_join_kernel(build_sorted: bool = False):
@@ -1483,10 +1550,9 @@ def unique_join_match(lkey, n_left: int, rkey, n_right: int,
     ln = dev(lkey[1], nlb, True)
     rk = dev(rkey[0], nrb, 0)
     rn = dev(rkey[1], nrb, True)
-    ck = ("unique", nlb, nrb, str(lk.dtype), str(rk.dtype), build_sorted)
-    fn = _UNIQUE_JOIN_CACHE.get(ck)
-    if fn is None:
-        fn = _UNIQUE_JOIN_CACHE[ck] = _unique_join_kernel(build_sorted)
+    ck = ("unique_join", nlb, nrb, str(lk.dtype), str(rk.dtype),
+          build_sorted)
+    fn = progcache.get(ck, lambda: _unique_join_kernel(build_sorted))
     lv_dev = jn.asarray(lv)
     match, cand, n_match = fn(lk, ln, lv_dev, rk, rn, jn.asarray(rv))
     if outer:
@@ -1500,10 +1566,8 @@ def unique_join_match(lkey, n_left: int, rkey, n_right: int,
         return (np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64))
     ob = min(bucket(n_out), nlb)
     pk = ("unique_pick", ob, nlb, outer)
-    ent = _UNIQUE_JOIN_CACHE.get(pk)
-    if ent is None:
-        ent = _UNIQUE_JOIN_CACHE[pk] = _unique_pick_kernel(ob, nlb, outer)
-    pfn, schema = ent
+    pfn, schema = progcache.get(pk,
+                                lambda: _unique_pick_kernel(ob, nlb, outer))
     li, ri = unpack_flat(pfn(match, cand, lv_dev), schema)
     return li[:n_out], ri[:n_out]
 
@@ -1511,7 +1575,6 @@ def unique_join_match(lkey, n_left: int, rkey, n_right: int,
 # =========================================================================
 # sort / top-k
 # =========================================================================
-_SORT_CACHE: Dict[tuple, Callable] = {}
 
 
 def _sort_kernel(descs: tuple):
@@ -1547,15 +1610,10 @@ def sort_permutation(key_cols: List[Tuple[np.ndarray, np.ndarray]],
     valid[:n_rows] = True
     kv = [jn.asarray(pad1(v, nb)) for v, _ in key_cols]
     kn = [jn.asarray(pad1(m, nb, True)) for _, m in key_cols]
-    key = (tuple(descs), nb, tuple(str(v.dtype) for v in kv))
-    fn = _SORT_CACHE.get(key)
-    if fn is None:
-        fn = _SORT_CACHE[key] = _sort_kernel(tuple(descs))
+    key = ("sort", tuple(descs), nb, tuple(str(v.dtype) for v in kv))
+    fn = progcache.get(key, lambda: _sort_kernel(tuple(descs)))
     perm = d2h(fn(kv, kn, jn.asarray(valid)))
     return perm[:n_rows]
-
-
-_TOPK_CACHE: Dict[tuple, Callable] = {}
 
 
 def _topk_kernel(kb: int):
@@ -1598,10 +1656,8 @@ def _topk_single(key, desc: bool, n_rows: int, k: int):
     kb = bucket(max(k, 1))
     if kb > nb:
         return None
-    ck = (nb, kb, str(score.dtype))
-    fn = _TOPK_CACHE.get(ck)
-    if fn is None:
-        fn = _TOPK_CACHE[ck] = _topk_kernel(kb)
+    ck = ("topk", nb, kb, str(score.dtype))
+    fn = progcache.get(ck, lambda: _topk_kernel(kb))
     ids = d2h(fn(jn.asarray(pad1(score, nb, pad_val))))[:k]
     return ids[ids < n_rows]  # k may exceed the row count
 
@@ -1700,3 +1756,70 @@ def top_k(key_cols: List[Tuple[np.ndarray, np.ndarray]], descs: List[bool],
             return ids
     perm = sort_permutation(key_cols, descs, n_rows)
     return perm[:k]
+
+
+# =========================================================================
+# bucket prewarming (tools/warm.py)
+# =========================================================================
+
+def prewarm_bucket(nb: int, k_buckets=(16, 128)) -> int:
+    """AOT-compile (``jit(...).lower().compile()``) the shape-GENERIC
+    kernels for one power-of-two bucket, so the first real query over a
+    table of that size runs warm.  The structural fused programs
+    (aggregate specs, expression lowerings, device masks) are warmed by
+    EXECUTING the plan once (tools/warm.py does); this covers the purely
+    bucket-keyed kernels a grown table hits next — single-key sort
+    permutations and the lax.top_k selection — so a cardinality drift
+    into the neighboring bucket never pays a cold XLA compile.  Every
+    AOT compile lands in the persistent compilation cache
+    (set_compile_cache_dir) — the persistence threshold drops to 0 for
+    the duration, so sub-second XLA:CPU compiles persist too, not only
+    the 20-40s TPU ones.  Returns the number of programs compiled;
+    failures are skipped (an unsupported shape must never break
+    warming)."""
+    j = jax()
+    jn = jnp()
+    compiled = 0
+    try:
+        prev_thresh = j.config.jax_persistent_cache_min_compile_time_secs
+        j.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        prev_thresh = None
+
+    def sds(dt):
+        return j.ShapeDtypeStruct((nb,), dt)
+
+    try:
+        for dts in ("int64", "float64"):
+            dt = jn.int64 if dts == "int64" else jn.float64
+            for desc in (False, True):
+                key = ("sort", (desc,), nb, (dts,))
+                fn = progcache.get(key,
+                                   lambda desc=desc: _sort_kernel((desc,)))
+                try:
+                    fn.lower([sds(dt)], [sds(jn.bool_)],
+                             sds(jn.bool_)).compile()
+                    compiled += 1
+                except Exception:
+                    pass
+            if j.default_backend() == "cpu":
+                continue  # _topk_single routes to np.partition on XLA:CPU
+            for kb in k_buckets:
+                if kb > nb:
+                    continue
+                key = ("topk", nb, kb, dts)
+                fn = progcache.get(key, lambda kb=kb: _topk_kernel(kb))
+                try:
+                    fn.lower(sds(dt)).compile()
+                    compiled += 1
+                except Exception:
+                    pass
+    finally:
+        if prev_thresh is not None:
+            try:
+                j.config.update(
+                    "jax_persistent_cache_min_compile_time_secs",
+                    prev_thresh)
+            except Exception:
+                pass
+    return compiled

@@ -1,0 +1,82 @@
+"""Shared compiled-program registry — the ``_PROJ_CACHE`` pattern,
+hoisted into ONE keyed table.
+
+Every device-program cache in the engine used to be an ad-hoc module
+dict (``_PROJ_CACHE`` / ``_FILTER_CACHE`` in tpu_executors.py,
+``_JIT_CACHE`` in devpipe.py, half a dozen ``*_CACHE`` tables in
+kernels.py).  They all implemented the same two-line idiom and none of
+them could answer the bench's question "did this query compile anything
+or did it run warm?".  This registry replaces them:
+
+- keys are NAMESPACED tuples of hashable scalars (first element a short
+  domain string: ``"proj"``, ``"sort"``, ``"seg"``, ``"pipe"``, ...) so
+  consumers can never collide (qlint TS105 applies to the key shapes);
+- values are whatever the builder returns — usually a ``counted_jit``
+  wrapper or a ``(fn, schema)`` pair for packed kernels;
+- every lookup counts a hit or a miss; the bench exports the per-query
+  delta (``progcache_hits`` / ``progcache_misses`` in kernels.STATS) as
+  the in-process half of the compile-cache story (the on-disk half is
+  jax's persistent compilation cache, kernels.set_compile_cache_dir);
+- the prewarmer (tools/warm.py) seeds entries AOT through the same
+  ``get`` path, so a prewarmed program is a plain hit at query time.
+
+Thread-safe: lookups and publishes take the registry lock; builders run
+OUTSIDE it (they may recurse into the registry while tracing).  A lost
+build race is benign — ``setdefault`` keeps the first-published entry,
+and both candidates dispatch the same XLA program.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+_mu = threading.Lock()
+_REG: Dict[tuple, object] = {}
+_MISS = object()
+
+#: registry hit/miss counters, exported through kernels.stats_snapshot as
+#: progcache_hits / progcache_misses
+STATS = {"hits": 0, "misses": 0}
+
+
+def get(key: tuple, build: Callable[[], object]):
+    """The one lookup path: return the entry for ``key``, building (and
+    publishing) it on first sight.  ``build`` runs outside the lock."""
+    with _mu:
+        ent = _REG.get(key, _MISS)
+        if ent is not _MISS:
+            STATS["hits"] += 1
+            return ent
+        STATS["misses"] += 1
+    ent = build()
+    with _mu:
+        return _REG.setdefault(key, ent)
+
+
+def peek(key: tuple):
+    """Entry or None, without counting or building (introspection)."""
+    with _mu:
+        return _REG.get(key)
+
+
+def keys(domain: Optional[str] = None) -> List[tuple]:
+    """Registered keys, optionally filtered by their namespace tag."""
+    with _mu:
+        return [k for k in _REG
+                if domain is None or (len(k) > 0 and k[0] == domain)]
+
+
+def size() -> int:
+    with _mu:
+        return len(_REG)
+
+
+def clear() -> None:
+    """Drop every entry (tests; a backend reset invalidates programs)."""
+    with _mu:
+        _REG.clear()
+
+
+def stats_snapshot() -> dict:
+    with _mu:
+        return dict(STATS)

@@ -1,0 +1,51 @@
+"""Bucket-estimate hook for AOT prewarming (tools/warm.py).
+
+Every device kernel pads its inputs to a power-of-two bucket
+(ops/kernels.bucket), so the set of buckets a plan will touch is
+derivable BEFORE execution from the planner's cardinality estimates:
+each physical node's ``stats_row_count`` (ANALYZE stats through
+derive_stats — the reference's task.go GetCost inputs) maps to the
+bucket its kernels will compile for, plus the next bucket up as
+headroom for stats drift (inserts growing a table past the boundary
+must not pay a cold compile on the first query that sees them).
+"""
+from __future__ import annotations
+
+from typing import Optional, Set
+
+
+def bucket_estimates(plan, session_vars=None) -> Set[int]:
+    """Power-of-two buckets a placed physical plan is expected to hit,
+    from per-node cardinality estimates (plus one growth bucket each).
+    When ``session_vars`` carries a block budget (tidb_device_block_rows)
+    the block bucket joins the set — block-wise streaming pads every
+    block to it."""
+    from ..ops.kernels import bucket
+    out: Set[int] = set()
+
+    def walk(p) -> None:
+        est = int(max(getattr(p, "stats_row_count", 0.0) or 0.0, 0))
+        if est > 0:
+            nb = bucket(est)
+            out.add(nb)
+            out.add(nb * 2)  # stats-drift headroom
+        scan = getattr(p, "scan", None)
+        if scan is not None:  # TableReader wraps its scan out-of-tree
+            walk(scan)
+        for c in getattr(p, "children", []):
+            walk(c)
+
+    walk(plan)
+    budget = _block_budget(session_vars)
+    if budget > 0:
+        out.add(bucket(budget))
+    return out
+
+
+def _block_budget(session_vars) -> int:
+    if not session_vars:
+        return 0
+    try:
+        return int(session_vars.get("tidb_device_block_rows", 0) or 0)
+    except Exception:
+        return 0

@@ -59,6 +59,16 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # stay resident in device memory (DeviceColumn chunks); 0 forces the
     # host-extraction path
     "tidb_device_passthrough": 1,
+    # async block pipeline: staged blocks in flight ahead of the device
+    # (executor/devpipe.py BlockPipeline — block-wise aggregation and
+    # join probe streaming overlap host staging with device compute).
+    # 0 = synchronous staging (byte-identical results, no thread);
+    # the TINYSQL_PIPELINE_DEPTH env var overrides for tests/CI
+    "tidb_pipeline_depth": 2,
+    # persistent XLA compile-cache directory so bucketed kernels survive
+    # process restarts ("" = engine default <repo>/.jax_cache; see
+    # ops/kernels.py set_compile_cache_dir for the resolution chain)
+    "tidb_compile_cache_dir": "",
     # opt-in runtime arm of the qlint plan-device checker: verify every
     # placed plan's device invariants before execution (analysis/
     # plan_device.py) and fail the statement on violation
@@ -505,10 +515,17 @@ class Session:
             v = self.eval_const_expr(expr)
             if scope == "user":
                 self.uservars[name] = v
-            elif scope == "global":
+                continue
+            if scope == "global":
                 self._globals()[name] = v
             else:
                 self.sysvars[name] = v
+            if name == "tidb_compile_cache_dir":
+                # apply to the live jax config immediately: compiled
+                # bucket programs from this point on persist under the
+                # new directory (ops/kernels.py resolution chain)
+                from ..ops import kernels
+                kernels.set_compile_cache_dir(str(v) if v else "")
         return None
 
     # ---- SHOW (reference: executor/show.go) ------------------------------

@@ -36,6 +36,7 @@ LOCK_SCOPE = [
     "tinysql_tpu/domain/domain.py",
     "tinysql_tpu/server/server.py",
     "tinysql_tpu/kv/rpc.py",
+    "tinysql_tpu/executor/devpipe.py",  # BlockPipeline staging queue
 ]
 
 

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Bucket prewarmer — AOT-compile the device programs a plan will hit so
+the FIRST real query runs warm (the BENCH_r05 problem: Q1 15.07s cold vs
+0.74s warm was almost entirely first-touch XLA compilation).
+
+Two warming layers per query:
+
+1. **Plan-derived bucket AOT** — plan the statement (no execution),
+   derive the power-of-two shape buckets from the planner's cardinality
+   estimates (planner/buckets.bucket_estimates), and
+   ``jax.jit(...).lower().compile()`` the shape-generic kernels for each
+   bucket (kernels.prewarm_bucket).  This also covers GROWTH buckets the
+   first execution would not touch yet.
+2. **One warming execution** — runs the query once, tracing the fused
+   structural programs (aggregate specs, expression lowerings, device
+   masks) into the in-process registry (ops/progcache) AND the
+   persistent XLA compilation cache on disk, so later PROCESSES skip the
+   compiles too (tidb_compile_cache_dir / TINYSQL_JAX_CACHE).
+
+Usage (standalone; bench.py --warm calls warm_queries on its session):
+
+    python tools/warm.py [--sf 0.05] [--queries Q1,Q3,Q6] [--cache-dir D]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def plan_buckets(session, sql: str) -> set:
+    """Plan one statement (parse -> logical -> placed physical, no
+    execution) and return its estimated shape buckets."""
+    from tinysql_tpu.parser import parse
+    from tinysql_tpu.planner.builder import PlanBuilder
+    from tinysql_tpu.planner.buckets import bucket_estimates
+    try:
+        phys = session._optimize(
+            PlanBuilder(session).build_select(parse(sql)[0]), True)
+        return bucket_estimates(phys, session.sysvars)
+    except Exception:
+        return set()  # warming must never fail the caller
+    finally:
+        session._pinned_is = None
+
+
+def warm_queries(session, queries: dict, verbose: bool = True) -> dict:
+    """Warm every (name -> sql) entry against an already-loaded session:
+    AOT-compile the plan-derived buckets, then execute each query once.
+    Returns a summary dict for the bench JSON."""
+    from tinysql_tpu.ops import kernels
+    t0 = time.time()
+    snap = kernels.stats_snapshot()
+    buckets = set()
+    for name, sql in queries.items():
+        got = plan_buckets(session, sql)
+        buckets |= got
+        if verbose:
+            print(f"[warm] {name}: buckets {sorted(got)}", file=sys.stderr)
+    aot = 0
+    for nb in sorted(buckets):
+        aot += kernels.prewarm_bucket(nb)
+    for name, sql in queries.items():
+        tq = time.time()
+        try:
+            session.query(sql)
+        except Exception as e:  # a broken query must not break warming
+            if verbose:
+                print(f"[warm] {name} failed: {e}", file=sys.stderr)
+            continue
+        if verbose:
+            print(f"[warm] {name} executed in {time.time() - tq:.2f}s",
+                  file=sys.stderr)
+    delta = kernels.stats_delta(snap)
+    out = {
+        "buckets": sorted(buckets),
+        "aot_programs": aot,
+        "programs_traced": delta.get("progcache_misses", 0),
+        "programs_reused": delta.get("progcache_hits", 0),
+        "cache_dir": kernels._cache_dir(),
+        "warm_s": round(time.time() - t0, 2),
+    }
+    if verbose:
+        print(f"[warm] {out}", file=sys.stderr)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sf", type=float, default=0.05,
+                    help="TPC-H scale factor to generate and warm against")
+    ap.add_argument("--queries", default="",
+                    help="comma-separated TPC-H query names (default all)")
+    ap.add_argument("--cache-dir", default="",
+                    help="persistent compile-cache directory "
+                         "(tidb_compile_cache_dir)")
+    args = ap.parse_args()
+
+    # NO backend pinning here: warming must compile for the backend the
+    # real queries will run on (the engine's ensure_live_backend handles
+    # tunnel liveness; JAX_PLATFORMS=cpu remains an explicit override)
+    from tinysql_tpu.bench import tpch
+    from tinysql_tpu.ops import kernels
+    from tinysql_tpu.session.session import new_session
+    if args.cache_dir:
+        kernels.set_compile_cache_dir(args.cache_dir)
+    s = new_session()
+    print(f"[warm] loading TPC-H SF={args.sf} ...", file=sys.stderr)
+    tpch.load(s, sf=args.sf, data=tpch.generate(args.sf))
+    names = [n.strip() for n in args.queries.split(",") if n.strip()] \
+        or list(tpch.QUERIES)
+    queries = {n: tpch.QUERIES[n] for n in names}
+    print(json.dumps(warm_queries(s, queries)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
