@@ -157,7 +157,9 @@ def main():
         warm_mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(warm_mod)
         s.execute("set @@tidb_use_tpu = 1")
-        warm_info = warm_mod.warm_queries(s, tpch.QUERIES)
+        warm_info = warm_mod.warm_queries(
+            s, tpch.QUERIES,
+            stats_path=os.environ.get("TINYSQL_STATS_FEEDBACK", ""))
 
     profile_dir = os.environ.get("TPCH_PROFILE")
     run_stats = {}
@@ -170,7 +172,6 @@ def main():
         walls = []
         stats = {}
         for _ in range(3):
-            snap = kernels.stats_snapshot()
             t0 = time.time()
             rows = s.query(sql).rows
             dt = time.time() - t0
@@ -181,7 +182,14 @@ def main():
             if dt < best:
                 best = dt
                 phases = dict(s.last_query_info)
-                stats = kernels.stats_delta(snap)
+                # counters come from the statement's OWN observability
+                # scope (obs/context.QueryObs), not a global
+                # snapshot/delta pair — concurrent work elsewhere in the
+                # process can no longer pollute a query's detail
+                stats = dict(s.last_query_stats.device_totals())
+                stats.setdefault("dispatches", 0)
+                stats.setdefault("d2h_transfers", 0)
+                stats.setdefault("d2h_bytes", 0)
         if tier != "cpu":
             print(f"[bench] phases parse={phases.get('parse_s', 0)*1e3:.1f}ms"
                   f" plan={phases.get('plan_s', 0)*1e3:.1f}ms"
@@ -195,16 +203,11 @@ def main():
             # scalar sync — dispatches=1/d2h=2 (Q6, BENCH_r05) is a bug
             assert stats.get("d2h_transfers", 0) \
                 <= stats.get("dispatches", 0) + 1, (sql, stats)
-            # pipelined block execution: overlap estimate from the stage/
-            # dispatch/drain walls vs the pipeline wall (busy time beyond
-            # the wall is work that ran CONCURRENTLY on the stage thread)
-            pw = stats.get("pipe_wall_s", 0.0)
-            if pw > 0:
-                busy = (stats.get("pipe_stage_s", 0.0)
-                        + stats.get("pipe_dispatch_s", 0.0)
-                        + stats.get("pipe_drain_s", 0.0))
+            # pipelined block execution: overlap estimate (shared formula
+            # with EXPLAIN ANALYZE — kernels.pipe_overlap_frac)
+            if stats.get("pipe_wall_s", 0.0) > 0:
                 stats["pipe_overlap_frac"] = round(
-                    max(0.0, busy - pw) / pw, 4)
+                    kernels.pipe_overlap_frac(stats), 4)
             extra = {}
             flops = stats.pop("flops", 0.0)
             bytes_acc = stats.pop("bytes_accessed", 0.0)

@@ -1,0 +1,28 @@
+"""OB4xx fixture: direct STATS mutation outside the owning modules.
+
+Every line marked OB4xx below must fire its rule; the clean patterns at
+the bottom must stay silent.  Never imported — parsed by test_lint.py.
+"""
+from tinysql_tpu.ops import kernels
+from tinysql_tpu.ops.kernels import STATS
+
+
+def bump_direct():
+    STATS["dispatches"] += 1                      # OB401 (bare name)
+    kernels.STATS["d2h_transfers"] = 0            # OB401 (attribute)
+    kernels.STATS["d2h_bytes"] += 4096            # OB401 (augassign)
+
+
+def reset_everything():
+    STATS.update(dispatches=0)                    # OB402
+    kernels.STATS.clear()                         # OB402
+
+
+def clean_patterns():
+    # reads are fine anywhere — /metrics renders straight from the dict
+    snapshot = dict(kernels.STATS)
+    n = STATS["dispatches"]
+    # and the accessors are THE sanctioned write path
+    kernels.stats_add("dispatches", 1)
+    kernels.stats_hwm("pipe_depth_hwm", 3)
+    return snapshot, n

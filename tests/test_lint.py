@@ -8,7 +8,7 @@ import sys
 import pytest
 
 from tinysql_tpu.analysis import (gather_sources, lint_lock_discipline,
-                                  lint_trace_safety)
+                                  lint_obs_discipline, lint_trace_safety)
 from tinysql_tpu.analysis.diag import SourceFile
 from tinysql_tpu.analysis.plan_device import (PlanDeviceError, check_plan,
                                               check_explain_consistency,
@@ -228,6 +228,39 @@ def _lint_cli_module():
 LOCK_SCOPE = _lint_cli_module().LOCK_SCOPE
 
 
+def test_stats_fixture_fires_obs_rules():
+    sf = SourceFile(os.path.join(FIXDIR, "bad_stats.py"))
+    diags = lint_obs_discipline(sf)
+    assert [d.rule for d in diags].count("OB401") == 3, \
+        [d.format() for d in diags]
+    assert [d.rule for d in diags].count("OB402") == 2, \
+        [d.format() for d in diags]
+
+
+def test_obs_owning_modules_exempt(tmp_path):
+    # kernels.py ITSELF may write STATS (it owns the accessors); a file
+    # of the same name elsewhere is exempt by basename — the rule's
+    # contract is "outside the owning module"
+    p = tmp_path / "kernels.py"
+    p.write_text("STATS = {}\nSTATS['dispatches'] = 1\n")
+    assert lint_obs_discipline(SourceFile(str(p))) == []
+
+
+def test_obs_reads_not_flagged(tmp_path):
+    p = tmp_path / "reader.py"
+    p.write_text("from tinysql_tpu.ops import kernels\n"
+                 "snap = dict(kernels.STATS)\n"
+                 "n = kernels.STATS['dispatches']\n")
+    assert lint_obs_discipline(SourceFile(str(p))) == []
+
+
+def test_tree_obs_discipline_clean():
+    diags = []
+    for sf in gather_sources(os.path.join(REPO, "tinysql_tpu")):
+        diags.extend(lint_obs_discipline(sf))
+    assert not diags, "\n".join(d.format() for d in diags)
+
+
 def test_tree_trace_safety_clean():
     diags = []
     for sf in gather_sources(os.path.join(REPO, "tinysql_tpu")):
@@ -260,6 +293,7 @@ def test_corpus_plans_clean():
     ("locks", "bad_locks.py"),
     ("trace", "bad_suppress.py"),
     ("trace", "bad_pipeline.py"),
+    ("obs", "bad_stats.py"),
 ])
 def test_cli_exits_nonzero_on_fixture(passname, fixture):
     r = subprocess.run(

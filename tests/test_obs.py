@@ -1,0 +1,396 @@
+"""Query-lifecycle observability (tinysql_tpu/obs/): per-query counter
+scoping under concurrency, accumulator vs high-water-mark semantics,
+span nesting across the devpipe producer thread, EXPLAIN ANALYZE,
+the slow-query log, the prewarm feedback loop, and the /metrics +
+/debug/trace endpoints."""
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from tinysql_tpu.executor.devpipe import BlockPipeline
+from tinysql_tpu.obs import context as obs_context
+from tinysql_tpu.obs import metrics as obs_metrics
+from tinysql_tpu.obs import slowlog as obs_slowlog
+from tinysql_tpu.obs.trace import clear_traces, recent_traces
+from tinysql_tpu.ops import kernels
+from tinysql_tpu.server.http_status import StatusServer
+from tinysql_tpu.utils.testkit import TestKit
+
+N_ROWS = 240
+
+
+def _kit(tpu: bool = False) -> TestKit:
+    tk = TestKit()
+    tk.must_exec("create database test")
+    tk.must_exec("use test")
+    tk.must_exec("create table t (a int primary key, b int, c varchar(8))")
+    tk.must_exec("insert into t values " + ", ".join(
+        f"({i}, {i % 7}, 'x{i % 3}')" for i in range(1, N_ROWS + 1)))
+    if tpu:
+        tk.must_exec("set @@tidb_use_tpu = 1")
+        tk.must_exec("set @@tidb_tpu_min_rows = 0")
+    else:
+        tk.must_exec("set @@tidb_use_tpu = 0")
+    return tk
+
+
+AGG_SQL = "select b, count(*), sum(a) from t group by b order by b"
+
+
+# ---- per-query scoping ---------------------------------------------------
+
+def test_per_query_counters_replace_global_delta():
+    tk = _kit(tpu=True)
+    tk.must_query(AGG_SQL)  # warm programs
+    totals = []
+    for _ in range(2):
+        tk.must_query(AGG_SQL)
+        totals.append(tk.session.last_query_stats.device_totals())
+    assert totals[0].get("dispatches", 0) > 0
+    # warm runs are deterministic: identical per-query counters
+    for k in ("dispatches", "d2h_transfers", "d2h_bytes"):
+        assert totals[0].get(k, 0) == totals[1].get(k, 0), (k, totals)
+
+
+def test_interleaved_sessions_report_independent_counters():
+    """Two sessions executing CONCURRENTLY (own threads, own storages)
+    must each report the same per-query counters as a solo run — the
+    global-snapshot/delta corruption the obs scopes exist to fix."""
+    kits = [_kit(tpu=True), _kit(tpu=True)]
+    for tk in kits:
+        tk.must_query(AGG_SQL)  # warm: compiles land in shared caches
+        tk.must_query(AGG_SQL)
+    solo = [tk.session.last_query_stats.device_totals() for tk in kits]
+    assert solo[0].get("dispatches", 0) > 0
+
+    barrier = threading.Barrier(2)
+    results = [None, None]
+    errors = []
+
+    def run(i):
+        try:
+            barrier.wait(timeout=10)
+            for _ in range(3):
+                kits[i].must_query(AGG_SQL)
+            results[i] = kits[i].session.last_query_stats.device_totals()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errors, errors
+    for i in range(2):
+        for k in ("dispatches", "d2h_transfers", "d2h_bytes"):
+            assert results[i].get(k, 0) == solo[i].get(k, 0), \
+                (i, k, results[i], solo[i])
+
+
+def test_accumulator_vs_hwm_semantics():
+    qobs = obs_context.QueryObs(sql="synthetic")
+    tok = obs_context.activate(qobs)
+    try:
+        base_blocks = kernels.STATS["pipe_blocks"]
+        kernels.stats_add("pipe_blocks", 2)
+        kernels.stats_add("pipe_blocks", 3)
+        kernels.stats_hwm("pipe_depth_hwm", 3)
+        kernels.stats_hwm("pipe_depth_hwm", 2)  # lower: must not win
+    finally:
+        obs_context.deactivate(tok)
+    totals = qobs.device_totals()
+    assert totals["pipe_blocks"] == 5          # accumulator: sums
+    assert totals["pipe_depth_hwm"] == 3       # high-water mark: max
+    assert kernels.STATS["pipe_blocks"] == base_blocks + 5
+    # after deactivation increments no longer reach the scope
+    kernels.stats_add("pipe_blocks", 7)
+    assert qobs.device_totals()["pipe_blocks"] == 5
+
+
+def test_counters_attribute_to_current_operator():
+    qobs = obs_context.QueryObs(sql="synthetic")
+    tok = obs_context.activate(qobs)
+    try:
+        st = qobs.op_stats(object(), "FakeExec")
+        op_tok = obs_context.push_op(st)
+        kernels.stats_add("dispatches", 1)
+        obs_context.pop_op(op_tok)
+        kernels.stats_add("dispatches", 1)  # no live operator frame
+    finally:
+        obs_context.deactivate(tok)
+    assert st.device["dispatches"] == 1
+    assert qobs.device_totals()["dispatches"] == 2
+
+
+# ---- span tracing --------------------------------------------------------
+
+def test_span_nesting_within_thread():
+    qobs = obs_context.QueryObs(sql="synthetic")
+    tok = obs_context.activate(qobs)
+    try:
+        with obs_context.span("outer") as so:
+            with obs_context.span("inner") as si:
+                assert si.parent == so.sid
+    finally:
+        obs_context.deactivate(tok)
+    spans = {s["name"]: s for s in qobs.tracer.spans()}
+    assert spans["inner"]["parent"] == spans["outer"]["id"]
+    assert spans["outer"]["parent"] is None
+
+
+def test_stage_spans_parent_across_producer_thread():
+    """BlockPipeline's producer thread runs in a copy of the creator's
+    context: its stage spans must land on the creating query's tracer,
+    parented to the span live at pipeline creation, on a DIFFERENT
+    thread id."""
+    qobs = obs_context.QueryObs(sql="synthetic")
+    tok = obs_context.activate(qobs)
+    try:
+        with obs_context.span("execute") as ex_span:
+            pipe = BlockPipeline(lambda i: i * i, range(4), depth=2)
+            assert list(pipe) == [0, 1, 4, 9]
+    finally:
+        obs_context.deactivate(tok)
+    spans = qobs.tracer.spans()
+    stage = [s for s in spans if s["name"] == "stage"]
+    assert len(stage) == 4
+    main_tid = threading.get_ident()
+    for s in stage:
+        assert s["parent"] == ex_span.sid
+        assert s["tid"] != main_tid
+    # depth=0 (synchronous) stages record on the caller's thread
+    qobs2 = obs_context.QueryObs(sql="sync")
+    tok = obs_context.activate(qobs2)
+    try:
+        list(BlockPipeline(lambda i: i, range(2), depth=0))
+    finally:
+        obs_context.deactivate(tok)
+    assert all(s["tid"] == main_tid for s in qobs2.tracer.spans())
+
+
+def test_chrome_trace_export_shape():
+    tk = _kit(tpu=False)
+    tk.must_query("select count(*) from t")
+    trace = tk.session.last_trace
+    assert "traceEvents" in trace
+    names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert {"parse", "plan", "place", "execute"} <= names
+    for e in trace["traceEvents"]:
+        if e["ph"] == "X":
+            assert e["dur"] >= 0 and e["ts"] > 0
+
+
+# ---- session timing (the parse amortization fix) ------------------------
+
+def test_batch_parse_reported_once():
+    tk = _kit(tpu=False)
+    tk.must_exec("select 1 from t limit 1; select 2 from t limit 1")
+    info = tk.session.last_query_info
+    stmts = info["statements"]
+    assert len(stmts) == 2
+    # the batch parse wall lands on the FIRST statement only, and the
+    # batch total adds it exactly once
+    assert stmts[0]["parse_s"] > 0.0
+    assert stmts[1]["parse_s"] == 0.0
+    expect = info["parse_s"] + sum(x["exec_s"] for x in stmts)
+    assert abs(info["total_s"] - expect) < 1e-9
+    assert info["parse_s"] == stmts[0]["parse_s"]
+
+
+# ---- EXPLAIN ANALYZE -----------------------------------------------------
+
+def test_explain_analyze_golden_join_agg():
+    tk = _kit(tpu=False)
+    rs = tk.session.query(
+        "explain analyze select p.b, count(*) from t p join t q "
+        "on p.a = q.a group by p.b order by p.b")
+    assert rs.columns == ["id", "estRows", "actRows", "task",
+                          "execution info", "device info", "operator info"]
+    got = [(r[0], r[2]) for r in rs.rows]
+    assert got == [
+        ("Sort", "7"),
+        ("  Projection", "7"),
+        ("    HashAgg", "7"),
+        ("      MergeJoin", str(N_ROWS)),
+        ("        TableReader", str(N_ROWS)),
+        ("          TableScan", ""),
+        ("        TableReader", str(N_ROWS)),
+        ("          TableScan", ""),
+    ], rs.rows
+    for r in rs.rows:
+        if r[0].strip() == "TableScan":
+            continue
+        assert r[4].startswith("time:"), r
+        assert "loops:" in r[4], r
+
+
+def test_explain_analyze_actrows_matches_result_tpu():
+    tk = _kit(tpu=True)
+    n = len(tk.must_query(AGG_SQL).data)
+    rs = tk.session.query("explain analyze " + AGG_SQL)
+    act = rs.rows[0][rs.columns.index("actRows")]
+    assert str(act) == str(n), rs.rows
+    dev = [r[rs.columns.index("device info")] for r in rs.rows]
+    assert any("dispatches:" in d for d in dev), rs.rows
+    assert any("cache:" in d for d in dev), rs.rows
+
+
+def test_plain_explain_unchanged():
+    tk = _kit(tpu=False)
+    rs = tk.session.query("explain select * from t")
+    assert rs.columns == ["id", "estRows", "task", "operator info"]
+
+
+# ---- slow log ------------------------------------------------------------
+
+def test_slow_log_structured_jsonl(tmp_path, monkeypatch):
+    path = tmp_path / "slow.jsonl"
+    monkeypatch.setenv("TINYSQL_SLOW_LOG", str(path))
+    obs_slowlog.clear()
+    tk = _kit(tpu=False)
+    tk.must_exec("set @@tidb_slow_log_threshold = 0")  # everything is slow
+    tk.must_query(AGG_SQL)
+    recs = obs_slowlog.recent()
+    assert recs, "no slow-log record captured"
+    rec = recs[-1]
+    assert rec["sql"].startswith("select b, count(*)")
+    assert rec["exec_ms"] >= 0 and rec["total_ms"] >= rec["exec_ms"]
+    assert rec["plan_digest"]
+    labels = [o["label"] for o in rec["operators"]]
+    assert any("HashAgg" in l for l in labels), labels
+    # the JSONL file got the same record
+    lines = [json.loads(l) for l in path.read_text().splitlines() if l]
+    assert any(l["sql"] == rec["sql"] for l in lines)
+
+
+def test_slow_log_threshold_sysvar_respected():
+    obs_slowlog.clear()
+    tk = _kit(tpu=False)
+    tk.must_exec("set @@tidb_slow_log_threshold = 600000")  # 10 min
+    tk.must_query("select count(*) from t")
+    assert not any(r["sql"].startswith("select count(*)")
+                   for r in obs_slowlog.recent())
+
+
+# ---- prewarm feedback loop ----------------------------------------------
+
+def test_feedback_file_and_merge(tmp_path, monkeypatch):
+    from tinysql_tpu.planner.buckets import merge_feedback
+    path = tmp_path / "stats.jsonl"
+    monkeypatch.setenv("TINYSQL_STATS_FEEDBACK", str(path))
+    tk = _kit(tpu=False)
+    tk.must_query(AGG_SQL)
+    recs = [json.loads(l) for l in path.read_text().splitlines() if l]
+    assert recs and recs[-1]["buckets"], recs
+    assert recs[-1]["plan_digest"]
+    merged = merge_feedback(str(path))
+    # every observed operator cardinality must produce its bucket +
+    # growth headroom in the merged prewarm set
+    for op in recs[-1]["operators"]:
+        if op["act_rows"] > 0:
+            nb = kernels.bucket(op["act_rows"])
+            assert nb in merged and nb * 2 in merged, (op, merged)
+    # merge is a union into an existing set
+    prior = {8}
+    assert merge_feedback(str(path), prior) is prior
+    assert prior > {8}, prior
+
+
+def test_feedback_captures_fused_input_shape_buckets(tmp_path, monkeypatch):
+    """TPU-tier kernels pad inputs to shape buckets that never flow
+    through an operator's next() (fused paths consume the replica
+    directly) — the feedback record must still carry them, via
+    kernels.bucket reporting into the query scope."""
+    path = tmp_path / "stats.jsonl"
+    monkeypatch.setenv("TINYSQL_STATS_FEEDBACK", str(path))
+    tk = _kit(tpu=True)
+    tk.must_query(AGG_SQL)
+    recs = [json.loads(l) for l in path.read_text().splitlines() if l]
+    buckets = set(recs[-1]["buckets"])
+    nb = kernels.bucket(N_ROWS)  # the scan's padded input shape
+    assert nb in buckets and nb * 2 in buckets, (nb, buckets)
+
+
+def test_merge_feedback_tolerates_garbage(tmp_path):
+    from tinysql_tpu.planner.buckets import merge_feedback
+    p = tmp_path / "junk.jsonl"
+    p.write_text('not json\n{"buckets": [64, "x"]}\n{"operators": 3}\n')
+    assert 64 in merge_feedback(str(p))
+    assert merge_feedback(str(tmp_path / "missing.jsonl")) == set()
+
+
+# ---- endpoints -----------------------------------------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.read().decode()
+
+
+def test_metrics_and_trace_endpoints_roundtrip():
+    clear_traces()
+    tk = _kit(tpu=True)
+    tk.must_query(AGG_SQL)
+    st = StatusServer(None, port=0)
+    st.start()
+    try:
+        text = _get(st.port, "/metrics")
+        # valid Prometheus text: HELP/TYPE pairs, parsable sample lines
+        metrics = {}
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            name_labels, _, value = line.rpartition(" ")
+            float(value)  # every sample value parses
+            metrics[name_labels.split("{")[0]] = float(value)
+        for name in ("tinysql_queries_total", "tinysql_dispatches_total",
+                     "tinysql_progcache_hits_total"):
+            assert name in metrics, sorted(metrics)
+        assert metrics["tinysql_dispatches_total"] > 0
+        assert text.count("# TYPE") == len(set(
+            l.split()[2] for l in text.splitlines()
+            if l.startswith("# TYPE")))
+
+        traces = json.loads(_get(st.port, "/debug/trace?n=8"))
+        assert traces, "trace ring empty"
+        assert any("select b, count(*)" in t["sql"] for t in traces)
+        last = traces[-1]
+        assert last["spans"]
+        assert any(s["name"] == "execute" for s in last["spans"])
+        # junk / negative n degrade to "everything", never an odd slice
+        assert len(json.loads(_get(st.port, "/debug/trace?n=-2"))) \
+            == len(json.loads(_get(st.port, "/debug/trace")))
+
+        slow = json.loads(_get(st.port, "/debug/slowlog"))
+        assert isinstance(slow, list)
+    finally:
+        st.close()
+
+
+def test_metrics_render_without_server():
+    out = obs_metrics.render_prometheus()
+    assert "tinysql_dispatches_total" in out
+    assert out.endswith("\n")
+
+
+# ---- bench wiring --------------------------------------------------------
+
+def test_q6_transfer_invariant_from_query_scope():
+    """bench.py's Q6 accounting invariant, now sourced from the
+    per-query scope: packed D2H pulls never exceed dispatches + 1."""
+    from tinysql_tpu.bench import tpch
+    from tinysql_tpu.session.session import new_session
+    s = new_session()
+    tpch.load(s, sf=0.002, data=tpch.generate(0.002))
+    s.execute("set @@tidb_use_tpu = 1")
+    s.execute("set @@tidb_tpu_min_rows = 0")
+    for _ in range(2):
+        rows = s.query(tpch.QUERIES["Q6"]).rows
+    assert len(rows) == 1
+    totals = s.last_query_stats.device_totals()
+    assert totals.get("dispatches", 0) > 0
+    assert totals.get("d2h_transfers", 0) <= totals["dispatches"] + 1

@@ -18,6 +18,10 @@ Three passes over the invariants nothing else checks mechanically:
 - **lock-discipline** (`lock_discipline.py`, LD3xx): infers per-class
   lock-to-field guard maps for the threaded subsystems and flags
   shared-state mutations outside declared lock scopes.
+- **obs-discipline** (`obs_discipline.py`, OB4xx): flags direct
+  ``STATS[...]`` writes outside the owning device-layer modules — only
+  the ``kernels.stats_add``/``stats_hwm`` accessors fan increments out
+  to per-query observability scopes (obs/context.py).
 
 Every pass honors inline suppressions with REQUIRED justification text:
 
@@ -28,11 +32,12 @@ See docs/LINT.md and tools/lint.py.
 from .diag import (Diagnostic, Severity, SourceFile, format_diagnostics,
                    gather_sources)
 from .lock_discipline import lint_lock_discipline
+from .obs_discipline import lint_obs_discipline
 from .plan_device import PlanDeviceError, check_plan, verify_plan
 from .trace_safety import lint_trace_safety
 
 __all__ = [
     "Diagnostic", "Severity", "SourceFile", "format_diagnostics",
     "gather_sources", "lint_trace_safety", "lint_lock_discipline",
-    "check_plan", "verify_plan", "PlanDeviceError",
+    "lint_obs_discipline", "check_plan", "verify_plan", "PlanDeviceError",
 ]

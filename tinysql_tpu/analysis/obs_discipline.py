@@ -1,0 +1,81 @@
+"""qlint pass: observability counter discipline (OB4xx).
+
+The device-economics counters (``ops/kernels.STATS``,
+``ops/progcache.STATS``) are written ONLY through their owning module's
+accessors (``kernels.stats_add`` / ``kernels.stats_hwm``; progcache's
+own locked ``get``).  The accessors are what fan every increment out to
+the active per-query observability scope (obs/context.py) — a direct
+``STATS[...] += 1`` elsewhere updates the global dict but silently
+vanishes from per-query attribution, EXPLAIN ANALYZE, and the slow log,
+and (being unlocked read-modify-write from arbitrary threads) can lose
+increments under the devpipe producer.
+
+Rules:
+
+- **OB401**: direct subscript write (``STATS[k] = ...`` /
+  ``STATS[k] += ...``) to a name or attribute called ``STATS`` outside
+  the owning modules.
+- **OB402**: mutating-method call (``STATS.update/clear/setdefault/
+  pop``) on such a target outside the owning modules.
+
+Reads (``STATS["dispatches"]``, ``dict(STATS)``) are fine anywhere —
+that is what /metrics does.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from .diag import Diagnostic, SourceFile, register_rules
+
+register_rules({
+    "OB401": "direct STATS[...] write outside the owning module — use "
+             "kernels.stats_add/stats_hwm so per-query scopes see it",
+    "OB402": "mutating STATS method call (update/clear/setdefault/pop) "
+             "outside the owning module",
+})
+
+#: modules that own a STATS dict and its accessors
+OWNING_MODULES = ("kernels.py", "progcache.py")
+
+_MUTATORS = {"update", "clear", "setdefault", "pop", "popitem"}
+
+
+def _is_stats_target(e: ast.expr) -> bool:
+    """``STATS`` / ``kernels.STATS`` / ``x.y.STATS``."""
+    if isinstance(e, ast.Name):
+        return e.id == "STATS"
+    if isinstance(e, ast.Attribute):
+        return e.attr == "STATS"
+    return False
+
+
+def lint_obs_discipline(sf: SourceFile) -> List[Diagnostic]:
+    if os.path.basename(sf.path) in OWNING_MODULES:
+        return []
+    diags: List[Diagnostic] = []
+    for node in ast.walk(sf.tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Subscript) and _is_stats_target(t.value):
+                diags.append(Diagnostic(
+                    "OB401",
+                    "direct STATS[...] write — route through "
+                    "kernels.stats_add/stats_hwm (per-query scopes and "
+                    "/metrics depend on the accessor fan-out)",
+                    sf.path, t.lineno))
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS \
+                and _is_stats_target(node.func.value):
+            diags.append(Diagnostic(
+                "OB402",
+                f"STATS.{node.func.attr}(...) mutates the counter table "
+                "outside its owning module — use the accessors",
+                sf.path, node.lineno))
+    return sf.filter(diags)

@@ -53,6 +53,7 @@ Key design points (why this maps well onto TPU + XLA):
 """
 from __future__ import annotations
 
+import contextvars
 import os
 import queue
 import threading
@@ -61,6 +62,8 @@ import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
+
+from ..obs import context as _obs
 
 from ..chunk import Chunk, Column as CCol
 from ..expression import Column as ExprColumn, Constant
@@ -146,9 +149,25 @@ class BlockPipeline:
         self._thread = None
         if not self._sync:
             self._q = queue.Queue(maxsize=max(1, depth))
+            # the producer runs inside a COPY of the creator's context:
+            # the active QueryObs scope, current-operator attribution,
+            # and span parent all carry across the thread boundary, so
+            # stage spans/counters land on the query (and operator) that
+            # built the pipeline (obs/context.py)
+            cctx = contextvars.copy_context()
             self._thread = threading.Thread(
-                target=self._run, name="tinysql-pipe-stage", daemon=True)
+                target=cctx.run, args=(self._run,),
+                name="tinysql-pipe-stage", daemon=True)
             self._thread.start()
+
+    def _stage_timed(self, item):
+        t0 = time.time()
+        with _obs.span("stage", cat="pipeline"):
+            out = self._stage(item)
+        dt = time.time() - t0
+        with self._mu:
+            self._stage_s += dt
+        return out
 
     # ---- producer -------------------------------------------------------
     def _run(self) -> None:
@@ -156,11 +175,7 @@ class BlockPipeline:
             for item in self._items:
                 if self._cancel.is_set():
                     return
-                t0 = time.time()
-                out = self._stage(item)
-                dt = time.time() - t0
-                with self._mu:
-                    self._stage_s += dt
+                out = self._stage_timed(item)
                 if not self._put((out, None)):
                     return
         except BaseException as exc:  # delivered to the consumer
@@ -185,12 +200,7 @@ class BlockPipeline:
     def __iter__(self):
         if self._sync:
             for item in self._items:
-                t0 = time.time()
-                out = self._stage(item)
-                dt = time.time() - t0
-                with self._mu:
-                    self._stage_s += dt
-                yield out
+                yield self._stage_timed(item)
             return
         try:
             while True:
@@ -2421,6 +2431,13 @@ class DevPipeExec:
 
     def _open_fallback(self, ctx):
         self._fallback = self._fallback_builder(self.plan)
+        qobs = getattr(self, "_obs_qobs", None)
+        if qobs is not None:
+            # the per-operator fallback tree is built lazily (after
+            # instrument_tree walked the executor tree), so a pipeline
+            # bail-out instruments it here with the same query scope
+            from ..obs.runtime_stats import instrument_tree
+            instrument_tree(self._fallback, qobs)
         self._fallback.open(ctx)
 
     def next(self) -> Optional[Chunk]:

@@ -1480,7 +1480,17 @@ class TableDualExec(Executor):
 def build_executor(plan: PhysicalPlan, use_tpu: bool = False) -> Executor:
     """Physical plan -> executor tree (reference: executor/builder.go:69-117).
     With use_tpu, the big four operators come from the TPU tier when the
-    plan's device enforcer marked them eligible."""
+    plan's device enforcer marked them eligible.  Every executor is
+    tagged with the plan node it was built from (``_obs_plan``) so
+    obs/runtime_stats can key per-operator RuntimeStats for
+    EXPLAIN ANALYZE without per-executor changes."""
+    ex = _build_executor(plan, use_tpu)
+    if getattr(ex, "_obs_plan", None) is None:
+        ex._obs_plan = plan
+    return ex
+
+
+def _build_executor(plan: PhysicalPlan, use_tpu: bool = False) -> Executor:
     if use_tpu and getattr(plan, "use_tpu", False):
         from .tpu_executors import build_tpu_executor
         ex = build_tpu_executor(plan)

@@ -1812,6 +1812,13 @@ def build_tpu_executor(plan) -> Optional[Executor]:
 
 
 def _build_tpu_op(plan) -> Optional[Executor]:
+    ex = _build_tpu_op_inner(plan)
+    if ex is not None and getattr(ex, "_obs_plan", None) is None:
+        ex._obs_plan = plan  # per-operator stats key (obs/runtime_stats)
+    return ex
+
+
+def _build_tpu_op_inner(plan) -> Optional[Executor]:
     if isinstance(plan, PhysicalHashAgg):
         return TPUHashAggExec(plan, build_executor(plan.children[0], True))
     if isinstance(plan, PhysicalHashJoin):

@@ -1,0 +1,43 @@
+"""Query-lifecycle observability.
+
+The reference surface this reproduces: executor runtime stats feeding
+``EXPLAIN ANALYZE`` (reference: util/execdetails + distsql/select_result
+CopRuntimeStats), the slow-query log (executor/adapter.go LogSlowQuery),
+and the HTTP status server's metrics export.  The TPU twist: the
+interesting runtime facts here are *device economics* — program
+dispatches, packed D2H transfers, compile-cache behavior, pipeline
+stage/compute overlap — which used to live only in the process-global
+``kernels.STATS`` dict, unattributable to a query or an operator.
+
+Three cooperating pieces:
+
+- **context** (`context.py`): a ``contextvars``-scoped ``QueryObs`` per
+  statement.  Device-layer accessors (``kernels.stats_add`` /
+  ``stats_hwm``, progcache hit/miss) fan each increment out to the
+  active query scope and to the operator whose ``next()`` frame is live,
+  so two concurrent sessions collect disjoint counters while the global
+  totals stay monotonic for ``/metrics``.  The devpipe producer thread
+  inherits the creator's scope via ``contextvars.copy_context``.
+- **RuntimeStats** (`context.py` + `runtime_stats.py`): per-operator
+  actual rows, Next loops, wall time, and device counters, collected by
+  wrapping the Open/Next/Close executor interface (``instrument_tree``)
+  — no per-executor code changes.
+- **surfaces**: ``EXPLAIN ANALYZE`` (planner/explain.py), Prometheus
+  ``/metrics`` + ``/debug/trace`` (server/http_status.py via
+  `metrics.py` / `trace.py`), the JSONL slow-query log (`slowlog.py`,
+  threshold sysvar ``tidb_slow_log_threshold``), and the bucket-prewarm
+  feedback file (`feedback.py`, consumed by ``tools/warm.py
+  --from-stats``).
+
+See docs/OBSERVABILITY.md.
+"""
+from .context import (QueryObs, RuntimeStats, activate, current,
+                      current_op, deactivate, record, record_hwm, span)
+from .runtime_stats import instrument_tree
+from .trace import Tracer, recent_traces
+
+__all__ = [
+    "QueryObs", "RuntimeStats", "Tracer", "activate", "current",
+    "current_op", "deactivate", "instrument_tree", "record", "record_hwm",
+    "recent_traces", "span",
+]

@@ -1,0 +1,150 @@
+"""Process-level metrics and the Prometheus text renderer (/metrics).
+
+Two counter families:
+
+- query-lifecycle counters owned here (``observe_query``): statements
+  executed, errors, slow queries, summed wall seconds — labeled by
+  statement kind;
+- device-economics counters owned by the device layer (``kernels.STATS``
+  and ``ops/progcache.STATS``), read at render time.  Those dicts are
+  process-cumulative accumulators (plus the ``pipe_depth_hwm`` high-water
+  mark, exported as a gauge): exactly the monotonic shape Prometheus
+  counters want.
+
+Rendering follows the Prometheus text exposition format 0.0.4 (HELP/TYPE
+comment pairs, ``\\n``-terminated sample lines).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+_mu = threading.Lock()
+
+#: (metric, labels-tuple) -> value;  labels-tuple is ((k, v), ...)
+_QUERY_COUNTERS: Dict[Tuple[str, tuple], float] = {}
+
+#: device-layer STATS key -> (prometheus name, help text)
+_DEVICE_METRICS = {
+    "dispatches": ("tinysql_dispatches_total",
+                   "Compiled device-program dispatches"),
+    "d2h_transfers": ("tinysql_d2h_transfers_total",
+                      "Device-to-host transfer operations"),
+    "d2h_bytes": ("tinysql_d2h_bytes_total",
+                  "Bytes materialized device-to-host"),
+    "flops": ("tinysql_device_flops_total",
+              "XLA cost-analysis FLOPs of dispatched programs"),
+    "bytes_accessed": ("tinysql_device_bytes_accessed_total",
+                       "XLA cost-analysis bytes accessed"),
+    "pipe_blocks": ("tinysql_pipe_blocks_total",
+                    "Blocks staged through the async block pipeline"),
+    "pipe_stage_s": ("tinysql_pipe_stage_seconds_total",
+                     "Host staging wall seconds (pipeline producer)"),
+    "pipe_dispatch_s": ("tinysql_pipe_dispatch_seconds_total",
+                        "Device dispatch wall seconds inside pipelines"),
+    "pipe_drain_s": ("tinysql_pipe_drain_seconds_total",
+                     "Result drain wall seconds inside pipelines"),
+    "pipe_wall_s": ("tinysql_pipe_wall_seconds_total",
+                    "End-to-end pipeline wall seconds"),
+    "pipe_depth_hwm": ("tinysql_pipe_depth_hwm",
+                       "Staging-queue depth high-water mark"),
+}
+
+
+def _bump(metric: str, labels: tuple, n: float) -> None:
+    with _mu:
+        key = (metric, labels)
+        _QUERY_COUNTERS[key] = _QUERY_COUNTERS.get(key, 0) + n
+
+
+def observe_query(kind: str, seconds: float, slow: bool = False,
+                  error: bool = False) -> None:
+    """Record one finished statement (kind = lowercased statement class,
+    e.g. ``select`` / ``insert`` / ``explain``)."""
+    labels = (("kind", kind),)
+    _bump("tinysql_queries_total", labels, 1)
+    _bump("tinysql_query_seconds_sum", labels, seconds)
+    if slow:
+        _bump("tinysql_slow_queries_total", labels, 1)
+    if error:
+        _bump("tinysql_query_errors_total", labels, 1)
+
+
+def reset() -> None:
+    """Tests only."""
+    with _mu:
+        _QUERY_COUNTERS.clear()
+
+
+def _fmt_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, float) and not v.is_integer():
+        return repr(v)
+    return str(int(v))
+
+
+def render_prometheus() -> str:
+    """The /metrics payload.  Imports the device layer lazily so the
+    status server stays importable without jax."""
+    lines: List[str] = []
+
+    def emit(name: str, help_text: str, mtype: str,
+             samples: List[Tuple[tuple, float]]) -> None:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for labels, v in samples:
+            lines.append(f"{name}{_fmt_labels(labels)} {_fmt_value(v)}")
+
+    # query-lifecycle counters
+    with _mu:
+        grouped: Dict[str, List[Tuple[tuple, float]]] = {}
+        for (metric, labels), v in sorted(_QUERY_COUNTERS.items()):
+            grouped.setdefault(metric, []).append((labels, v))
+    helps = {
+        "tinysql_queries_total": "Statements executed",
+        "tinysql_query_seconds_sum":
+            "Summed statement execution wall seconds (parse excluded)",
+        "tinysql_slow_queries_total":
+            "Statements whose exec wall exceeded tidb_slow_log_threshold",
+        "tinysql_query_errors_total": "Statements that raised",
+    }
+    for metric in sorted(grouped):
+        emit(metric, helps.get(metric, metric), "counter", grouped[metric])
+
+    # device-economics counters (kernels.STATS); the HWM-key set is
+    # owned by kernels — one definition, so a new high-water counter
+    # can never be mis-exported as an ever-increasing counter here
+    try:
+        from ..ops import kernels, progcache
+        stats = dict(kernels.STATS)
+        hwm_keys = kernels._HWM_KEYS
+        pstats = progcache.stats_snapshot()
+        psize = progcache.size()
+    except Exception:  # jax import failure must not kill /metrics
+        stats, hwm_keys, pstats, psize = {}, (), {}, None
+    for key, (name, help_text) in _DEVICE_METRICS.items():
+        if key not in stats:
+            continue
+        mtype = "gauge" if key in hwm_keys else "counter"
+        emit(name, help_text, mtype, [((), stats[key])])
+    if pstats:
+        emit("tinysql_progcache_hits_total",
+             "In-process program-registry hits", "counter",
+             [((), pstats.get("hits", 0))])
+        emit("tinysql_progcache_misses_total",
+             "In-process program-registry misses (program builds)",
+             "counter", [((), pstats.get("misses", 0))])
+    if psize is not None:
+        emit("tinysql_progcache_programs", "Registered compiled programs",
+             "gauge", [((), psize)])
+
+    from .trace import recent_traces
+    emit("tinysql_trace_ring_entries", "Query traces buffered for "
+         "/debug/trace", "gauge", [((), len(recent_traces()))])
+    return "\n".join(lines) + "\n"

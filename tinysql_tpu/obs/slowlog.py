@@ -1,0 +1,73 @@
+"""Structured JSONL slow-query log (reference: executor/adapter.go
+LogSlowQuery + the slow-log parser's field contract).
+
+Replaces the inline ``logging.warning("slow query ...")`` in
+session/session.py with one JSON object per slow statement: timings,
+plan digest, per-query device counters, and per-operator RuntimeStats —
+enough to answer "where did the time go" without re-running the query.
+
+Destinations:
+- the ``tinysql_tpu.slowlog`` logger (one JSON line per record);
+- an append-only JSONL file when ``TINYSQL_SLOW_LOG`` names a path;
+- an in-process ring (``recent``) for tests and debug endpoints.
+
+The threshold lives in the ``tidb_slow_log_threshold`` sysvar
+(milliseconds, default 300 — the reference's default).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+LOGGER = logging.getLogger("tinysql_tpu.slowlog")
+
+_mu = threading.Lock()
+_RING: deque = deque(maxlen=64)
+
+
+def build_record(sql: str, info: dict, qobs=None) -> dict:
+    """One slow-log record; ``info`` is the session's per-statement
+    timing dict (parse_s is the per-BATCH parse wall, reported once)."""
+    rec = {
+        "time": time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime()),
+        "sql": sql[:2048].replace("\n", " "),
+        "total_ms": round(info.get("total_s", 0.0) * 1e3, 3),
+        "parse_ms": round(info.get("parse_s", 0.0) * 1e3, 3),
+        "plan_ms": round(info.get("plan_s", 0.0) * 1e3, 3),
+        "exec_ms": round(info.get("exec_s", 0.0) * 1e3, 3),
+    }
+    if qobs is not None:
+        rec["plan_digest"] = qobs.plan_digest
+        rec["device"] = qobs.device_totals()
+        rec["operators"] = qobs.operators()
+    return rec
+
+
+def log_slow(record: dict) -> None:
+    line = json.dumps(record, default=str, sort_keys=True)
+    LOGGER.warning("%s", line)
+    path = os.environ.get("TINYSQL_SLOW_LOG")
+    if path:
+        try:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass  # a full disk must not fail the query
+    with _mu:
+        _RING.append(record)
+
+
+def recent(n: Optional[int] = None) -> List[dict]:
+    with _mu:
+        out = list(_RING)
+    return out[-n:] if n else out
+
+
+def clear() -> None:
+    with _mu:
+        _RING.clear()

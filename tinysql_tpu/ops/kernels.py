@@ -15,6 +15,7 @@ never equi-join, NULL sorts first ASC / last DESC).
 from __future__ import annotations
 
 import os
+import threading
 
 from functools import partial
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -22,6 +23,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import progcache
+from ..obs import context as _obs
 
 _jax = None
 
@@ -237,18 +239,57 @@ STATS = {"dispatches": 0, "d2h_transfers": 0, "d2h_bytes": 0,
 #: STATS keys that are high-water marks, not accumulators
 _HWM_KEYS = ("pipe_depth_hwm",)
 
+#: guards the global STATS read-modify-writes — sessions and devpipe
+#: producer threads increment concurrently
+_STATS_MU = threading.Lock()
+
+
+def stats_add(key: str, n) -> None:
+    """THE accumulator write path (qlint OB401 bans direct ``STATS[...]``
+    writes outside this module): bumps the process-global counter under
+    the lock AND fans the increment out to the active per-query scope +
+    the operator whose next() frame is live (obs/context.py), so
+    concurrent sessions collect disjoint per-query counters."""
+    with _STATS_MU:
+        STATS[key] = STATS.get(key, 0) + n
+    _obs.record(key, n)
+
+
+def stats_hwm(key: str, n) -> None:
+    """High-water-mark write path: keeps the max, globally and in the
+    per-query scope (a deep staging queue in one query must not bleed
+    into another's detail)."""
+    with _STATS_MU:
+        if n > STATS.get(key, 0):
+            STATS[key] = n
+    _obs.record_hwm(key, n)
+
+
+def pipe_overlap_frac(d: dict) -> float:
+    """Staging/compute overlap estimate from a counter scope's ``pipe_*``
+    walls (global STATS delta, or a per-query ``device_totals()``): busy
+    time beyond the pipeline wall is work that ran CONCURRENTLY on the
+    stage thread.  THE one formula — bench detail and EXPLAIN ANALYZE
+    must agree."""
+    pw = d.get("pipe_wall_s", 0.0)
+    if not pw or pw <= 0:
+        return 0.0
+    busy = (d.get("pipe_stage_s", 0.0) + d.get("pipe_dispatch_s", 0.0)
+            + d.get("pipe_drain_s", 0.0))
+    return max(0.0, busy - pw) / pw
+
 
 def pipe_record(blocks: int = 0, stage_s: float = 0.0,
                 dispatch_s: float = 0.0, drain_s: float = 0.0,
                 wall_s: float = 0.0, depth_hwm: int = 0) -> None:
     """Accrue one pipelined run's stage/compute/drain walls into STATS
     (called once per BlockPipeline consumer loop, not per block)."""
-    STATS["pipe_blocks"] += blocks
-    STATS["pipe_stage_s"] += stage_s
-    STATS["pipe_dispatch_s"] += dispatch_s
-    STATS["pipe_drain_s"] += drain_s
-    STATS["pipe_wall_s"] += wall_s
-    STATS["pipe_depth_hwm"] = max(STATS["pipe_depth_hwm"], depth_hwm)
+    stats_add("pipe_blocks", blocks)
+    stats_add("pipe_stage_s", stage_s)
+    stats_add("pipe_dispatch_s", dispatch_s)
+    stats_add("pipe_drain_s", drain_s)
+    stats_add("pipe_wall_s", wall_s)
+    stats_hwm("pipe_depth_hwm", depth_hwm)
 
 # when on, every counted_jit dispatch also accrues the program's XLA cost
 # analysis (flops / bytes accessed) into STATS — the bench's MFU and
@@ -264,15 +305,16 @@ def enable_cost_tracking(flag: bool = True) -> None:
 
 def stats_snapshot() -> dict:
     from . import progcache
-    out = dict(STATS)
+    with _STATS_MU:
+        out = dict(STATS)
+        # high-water marks are PER INTERVAL: a snapshot opens a new
+        # interval (sequential snapshot/delta pairs, the bench's usage),
+        # so a deep queue in query N never bleeds into query N+1's detail
+        for k in _HWM_KEYS:
+            STATS[k] = 0
     pc = progcache.stats_snapshot()
     out["progcache_hits"] = pc["hits"]
     out["progcache_misses"] = pc["misses"]
-    # high-water marks are PER INTERVAL: a snapshot opens a new interval
-    # (sequential snapshot/delta pairs, the bench's usage), so a deep
-    # queue in query N never bleeds into query N+1's detail
-    for k in _HWM_KEYS:
-        STATS[k] = 0
     return out
 
 
@@ -332,18 +374,19 @@ def counted_jit(fn, **kw):
     costs: Dict[tuple, Optional[tuple]] = {}
 
     def call(*a, **k):
-        STATS["dispatches"] += 1
+        stats_add("dispatches", 1)
         if _COST_TRACKING["on"]:
             spec = _arg_spec((a, k))
             c = costs.get(spec)
             if c is not None:
-                STATS["flops"] += c[0]
-                STATS["bytes_accessed"] += c[1]
+                stats_add("flops", c[0])
+                stats_add("bytes_accessed", c[1])
             elif spec not in costs:
                 costs[spec] = None
                 _PENDING_COSTS.append((costs, spec, w,
                                        _abstractify((a, k))))
-        return w(*a, **k)
+        with _obs.span("dispatch", cat="device"):
+            return w(*a, **k)
     # AOT hook for the bucket prewarmer (tools/warm.py):
     # fn.lower(*abstract).compile() compiles without dispatching
     call.lower = w.lower
@@ -352,9 +395,10 @@ def counted_jit(fn, **kw):
 
 def d2h(dev_arr) -> np.ndarray:
     """Counted device->host materialization."""
-    out = np.asarray(dev_arr)
-    STATS["d2h_transfers"] += 1
-    STATS["d2h_bytes"] += out.nbytes
+    with _obs.span("drain", cat="device"):
+        out = np.asarray(dev_arr)
+    stats_add("d2h_transfers", 1)
+    stats_add("d2h_bytes", out.nbytes)
     return out
 
 
@@ -364,9 +408,10 @@ def d2h_many(dev_arrs) -> List[np.ndarray]:
     kernel result split across the int64 and float64 streams pays the
     link's per-transfer latency once, not once per stream (the Q6
     dispatches=1 / d2h_transfers=2 accounting bug, BENCH_r05)."""
-    outs = [np.asarray(a) for a in jax().device_get(list(dev_arrs))]
-    STATS["d2h_transfers"] += 1
-    STATS["d2h_bytes"] += sum(o.nbytes for o in outs)
+    with _obs.span("drain", cat="device"):
+        outs = [np.asarray(a) for a in jax().device_get(list(dev_arrs))]
+    stats_add("d2h_transfers", 1)
+    stats_add("d2h_bytes", sum(o.nbytes for o in outs))
     return outs
 
 
@@ -441,10 +486,14 @@ def unpack_flat(pair, schema: list) -> List[np.ndarray]:
 
 def bucket(n: int) -> int:
     """Pad target: next power of two (min 16) — bounds recompiles to
-    O(log n) distinct shapes."""
+    O(log n) distinct shapes.  Each resolved bucket is reported to the
+    active per-query scope (obs/context.py): the ground truth the
+    prewarm feedback loop records, since fused-pipeline input shapes
+    never flow through an operator's next()."""
     b = 16
     while b < n:
         b <<= 1
+    _obs.record_bucket(b)
     return b
 
 

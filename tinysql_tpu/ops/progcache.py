@@ -30,6 +30,8 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional
 
+from ..obs import context as _obs
+
 _mu = threading.Lock()
 _REG: Dict[tuple, object] = {}
 _MISS = object()
@@ -46,9 +48,17 @@ def get(key: tuple, build: Callable[[], object]):
         ent = _REG.get(key, _MISS)
         if ent is not _MISS:
             STATS["hits"] += 1
-            return ent
-        STATS["misses"] += 1
-    ent = build()
+            hit = True
+        else:
+            STATS["misses"] += 1
+            hit = False
+    # per-query attribution rides the obs scope (kernels.stats_snapshot
+    # exports the global pair as progcache_hits/progcache_misses)
+    _obs.record("progcache_hits" if hit else "progcache_misses", 1)
+    if hit:
+        return ent
+    with _obs.span("compile", cat="device", key=str(key[0])):
+        ent = build()
     with _mu:
         return _REG.setdefault(key, ent)
 

@@ -1,7 +1,10 @@
-"""EXPLAIN text rendering (reference: planner/core/explain.go + stringer)."""
+"""EXPLAIN / EXPLAIN ANALYZE text rendering (reference:
+planner/core/explain.go + stringer; common_plans.go Explain with
+RuntimeStats for the ANALYZE columns)."""
 from __future__ import annotations
 
-from typing import List
+import hashlib
+from typing import List, Optional
 
 from .physical import (PhysicalHashAgg, PhysicalHashJoin,
                        PhysicalIndexLookUpReader, PhysicalIndexReader,
@@ -104,4 +107,93 @@ def explain_text(p: PhysicalPlan, depth: int = 0,
                     f"table:{p.scan.alias}"])
     for c in children:
         explain_text(c, depth + 1, out)
+    return out
+
+
+def plan_digest(p: PhysicalPlan) -> str:
+    """Stable digest of the plan SHAPE (operator tree + operator info,
+    estimates excluded so stats drift keeps the digest) — the slow-log /
+    feedback-file join key (reference: plan digest in the slow log)."""
+    parts: List[str] = []
+
+    def walk(n, depth):
+        parts.append(f"{depth}:{n.op_name()}"
+                     f":{int(bool(getattr(n, 'use_tpu', False)))}"
+                     f":{_info(n)}")
+        for c in n.children:
+            walk(c, depth + 1)
+
+    walk(p, 0)
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
+
+
+# ---- EXPLAIN ANALYZE -----------------------------------------------------
+
+EXPLAIN_ANALYZE_COLUMNS = ("id", "estRows", "actRows", "task",
+                           "execution info", "device info",
+                           "operator info")
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GB"
+
+
+def _exec_info(st) -> str:
+    return (f"time:{st.wall_s * 1e3:.1f}ms, open:{st.open_s * 1e3:.1f}ms, "
+            f"loops:{st.loops}")
+
+
+def _device_info(st) -> str:
+    """Device-economics cell: program dispatches, packed D2H transfers/
+    bytes, program-cache hits/misses, and the pipeline stage/dispatch/
+    drain/overlap accounting — only the families that actually fired."""
+    d = st.device
+    parts = []
+    if d.get("dispatches"):
+        parts.append(f"dispatches:{int(d['dispatches'])}")
+    if d.get("d2h_transfers"):
+        parts.append(f"d2h:{int(d['d2h_transfers'])}/"
+                     f"{_fmt_bytes(d.get('d2h_bytes', 0))}")
+    hits, misses = d.get("progcache_hits", 0), d.get("progcache_misses", 0)
+    if hits or misses:
+        parts.append(f"cache:{int(hits)}h/{int(misses)}m")
+    if d.get("pipe_blocks"):
+        from ..ops.kernels import pipe_overlap_frac
+        overlap = pipe_overlap_frac(d)
+        parts.append(f"pipe:{int(d['pipe_blocks'])}blk"
+                     f"/stage:{d.get('pipe_stage_s', 0.0) * 1e3:.1f}ms"
+                     f"/drain:{d.get('pipe_drain_s', 0.0) * 1e3:.1f}ms"
+                     f"/overlap:{overlap:.2f}")
+    return ", ".join(parts)
+
+
+def explain_analyze_text(p: PhysicalPlan, qobs, depth: int = 0,
+                         out: Optional[List[list]] = None) -> List[list]:
+    """The four EXPLAIN columns plus actRows / execution info / device
+    info from the per-operator RuntimeStats collected while the
+    statement ran (``qobs`` = the statement's obs scope; operators the
+    executor tree never built — e.g. inside a fused devpipe program —
+    render with blank analyze cells)."""
+    if out is None:
+        out = []
+    name = p.op_name()
+    if getattr(p, "use_tpu", False):
+        name += "(TPU)"
+    st = qobs.op_stats_for(p) if qobs is not None else None
+    act = str(st.act_rows) if st is not None else ""
+    einfo = _exec_info(st) if st is not None else ""
+    dinfo = _device_info(st) if st is not None else ""
+    out.append(["  " * depth + name, _est_rows(p), act, _task(p),
+                einfo, dinfo, _info(p)])
+    if isinstance(p, PhysicalTableReader):
+        out.append(["  " * (depth + 1) + "TableScan",
+                    _est_rows(p.scan) or _est_rows(p), "", "cop", "", "",
+                    f"table:{p.scan.alias}"])
+    for c in p.children:
+        explain_analyze_text(c, qobs, depth + 1, out)
     return out

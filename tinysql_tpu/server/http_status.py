@@ -1,6 +1,9 @@
 """Status HTTP endpoint (reference: server/http_status.go:32-99 — index
 page, /status JSON, pprof routes; pprof is Go-specific, the analogue here
-is /debug/threads).
+is /debug/threads) plus the observability surfaces: Prometheus-text
+``/metrics`` (obs/metrics.py), ``/debug/trace`` (the last N query traces
+as JSON, chrome://tracing-loadable per entry), and ``/debug/slowlog``
+(recent structured slow-query records).
 """
 from __future__ import annotations
 
@@ -10,6 +13,7 @@ import threading
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 
 def _make_handler(server_ref):
@@ -27,7 +31,28 @@ def _make_handler(server_ref):
 
         def do_GET(self):
             srv = server_ref()
-            if self.path == "/status":
+            parsed = urlparse(self.path)
+            if parsed.path == "/metrics":
+                from ..obs.metrics import render_prometheus
+                self._send(200, render_prometheus().encode(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+                return
+            if parsed.path == "/debug/trace":
+                from ..obs.trace import recent_traces
+                qs = parse_qs(parsed.query)
+                try:
+                    n = int(qs.get("n", ["0"])[0])
+                except ValueError:
+                    n = 0
+                n = n if n > 0 else None  # last-N only; junk = everything
+                self._send(200, json.dumps(
+                    recent_traces(n), default=str).encode())
+                return
+            if parsed.path == "/debug/slowlog":
+                from ..obs.slowlog import recent
+                self._send(200, json.dumps(recent(), default=str).encode())
+                return
+            if parsed.path == "/status":
                 from ..server.protocol import SERVER_VERSION
                 body = json.dumps({
                     "version": SERVER_VERSION,
@@ -37,16 +62,19 @@ def _make_handler(server_ref):
                         if getattr(c, "tls", False)) if srv else 0,
                 }).encode()
                 self._send(200, body)
-            elif self.path == "/debug/threads":
+            elif parsed.path == "/debug/threads":
                 out = []
                 for tid, frame in sys._current_frames().items():
                     out.append(f"--- thread {tid} ---")
                     out.extend(traceback.format_stack(frame))
                 self._send(200, "\n".join(out).encode(),
                            "text/plain; charset=utf-8")
-            elif self.path == "/":
+            elif parsed.path == "/":
                 self._send(200, b"<h1>tinysql-tpu status</h1>"
                            b'<a href="/status">status</a> '
+                           b'<a href="/metrics">metrics</a> '
+                           b'<a href="/debug/trace">traces</a> '
+                           b'<a href="/debug/slowlog">slowlog</a> '
                            b'<a href="/debug/threads">threads</a>',
                            "text/html")
             else:

@@ -8,6 +8,7 @@ sysvar get/set :464-523), executor/compiler.go, executor/adapter.go
 """
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -73,6 +74,10 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # placed plan's device invariants before execution (analysis/
     # plan_device.py) and fail the statement on violation
     "tidb_qlint_verify": 0,
+    # slow-query log threshold in MILLISECONDS (reference:
+    # tidb_slow_log_threshold, default 300): statements whose exec wall
+    # exceeds it emit a structured JSONL record (obs/slowlog.py)
+    "tidb_slow_log_threshold": 300,
     "sql_mode": "STRICT_TRANS_TABLES",
     "max_execution_time": 0,
 }
@@ -95,7 +100,7 @@ class SessionError(Exception):
     pass
 
 
-SLOW_QUERY_THRESHOLD_MS = 300.0  # reference: logutil slow-query threshold
+SLOW_QUERY_THRESHOLD_MS = 300.0  # fallback when the sysvar is unset/bad
 
 
 class Session:
@@ -128,8 +133,13 @@ class Session:
         # (reference: StatementContext warnings, SHOW WARNINGS/ERRORS)
         self.last_warnings: List[tuple] = []
         # per-statement phase timings (reference: session.go DurationParse
-        # :590 / DurationCompile :612 + slow-query logging)
+        # :590 / DurationCompile :612 + slow-query logging).  parse_s is
+        # the per-BATCH parse wall (reported once); "statements" carries
+        # the per-statement phase list
         self.last_query_info: Dict[str, float] = {}
+        # the last statement's observability scope (obs/context.QueryObs):
+        # per-query device counters, per-operator RuntimeStats, span trace
+        self.last_query_stats = None
 
     def _globals(self) -> Dict[str, Datum]:
         g = getattr(self.storage, "_global_vars", None)
@@ -220,28 +230,106 @@ class Session:
 
     # ---- entry -----------------------------------------------------------
     def execute(self, sql: str) -> List[Optional[ResultSet]]:
+        from ..obs import context as obs_context
         t0 = time.perf_counter()
         stmts = parse(sql)
         t_parse = time.perf_counter() - t0
         out = []
-        for s in stmts:
-            t1 = time.perf_counter()
-            self._plan_s = 0.0
-            out.append(self._execute_stmt(s))
-            t_exec = time.perf_counter() - t1
-            self.last_query_info = {
-                "parse_s": t_parse / max(len(stmts), 1),
-                "plan_s": self._plan_s,
-                "exec_s": t_exec,
-                "total_s": t_parse / max(len(stmts), 1) + t_exec,
-            }
-            total_ms = self.last_query_info["total_s"] * 1e3
-            if total_ms > SLOW_QUERY_THRESHOLD_MS:
-                import logging
-                logging.getLogger("tinysql_tpu.slowlog").warning(
-                    "slow query (%.0fms): %s", total_ms,
-                    sql[:200].replace("\n", " "))
+        stmt_infos: List[Dict[str, float]] = []
+        try:
+            for i, s in enumerate(stmts):
+                label = sql if len(stmts) == 1 else \
+                    f"{sql[:200]} [stmt {i + 1}/{len(stmts)}]"
+                qobs = obs_context.QueryObs(sql=label)
+                if i == 0:
+                    # TRUE per-batch parse wall, reported ONCE — not
+                    # amortized into every statement and re-added to each
+                    # statement's total_s
+                    qobs.tracer.add_complete(
+                        "parse", t0, t_parse,
+                        args={"statements": len(stmts)})
+                tok = obs_context.activate(qobs)
+                self.last_query_stats = qobs
+                t1 = time.perf_counter()
+                self._plan_s = 0.0
+                err = True
+                try:
+                    with obs_context.span("execute",
+                                          kind=type(s).__name__):
+                        out.append(self._execute_stmt(s))
+                    err = False
+                finally:
+                    obs_context.deactivate(tok)
+                    t_exec = time.perf_counter() - t1
+                    parse_share = t_parse if i == 0 else 0.0
+                    info = {"parse_s": parse_share,
+                            "plan_s": self._plan_s,
+                            "exec_s": t_exec,
+                            "total_s": parse_share + t_exec}
+                    stmt_infos.append(info)
+                    qobs.info = info
+                    self._finish_obs(s, qobs, info, err)
+        finally:
+            if stmt_infos:
+                # batch scope throughout, so the fields ADD UP: total =
+                # parse + sum(exec); plan is inside exec.  Per-statement
+                # phases live in the "statements" list
+                self.last_query_info = {
+                    "parse_s": t_parse,
+                    "plan_s": sum(x["plan_s"] for x in stmt_infos),
+                    "exec_s": sum(x["exec_s"] for x in stmt_infos),
+                    "total_s": t_parse + sum(x["exec_s"]
+                                             for x in stmt_infos),
+                    "statements": stmt_infos,
+                }
         return out
+
+    def _finish_obs(self, stmt: ast.StmtNode, qobs, info: Dict[str, float],
+                    err: bool) -> None:
+        """Post-statement observability fan-out: query metrics, the trace
+        ring (/debug/trace), the structured slow-query log, and the
+        bucket-prewarm feedback file.  Never raises."""
+        from ..obs import metrics as obs_metrics
+        from ..obs import slowlog as obs_slowlog
+        from ..obs.feedback import maybe_emit
+        from ..obs.trace import publish_trace
+        try:
+            kind = type(stmt).__name__.replace("Stmt", "").lower()
+            thr = SLOW_QUERY_THRESHOLD_MS
+            try:
+                thr = float(self.get_sysvar("tidb_slow_log_threshold"))
+            except (TypeError, ValueError):
+                pass
+            total_ms = info["total_s"] * 1e3
+            # classify on the statement's OWN exec wall: the batch parse
+            # time rides statement 0's total_s for reporting, but must
+            # not tip statement 0 over the slow threshold on behalf of
+            # the whole batch
+            slow = info["exec_s"] * 1e3 > thr
+            obs_metrics.observe_query(kind, info["exec_s"], slow=slow,
+                                      error=err)
+            # spans only: Chrome trace events derive from them on demand
+            # (session.last_trace, tools/trace2json.py) — storing both
+            # would double ring memory and /debug/trace payloads.
+            # Bookkeeping statements (SET/USE/txn control) stay out of
+            # the bounded ring: bench-style clients interleave them with
+            # every query and would evict the traces /debug/trace is for
+            if not isinstance(stmt, (ast.SetStmt, ast.UseStmt,
+                                     ast.BeginStmt, ast.CommitStmt,
+                                     ast.RollbackStmt, ast.EmptyStmt)):
+                publish_trace({
+                    "sql": qobs.sql[:512], "ts": qobs.started_at,
+                    "total_ms": round(total_ms, 3), "error": err,
+                    "spans": qobs.tracer.spans(),
+                })
+            if slow:
+                obs_slowlog.log_slow(
+                    obs_slowlog.build_record(qobs.sql, info, qobs))
+            if not err:
+                maybe_emit(qobs)
+        except Exception:
+            logging.getLogger("tinysql_tpu").warning(
+                "observability fan-out failed", exc_info=True)
 
     def query(self, sql: str) -> ResultSet:
         out = [r for r in self.execute(sql) if r is not None]
@@ -331,14 +419,23 @@ class Session:
 
     # ---- SELECT ---------------------------------------------------------
     def _exec_select(self, stmt: ast.SelectStmt) -> ResultSet:
+        from ..obs import context as obs_context
+        from ..obs.runtime_stats import instrument_tree
+        qobs = obs_context.current()
         t0 = time.perf_counter()
         builder = PlanBuilder(self)
-        logical = builder.build_select(stmt)
+        with obs_context.span("plan"):
+            logical = builder.build_select(stmt)
         columns = [c.name for c in logical.schema.columns]
         use_tpu = bool(self.get_sysvar("tidb_use_tpu"))
-        phys = self._optimize(logical, use_tpu)
+        with obs_context.span("place", tpu=use_tpu):
+            phys = self._optimize(logical, use_tpu)
         t_plan = time.perf_counter() - t0
+        if qobs is not None:
+            from ..planner.explain import plan_digest
+            qobs.plan_digest = plan_digest(phys)
         ex = build_executor(phys, use_tpu=use_tpu)
+        instrument_tree(ex, qobs)
         ex.open(ExecContext(self.get_txn(), self.sysvars,
                             self.infoschema(), self.storage))
         try:
@@ -602,12 +699,47 @@ class Session:
     def _exec_explain(self, stmt: ast.ExplainStmt) -> ResultSet:
         if not isinstance(stmt.stmt, ast.SelectStmt):
             raise SessionError("EXPLAIN supports SELECT only for now")
+        from ..obs import context as obs_context
         builder = PlanBuilder(self)
-        phys = self._optimize(builder.build_select(stmt.stmt),
-                              bool(self.get_sysvar("tidb_use_tpu")))
+        use_tpu = bool(self.get_sysvar("tidb_use_tpu"))
+        with obs_context.span("plan"):
+            logical = builder.build_select(stmt.stmt)
+        with obs_context.span("place", tpu=use_tpu):
+            phys = self._optimize(logical, use_tpu)
+        if stmt.analyze:
+            # EXPLAIN ANALYZE (reference: explain.go with RuntimeStats):
+            # run the statement under the active obs scope, then render
+            # the plan annotated with actRows / wall time / device
+            # counters next to the estimates
+            from ..obs.runtime_stats import instrument_tree
+            from ..planner.explain import (EXPLAIN_ANALYZE_COLUMNS,
+                                           explain_analyze_text,
+                                           plan_digest)
+            qobs = obs_context.current()
+            if qobs is not None:
+                qobs.plan_digest = plan_digest(phys)
+            ex = build_executor(phys, use_tpu=use_tpu)
+            instrument_tree(ex, qobs)
+            ex.open(ExecContext(self.get_txn(), self.sysvars,
+                                self.infoschema(), self.storage))
+            try:
+                ex.drain()
+            finally:
+                ex.close()
+            return ResultSet(list(EXPLAIN_ANALYZE_COLUMNS),
+                             explain_analyze_text(phys, qobs))
         from ..planner.explain import explain_text
         rows = explain_text(phys)
         return ResultSet(["id", "estRows", "task", "operator info"], rows)
+
+    @property
+    def last_trace(self):
+        """Chrome trace-event JSON of the last statement (load in
+        chrome://tracing / Perfetto; tools/trace2json.py exports the
+        ring)."""
+        q = self.last_query_stats
+        return q.tracer.chrome_trace(label=q.sql[:200]) \
+            if q is not None else None
 
     # ---- ANALYZE (stats phase wires this up) ----------------------------
     def _exec_analyze(self, stmt: ast.AnalyzeTableStmt) -> None:

@@ -68,6 +68,16 @@ def run_locks(paths):
     return diags
 
 
+def run_obs(paths):
+    from tinysql_tpu.analysis import gather_sources, lint_obs_discipline
+    diags = []
+    for p in paths:
+        for sf in gather_sources(p):
+            diags.extend(sf.check_suppression_syntax())
+            diags.extend(lint_obs_discipline(sf))
+    return diags
+
+
 def run_plans(fuzz_n=None):
     _force_cpu_backend()
     from tinysql_tpu.analysis.plan_device import check_corpus
@@ -81,8 +91,8 @@ def main(argv=None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="run all passes over their default scopes")
     ap.add_argument("--pass", dest="passes", action="append",
-                    choices=["trace", "locks", "plans", "all"],
-                    help="which pass(es) to run (default: trace+locks "
+                    choices=["trace", "locks", "obs", "plans", "all"],
+                    help="which pass(es) to run (default: trace+locks+obs "
                          "over paths; all under --strict)")
     ap.add_argument("--rules", action="store_true",
                     help="print the rule catalogue and exit")
@@ -101,9 +111,9 @@ def main(argv=None) -> int:
 
     passes = set(args.passes or [])
     if args.strict or "all" in passes:
-        passes = {"trace", "locks", "plans"}
+        passes = {"trace", "locks", "obs", "plans"}
     elif not passes:
-        passes = {"trace", "locks"}
+        passes = {"trace", "locks", "obs"}
 
     pkg = os.path.join(REPO_ROOT, "tinysql_tpu")
     paths = args.paths or [pkg]
@@ -115,6 +125,8 @@ def main(argv=None) -> int:
                       else [os.path.join(REPO_ROOT, p)
                             for p in LOCK_SCOPE])
         diags.extend(run_locks(lock_paths))
+    if "obs" in passes:
+        diags.extend(run_obs(paths))
     if "plans" in passes:
         diags.extend(run_plans(args.fuzz_n))
 

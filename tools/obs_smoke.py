@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Observability smoke (the CI ``obs-smoke`` job).
+
+End-to-end assertion chain over a tiny TPC-H load:
+
+1. run Q6 on the device tier — the per-query scope must report nonzero
+   program dispatches and `bench.py`'s transfer invariant must hold;
+2. ``EXPLAIN ANALYZE`` Q6 and Q1 — the ROOT operator's actRows must
+   equal the executed result cardinality;
+3. a ``StatusServer`` must serve ``/metrics`` exposing a nonzero
+   ``tinysql_dispatches_total`` and a ``/debug/trace`` ring containing
+   the statements above.
+
+Exit 0 on success; prints one line per check.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from urllib.request import urlopen
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    print(f"[obs-smoke] {'ok' if ok else 'FAIL'}: {name}"
+          f"{' — ' + detail if detail else ''}")
+    if not ok:
+        sys.exit(1)
+
+
+def main() -> int:
+    from tinysql_tpu.bench import tpch
+    from tinysql_tpu.server.http_status import StatusServer
+    from tinysql_tpu.session.session import new_session
+
+    sf = float(os.environ.get("TPCH_SF", "0.01"))
+    s = new_session()
+    tpch.load(s, sf=sf, data=tpch.generate(sf))
+    s.execute("set @@tidb_use_tpu = 1")
+    s.execute("set @@tidb_tpu_min_rows = 0")
+
+    # 1. Q6 on the device tier: per-query counters
+    q6 = tpch.QUERIES["Q6"]
+    rows = s.query(q6).rows
+    totals = s.last_query_stats.device_totals()
+    check("Q6 executed", len(rows) == 1, f"{len(rows)} rows")
+    check("per-query dispatches nonzero",
+          totals.get("dispatches", 0) > 0, str(totals))
+    check("transfer invariant d2h <= dispatches+1",
+          totals.get("d2h_transfers", 0)
+          <= totals.get("dispatches", 0) + 1, str(totals))
+
+    # 2. EXPLAIN ANALYZE actRows == executed cardinality
+    for name in ("Q6", "Q1"):
+        sql = tpch.QUERIES[name]
+        n = len(s.query(sql).rows)
+        ra = s.query("explain analyze " + sql)
+        idx = ra.columns.index("actRows")
+        root_act = ra.rows[0][idx]
+        check(f"EXPLAIN ANALYZE {name} actRows == result rows",
+              str(root_act) == str(n), f"act={root_act} rows={n}")
+        devcol = ra.columns.index("device info")
+        check(f"EXPLAIN ANALYZE {name} shows device counters",
+              any("dispatches:" in str(r[devcol]) for r in ra.rows))
+
+    # 3. /metrics + /debug/trace round-trip
+    st = StatusServer(None, port=0)
+    st.start()
+    try:
+        with urlopen(f"http://127.0.0.1:{st.port}/metrics",
+                     timeout=10) as r:
+            text = r.read().decode()
+        val = 0.0
+        for line in text.splitlines():
+            if line.startswith("tinysql_dispatches_total"):
+                val = float(line.split()[-1])
+        check("/metrics tinysql_dispatches_total nonzero", val > 0,
+              f"value={val}")
+        with urlopen(f"http://127.0.0.1:{st.port}/debug/trace?n=4",
+                     timeout=10) as r:
+            traces = json.loads(r.read().decode())
+        check("/debug/trace returns spans",
+              bool(traces) and all(t.get("spans") for t in traces),
+              f"{len(traces)} entries")
+    finally:
+        st.close()
+    print("[obs-smoke] all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

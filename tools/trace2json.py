@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Export query traces as ONE chrome://tracing / Perfetto JSON file.
+
+Sources (first match wins):
+
+- ``--url http://HOST:PORT`` — pull ``/debug/trace`` from a live status
+  server (server/http_status.py);
+- ``--slowlog FILE`` — convert a structured slow-query JSONL file
+  (obs/slowlog.py records carry phase timings; spans are synthesized
+  from parse/plan/exec walls when the record has no span list);
+- ``--trace FILE`` — a JSON file holding the ``/debug/trace`` payload
+  (or one entry of it) saved earlier.
+
+Each query becomes its own ``pid`` so chrome://tracing shows one track
+group per statement; span thread lanes are preserved.
+
+    python tools/trace2json.py --url http://127.0.0.1:10080 -o trace.json
+    # then: chrome://tracing -> Load -> trace.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tinysql_tpu.obs.trace import spans_to_events  # noqa: E402
+
+
+def _events_from_slowlog(rec: dict, pid: int) -> list:
+    """Synthesize parse -> plan -> exec spans from a slow-log record's
+    phase walls (records predating span capture, or trimmed ones)."""
+    label = rec.get("sql", "?")[:120]
+    events = [{"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+               "args": {"name": label}}]
+    t = 0.0
+    for phase in ("parse", "plan", "exec"):
+        dur_us = float(rec.get(f"{phase}_ms", 0.0)) * 1e3
+        events.append({"ph": "X", "pid": pid, "tid": 0, "name": phase,
+                       "cat": "query", "ts": t, "dur": dur_us,
+                       "args": {"plan_digest": rec.get("plan_digest")}})
+        if phase != "plan":  # plan is inside exec in the session's split
+            t += dur_us
+    return events
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", default="",
+                    help="status-server base URL to pull /debug/trace")
+    ap.add_argument("--slowlog", default="",
+                    help="structured slow-query JSONL (TINYSQL_SLOW_LOG)")
+    ap.add_argument("--trace", default="",
+                    help="saved /debug/trace JSON payload")
+    ap.add_argument("-o", "--out", default="trace.json")
+    ap.add_argument("-n", type=int, default=0,
+                    help="keep only the last N queries")
+    args = ap.parse_args(argv)
+
+    entries: List[dict] = []
+    if args.url:
+        from urllib.request import urlopen
+        with urlopen(args.url.rstrip("/") + "/debug/trace",
+                     timeout=10) as r:
+            entries = json.loads(r.read().decode())
+    elif args.slowlog:
+        with open(args.slowlog, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    entries.append(json.loads(line))
+    elif args.trace:
+        with open(args.trace, "r", encoding="utf-8") as f:
+            payload = json.load(f)
+        entries = payload if isinstance(payload, list) else [payload]
+    else:
+        ap.error("one of --url / --slowlog / --trace is required")
+
+    if args.n:
+        entries = entries[-args.n:]
+    events = []
+    for pid, rec in enumerate(entries, start=1):
+        label = f"{pid}: {rec.get('sql', '?')[:120]}"
+        spans = rec.get("spans")
+        if spans:
+            events.extend(spans_to_events(spans, pid=pid, label=label))
+        else:
+            events.extend(_events_from_slowlog(rec, pid))
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump({"traceEvents": events}, f)
+    print(f"wrote {len(events)} events from {len(entries)} queries "
+          f"to {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

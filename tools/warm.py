@@ -49,10 +49,13 @@ def plan_buckets(session, sql: str) -> set:
         session._pinned_is = None
 
 
-def warm_queries(session, queries: dict, verbose: bool = True) -> dict:
+def warm_queries(session, queries: dict, verbose: bool = True,
+                 stats_path: str = "") -> dict:
     """Warm every (name -> sql) entry against an already-loaded session:
-    AOT-compile the plan-derived buckets, then execute each query once.
-    Returns a summary dict for the bench JSON."""
+    AOT-compile the plan-derived buckets (plus observed buckets from a
+    RuntimeStats feedback file when ``stats_path`` names one), then
+    execute each query once.  Returns a summary dict for the bench
+    JSON."""
     from tinysql_tpu.ops import kernels
     t0 = time.time()
     snap = kernels.stats_snapshot()
@@ -62,6 +65,16 @@ def warm_queries(session, queries: dict, verbose: bool = True) -> dict:
         buckets |= got
         if verbose:
             print(f"[warm] {name}: buckets {sorted(got)}", file=sys.stderr)
+    observed = set()
+    if stats_path:
+        # measured-runtime feedback loop: buckets that real executions
+        # hit refine (extend) the estimate-derived prewarm set
+        from tinysql_tpu.planner.buckets import merge_feedback
+        observed = merge_feedback(stats_path)
+        buckets |= observed
+        if verbose:
+            print(f"[warm] feedback {stats_path}: buckets "
+                  f"{sorted(observed)}", file=sys.stderr)
     aot = 0
     for nb in sorted(buckets):
         aot += kernels.prewarm_bucket(nb)
@@ -79,6 +92,7 @@ def warm_queries(session, queries: dict, verbose: bool = True) -> dict:
     delta = kernels.stats_delta(snap)
     out = {
         "buckets": sorted(buckets),
+        "observed_buckets": sorted(observed),
         "aot_programs": aot,
         "programs_traced": delta.get("progcache_misses", 0),
         "programs_reused": delta.get("progcache_hits", 0),
@@ -99,6 +113,10 @@ def main() -> int:
     ap.add_argument("--cache-dir", default="",
                     help="persistent compile-cache directory "
                          "(tidb_compile_cache_dir)")
+    ap.add_argument("--from-stats", default="", dest="from_stats",
+                    help="RuntimeStats feedback JSONL (written when "
+                         "TINYSQL_STATS_FEEDBACK is set): observed "
+                         "buckets join the estimate-derived prewarm set")
     args = ap.parse_args()
 
     # NO backend pinning here: warming must compile for the backend the
@@ -115,7 +133,8 @@ def main() -> int:
     names = [n.strip() for n in args.queries.split(",") if n.strip()] \
         or list(tpch.QUERIES)
     queries = {n: tpch.QUERIES[n] for n in names}
-    print(json.dumps(warm_queries(s, queries)))
+    print(json.dumps(warm_queries(s, queries,
+                                  stats_path=args.from_stats)))
     return 0
 
 
