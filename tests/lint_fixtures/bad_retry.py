@@ -1,0 +1,33 @@
+"""FP5xx fixture: raw sleeps in a retry ladder + unregistered failpoint.
+
+Every line marked FP5xx below must fire its rule; the clean patterns at
+the bottom must stay silent.  Never imported — parsed by test_lint.py.
+"""
+import time
+
+from tinysql_tpu import fail
+from tinysql_tpu.utils import failpoint
+
+
+def naive_retry(task):
+    for _ in range(5):
+        try:
+            return task()
+        except Exception:
+            time.sleep(0.1)                        # FP501
+    failpoint.inject("notInTheCatalogue")          # FP502
+    fail.eval_point("alsoUnregistered")            # FP502
+
+
+def clean_patterns(boer, bo, task):
+    # registered names are fine, through either module alias
+    failpoint.inject("copTaskError")
+    fail.inject("commitError")
+    # dynamic names are out of static scope (runtime arm() still rejects)
+    name = "copTaskError"
+    failpoint.inject(name)
+    # sleeping through the Backoffer is THE sanctioned wait
+    try:
+        return task()
+    except Exception as e:
+        boer.backoff(bo.BO_RPC, e)

@@ -1,16 +1,48 @@
-"""Cluster chaos during live traffic: store delays, region splits racing
-concurrent readers, and parallel writers resolving 2PC conflicts —
-the reference's mocktikv chaos surface (cluster.go StopStore/delay,
-region-epoch retries) driven from real SQL.
+"""Chaos suite: every registered failpoint, statement interruption,
+runtime device-loss degradation, and memory quotas.
+
+Three layers:
+
+1. the original live-traffic chaos (store delays, splits racing readers,
+   parallel 2PC writers) — mocktikv's chaos surface driven from SQL;
+2. the FULL failpoint catalogue matrix: ``CHAOS`` maps every name in
+   ``fail.catalogue()`` to a driver that arms it and asserts clean
+   retry/degradation or a clean TYPED error — never a hang, never a
+   half-committed txn (a coverage test fails if a failpoint is ever
+   registered without a driver here);
+3. the runtime capabilities: KILL / max_execution_time (MySQL 1317 /
+   3024), device-loss CPU re-execution, tidb_mem_quota_query (8175).
+
+``SLEEP_SCALE = 0`` runs every retry ladder without wall-clock sleeps;
+``DEFAULT_LOCK_TTL_MS = 1`` lets readers resolve a crashed committer's
+leftover locks immediately instead of waiting out the TTL.
 """
 import threading
 import time
 
 import pytest
 
+from tinysql_tpu import fail
 from tinysql_tpu.codec import tablecodec
 from tinysql_tpu.columnar.store import store_of
-from tinysql_tpu.session.session import Session, new_session
+from tinysql_tpu.kv.errors import (BackoffExceeded, KVError, RegionError,
+                                   UndeterminedError)
+from tinysql_tpu.ops import degrade
+from tinysql_tpu.session.session import Session, SessionError, new_session
+from tinysql_tpu.utils.interrupt import QueryKilled, QueryTimeout
+from tinysql_tpu.utils.memory import MemQuotaExceeded
+
+
+@pytest.fixture(autouse=True)
+def _chaos_env(monkeypatch):
+    """Fast ladders + fast lock resolution + clean slate per test."""
+    monkeypatch.setattr("tinysql_tpu.kv.backoff.SLEEP_SCALE", 0)
+    monkeypatch.setattr("tinysql_tpu.kv.txn.DEFAULT_LOCK_TTL_MS", 1)
+    fail.disarm_all()
+    degrade.reset()
+    yield
+    fail.disarm_all()
+    degrade.reset()
 
 
 @pytest.fixture
@@ -29,6 +61,10 @@ def tk():
     store_of(s.storage).invalidate(info.id)
     return s, info
 
+
+# =========================================================================
+# layer 1: live-traffic chaos (original suite)
+# =========================================================================
 
 def test_query_completes_under_store_delay(tk):
     s, _ = tk
@@ -97,3 +133,662 @@ def test_write_conflict_between_explicit_txns(tk):
     with pytest.raises(Exception):
         s2.execute("commit")  # conflicting write must not silently win
     assert s.query("select count(*) from t where a = 1").rows == [[0]]
+
+
+# =========================================================================
+# layer 2: the failpoint-catalogue matrix
+# =========================================================================
+
+#: per-failpoint chaos drivers; the coverage test below requires exactly
+#: one per registered catalogue name
+CHAOS = {}
+
+
+def chaos(name):
+    def deco(fn):
+        CHAOS[name] = fn
+        return fn
+    return deco
+
+
+def _read_ok(s):
+    rows = s.query("select b, count(*), sum(a) from t "
+                   "where a <= 500 group by b order by b").rows
+    assert len(rows) == 7 and sum(r[1] for r in rows) == 500
+
+
+@chaos("rpcServerBusy")
+def _busy(tk):
+    s, _ = tk
+    with fail.armed("rpcServerBusy", times=3):
+        _read_ok(s)  # BO_REGION_MISS ladder absorbs the busy spikes
+    # exhaustion: a permanently-busy store must end in the typed budget
+    # error, not a hang
+    with fail.armed("rpcServerBusy"):
+        with pytest.raises(BackoffExceeded):
+            s.query("select count(*) from t").rows
+
+
+def _settle():
+    """Let the 1ms chaos lock TTL lapse in REAL time so the next reader
+    resolves a crashed committer's leftovers instead of backing off
+    against a still-live lock."""
+    time.sleep(0.01)
+
+
+# the commit/prewrite drivers use DELETE, not INSERT: an insert's autoid
+# rebase runs its own meta txn with a RETRY loop that (correctly!)
+# absorbs an injected commit fault — which would consume the armed
+# failpoint before the user txn ever committed
+
+@chaos("prewriteError")
+def _prewrite(tk):
+    s, _ = tk
+    with fail.armed("prewriteError", exc=IOError("prewrite down"),
+                    times=1):
+        with pytest.raises(IOError):
+            s.execute("delete from t where a = 3")
+    # cleanup ran: the row survives, no stuck lock, key still writable
+    _settle()
+    assert s.query("select count(*) from t where a = 3").rows == [[1]]
+    s.execute("delete from t where a = 3")
+    s.execute("insert into t values (3, 3)")
+
+
+@chaos("commitError")
+def _commit(tk):
+    s, _ = tk
+    with fail.armed("commitError", exc=IOError("commit rpc down"),
+                    times=1):
+        with pytest.raises(UndeterminedError):
+            s.execute("delete from t where a = 2")
+    # the commit RPC never reached MVCC: the next reader resolves the
+    # expired primary lock to a rollback — not half-committed
+    _settle()
+    assert s.query("select count(*) from t where a = 2").rows == [[1]]
+
+
+@chaos("commitPrimaryError")
+def _commit_primary(tk):
+    s, _ = tk
+    with fail.armed("commitPrimaryError", exc=IOError("net down"),
+                    times=1):
+        with pytest.raises(UndeterminedError):
+            s.execute("delete from t where a = 5")
+    _settle()
+    assert s.query("select count(*) from t where a = 5").rows == [[1]]
+
+
+@chaos("commitSecondaryError")
+def _commit_secondary(tk):
+    s, _ = tk
+    # rows 50 and 400 live in different regions (fixture splits at
+    # 125/250/375), so the txn has a real secondary batch
+    with fail.armed("commitSecondaryError", exc=IOError("flaky"),
+                    times=1):
+        s.execute("delete from t where a = 50 or a = 400")
+    # durable once the primary committed: the reader resolves the
+    # leftover secondary lock THROUGH the primary to commit the delete
+    _settle()
+    assert s.query("select count(*) from t "
+                   "where a = 50 or a = 400").rows == [[0]]
+    s.execute("insert into t values (50, 1), (400, 1)")
+
+
+@chaos("beforeCommit")
+def _before_commit(tk):
+    s, _ = tk
+    # panic between prewrite and commit = the classic Percolator crashed
+    # committer; BaseException so 'except Exception' recovery can't hide it
+    with fail.armed("beforeCommit", panic=True, times=1):
+        with pytest.raises(fail.Panic):
+            s.execute("delete from t where a = 7")
+    _settle()
+    s2 = Session(s.storage, current_db="c")
+    s2.execute("set @@tidb_use_tpu = 0")
+    # never committed: the row survives, and the key is writable again
+    assert s2.query("select count(*) from t where a = 7").rows == [[1]]
+    s2.execute("delete from t where a = 7")
+    s2.execute("insert into t values (7, 0)")
+
+
+@chaos("copTaskError")
+def _cop(tk):
+    s, _ = tk
+    with fail.armed("copTaskError", exc=RegionError("injected"), times=2):
+        _read_ok(s)  # region errors re-split and retry
+    with fail.armed("copTaskError", exc=ValueError("cop boom"), times=1):
+        with pytest.raises(ValueError):
+            s.query("select b, count(*) from t group by b").rows
+    # a persistently failing region exhausts ONE shared budget across
+    # re-split recursion: the typed BackoffExceeded, not RecursionError
+    with fail.armed("copTaskError", exc=RegionError("flapping")):
+        with pytest.raises(BackoffExceeded):
+            s.query("select b, count(*) from t group by b").rows
+    _read_ok(s)  # pool drained cleanly, next scan fine
+
+
+@chaos("devpipeStageError")
+def _devpipe(tk):
+    from tinysql_tpu.executor.devpipe import BlockPipeline
+    with fail.armed("devpipeStageError", exc=RuntimeError("stage died"),
+                    times=1):
+        pipe = BlockPipeline(lambda x: x * 2, [1, 2, 3], depth=2)
+        with pytest.raises(RuntimeError, match="stage died"):
+            list(pipe)
+    # a fresh pipeline over the same items works
+    assert list(BlockPipeline(lambda x: x * 2, [1, 2, 3], depth=2)) \
+        == [2, 4, 6]
+
+
+def _tpu_session(s):
+    s.execute("set @@tidb_use_tpu = 1")
+    s.execute("set @@tidb_tpu_min_rows = 1")
+    s.execute("set @@tidb_device_cooldown = 0")
+
+
+@chaos("kernelDispatchError")
+def _dispatch(tk):
+    s, _ = tk
+    want = s.query("select b, sum(a) from t group by b order by b").rows
+    _tpu_session(s)
+    with fail.armed("kernelDispatchError",
+                    exc=degrade.DeviceLost("tunnel dropped")):
+        got = s.query("select b, sum(a) from t group by b order by b").rows
+    assert got == want  # transparent CPU re-execution, same answer
+    assert degrade.snapshot()["degraded_statements_total"] == 1
+
+
+@chaos("kernelD2HError")
+def _d2h(tk):
+    s, _ = tk
+    want = s.query("select sum(a), count(*) from t").rows
+    _tpu_session(s)
+    with fail.armed("kernelD2HError",
+                    exc=degrade.DeviceLost("link dropped"), times=1):
+        got = s.query("select sum(a), count(*) from t").rows
+    assert got == want
+    assert degrade.snapshot()["device_loss_total"] == 1
+
+
+@chaos("backendProbeFail")
+def _probe(tk, monkeypatch=None):
+    from tinysql_tpu.ops import kernels
+    import jax
+    probed = kernels._probed
+    prev_plats = jax.config.jax_platforms
+    try:
+        kernels._probed = False
+        with fail.armed("backendProbeFail"):
+            kernels.ensure_live_backend(jax)  # must return, never hang
+        assert str(jax.config.jax_platforms) == "cpu"
+        # error actions (the only kind a spec string can arm besides
+        # return) mean "probe failed" too: pin cpu, never propagate
+        kernels._probed = False
+        with fail.armed("backendProbeFail", exc=RuntimeError("probe x")):
+            kernels.ensure_live_backend(jax)
+        assert str(jax.config.jax_platforms) == "cpu"
+    finally:
+        kernels._probed = probed
+        # un-pin: on a device-backed dev box the rest of the session
+        # must not silently run on cpu
+        jax.config.update("jax_platforms", prev_plats)
+
+
+@chaos("ddlStepError")
+def _ddl_step(tk):
+    s, _ = tk
+    # KVError steps are retried until the job converges
+    with fail.armed("ddlStepError", exc=KVError("step hiccup"), times=2):
+        s.execute("create table chaos_ddl (x int primary key)")
+    assert s.query("show tables like 'chaos_ddl'").rows
+    # non-retryable step failure cancels the job with a typed error...
+    with fail.armed("ddlStepError", exc=RuntimeError("broken step"),
+                    times=1):
+        with pytest.raises(Exception, match="broken step"):
+            s.execute("create table chaos_ddl2 (x int primary key)")
+    # ...and the queue is not wedged: the same DDL succeeds afterwards
+    s.execute("create table chaos_ddl2 (x int primary key)")
+
+
+@chaos("reorgBatchError")
+def _reorg(tk):
+    s, _ = tk
+    with fail.armed("reorgBatchError", exc=KVError("reorg hiccup"),
+                    times=2):
+        s.execute("create index idx_chaos_b on t (b)")
+    assert s.query("admin check table t").rows == [["OK"]]
+    rows = s.query("select count(*) from t where b = 3").rows
+    assert rows == [[sum(1 for i in range(1, 501) if i % 7 == 3)]]
+
+
+@chaos("execSlowNext")
+def _slow_next(tk):
+    s, _ = tk
+    s.execute("set @@tidb_max_chunk_size = 64")
+    with fail.armed("execSlowNext", sleep=0.002):
+        assert s.query("select count(*) from t").rows == [[500]]
+
+
+def test_chaos_covers_entire_catalogue():
+    """A failpoint registered without a chaos driver is a seam nobody
+    proved degrades cleanly — fail loudly right here."""
+    assert set(CHAOS) == set(fail.catalogue()), (
+        set(CHAOS) ^ set(fail.catalogue()))
+
+
+@pytest.mark.parametrize("name", sorted(fail.catalogue()))
+def test_chaos_matrix(name, tk):
+    fail.reset_hits()
+    CHAOS[name](tk)
+    assert fail.hits().get(name, 0) >= 1, \
+        f"driver for {name} never actually fired the failpoint"
+    # post-fault health: reads AND writes still serve
+    s, _ = tk
+    _read_ok(s)
+    s.execute("insert into t values (20000, 0)")
+    assert s.query("select count(*) from t where a = 20000").rows == [[1]]
+
+
+# =========================================================================
+# layer 3a: statement interruption (KILL + max_execution_time)
+# =========================================================================
+
+def _slow_query(s, sql="select * from t", exc_box=None):
+    try:
+        s.query(sql)
+        exc_box.append(None)
+    except Exception as e:
+        exc_box.append(e)
+
+
+def test_kill_query_aborts_running_statement(tk):
+    s, _ = tk
+    s.execute("set @@tidb_max_chunk_size = 16")
+    box = []
+    with fail.armed("execSlowNext", sleep=0.02):
+        t = threading.Thread(target=_slow_query, args=(s,), kwargs={
+            "exc_box": box})
+        t.start()
+        time.sleep(0.1)
+        from tinysql_tpu.utils import interrupt
+        assert interrupt.kill(s.conn_id, query_only=True)
+        t.join(10)
+    assert not t.is_alive()
+    assert isinstance(box[0], QueryKilled)
+    assert box[0].mysql_code == 1317
+    assert s.query("select count(*) from t").rows == [[500]]  # healthy
+
+
+def test_kill_statement_from_second_session(tk):
+    s, _ = tk
+    s.execute("set @@tidb_max_chunk_size = 16")
+    s2 = Session(s.storage, current_db="c")
+    box = []
+    with fail.armed("execSlowNext", sleep=0.02):
+        t = threading.Thread(target=_slow_query, args=(s,), kwargs={
+            "exc_box": box})
+        t.start()
+        time.sleep(0.1)
+        s2.execute(f"kill query {s.conn_id}")
+        t.join(10)
+    assert isinstance(box[0], QueryKilled), box[0]
+
+
+def test_kill_unknown_thread_id(tk):
+    s, _ = tk
+    with pytest.raises(SessionError) as ei:
+        s.execute("kill query 999999999")
+    assert ei.value.mysql_code == 1094
+
+
+def test_plain_kill_marks_connection_dead(tk):
+    s, _ = tk
+    s2 = Session(s.storage, current_db="c")
+    s.execute(f"kill {s2.conn_id}")
+    assert s2.killed  # the server's command loop drops it after this
+
+
+def test_max_execution_time_expires_long_select(tk):
+    s, _ = tk
+    s.execute("set @@tidb_max_chunk_size = 16")
+    s.execute("set @@max_execution_time = 60")
+    with fail.armed("execSlowNext", sleep=0.02):
+        with pytest.raises(QueryTimeout) as ei:
+            s.query("select * from t")
+    assert ei.value.mysql_code == 3024
+    s.execute("set @@max_execution_time = 0")
+    with fail.armed("execSlowNext", sleep=0.02):
+        assert len(s.query("select * from t where a <= 32").rows) == 32
+
+
+def test_max_execution_time_applies_to_select_only(tk):
+    s, _ = tk
+    s.execute("set @@max_execution_time = 1")
+    time.sleep(0.005)
+    # writes and DDL are not under the SELECT deadline (MySQL semantics)
+    s.execute("insert into t values (21000, 0)")
+    s.execute("delete from t where a = 21000")
+
+
+def test_max_execution_time_rejected_at_set_time(tk):
+    s, _ = tk
+    for bad, code in [("'abc'", 1232), ("1.5", 1232), ("'++5'", 1232),
+                      ("'1.5'", 1232), ("-5", 1231)]:
+        with pytest.raises(SessionError) as ei:
+            s.execute(f"set @@max_execution_time = {bad}")
+        assert ei.value.mysql_code == code, bad
+    # the stored value is unchanged by the failed SETs
+    assert int(s.get_sysvar("max_execution_time")) == 0
+    s.execute("set @@max_execution_time = '250'")  # int-strings coerce
+    assert int(s.get_sysvar("max_execution_time")) == 250
+
+
+def test_kill_reaches_distsql_worker_pool(tk):
+    """A kill mid-scatter-gather propagates through the worker pool's
+    copied context and aborts the statement (workers observe the guard
+    between attempts/backoffs)."""
+    s, info = tk
+    # enough tasks x per-attempt sleep that the scan outlives the kill:
+    # 8 regions / 2 workers x 0.05s ≈ 0.2s of pool wall
+    for h in (60, 180, 320, 440, 470):
+        s.storage.cluster.split(tablecodec.encode_row_key(info.id, h))
+    s.storage.cache.invalidate_all()
+    s.execute("set @@tidb_distsql_scan_concurrency = 2")
+    box = []
+    with fail.armed("copTaskError", sleep=0.05):
+        t = threading.Thread(target=_slow_query,
+                             args=(s, "select b, count(*) from t group by b"),
+                             kwargs={"exc_box": box})
+        t.start()
+        time.sleep(0.06)
+        from tinysql_tpu.utils import interrupt
+        interrupt.kill(s.conn_id, query_only=True)
+        t.join(10)
+    assert not t.is_alive()
+    assert isinstance(box[0], QueryKilled), box[0]
+
+
+# =========================================================================
+# layer 3b: memory quota
+# =========================================================================
+
+def test_mem_quota_aborts_oversized_statement(tk):
+    s, _ = tk
+    s.execute("set @@tidb_mem_quota_query = 8192")
+    with pytest.raises(MemQuotaExceeded) as ei:
+        s.query("select * from t order by b")  # full sort materialization
+    assert ei.value.mysql_code == 8175
+    # statement aborted cleanly; lifting the quota restores service
+    s.execute("set @@tidb_mem_quota_query = 0")
+    assert len(s.query("select * from t order by b").rows) == 500
+
+
+def test_mem_quota_zero_is_unlimited(tk):
+    s, _ = tk
+    s.execute("set @@tidb_mem_quota_query = 0")
+    assert len(s.query("select * from t order by b").rows) == 500
+
+
+def test_mem_quota_rejects_bad_values(tk):
+    s, _ = tk
+    with pytest.raises(SessionError) as ei:
+        s.execute("set @@tidb_mem_quota_query = 'lots'")
+    assert ei.value.mysql_code == 1232
+
+
+def test_mem_quota_abort_counts_in_metrics(tk):
+    s, _ = tk
+    from tinysql_tpu.obs.metrics import render_prometheus
+    s.execute("set @@tidb_mem_quota_query = 8192")
+    with pytest.raises(MemQuotaExceeded):
+        s.query("select * from t order by b")
+    assert "tinysql_mem_quota_exceeded_total" in render_prometheus()
+
+
+# =========================================================================
+# layer 3c: device-loss degradation details
+# =========================================================================
+
+def test_device_loss_pins_cpu_for_cooldown(tk):
+    s, _ = tk
+    want = s.query("select sum(a) from t").rows
+    s.execute("set @@tidb_use_tpu = 1")
+    s.execute("set @@tidb_tpu_min_rows = 1")
+    s.execute("set @@tidb_device_cooldown = 600")
+    with fail.armed("kernelDispatchError",
+                    exc=degrade.DeviceLost("gone")):
+        assert s.query("select sum(a) from t").rows == want
+    assert degrade.cpu_pinned()
+    # while pinned, statements PLAN on cpu: no dispatches even though
+    # the failpoint is still armed (arming would fail any dispatch)
+    with fail.armed("kernelDispatchError",
+                    exc=degrade.DeviceLost("still gone")):
+        assert s.query("select sum(a) from t").rows == want
+    snap = degrade.snapshot()
+    assert snap["device_loss_total"] == 1  # the pinned run saw no loss
+    assert s.last_warnings == []
+
+
+def test_sysvar_armed_dispatch_fault_degrades_too(tk):
+    """Spec strings cannot name an exception class: an error() action on
+    the device-boundary failpoints must degrade exactly like a
+    programmatic DeviceLost."""
+    s, _ = tk
+    want = s.query("select sum(a) from t").rows
+    _tpu_session(s)
+    s.execute("set @@tidb_failpoints = 'kernelDispatchError=error(lost)'")
+    try:
+        assert s.query("select sum(a) from t").rows == want
+    finally:
+        s.execute("set @@tidb_failpoints = ''")
+    assert degrade.snapshot()["degraded_statements_total"] == 1
+
+
+def test_device_loss_on_write_surfaces_error(tk):
+    """Writes are not idempotent: a device loss during a DELETE's scan
+    must surface, never silently re-execute."""
+    s, _ = tk
+    s.execute("set @@tidb_use_tpu = 1")
+    s.execute("set @@tidb_tpu_min_rows = 1")
+    with fail.armed("kernelDispatchError",
+                    exc=degrade.DeviceLost("gone"), times=1):
+        try:
+            s.execute("delete from t where b = 3")
+            # CPU-planned delete (scan subtree not device-eligible):
+            # acceptable — but it must NOT have been a silent re-run
+            assert degrade.snapshot()["degraded_statements_total"] == 0
+        except degrade.DeviceLost:
+            pass  # surfaced: the documented contract
+    assert s.query("admin check table t").rows == [["OK"]]
+
+
+def test_failpoint_hits_exported_to_metrics(tk):
+    s, _ = tk
+    from tinysql_tpu.obs.metrics import render_prometheus
+    fail.reset_hits()
+    with fail.armed("execSlowNext", sleep=0.0):
+        s.query("select count(*) from t")
+    text = render_prometheus()
+    assert 'tinysql_failpoint_hits_total{name="execSlowNext"}' in text
+
+
+# =========================================================================
+# layer 3d: the kv/backoff.py retry ladder under injected faults
+# (SLEEP_SCALE = 0 via the autouse fixture: full ladder, no wall-clock)
+# =========================================================================
+
+def test_backoffer_budget_exhaustion_and_attempt_ledger():
+    from tinysql_tpu.kv import backoff as bo
+    boer = bo.Backoffer(1000)
+    err = RegionError("synthetic")
+    with pytest.raises(BackoffExceeded):
+        for _ in range(100):
+            boer.backoff(bo.BO_REGION_MISS, err)
+    # the ledger recorded every attempt and the originating errors
+    assert boer.attempts["regionMiss"] >= 2
+    assert all(e is err for e in boer.errors)
+
+
+def test_backoffer_cancel_event_interrupts_ladder():
+    from tinysql_tpu.kv import backoff as bo
+    from tinysql_tpu.kv.errors import TaskCancelled
+    cancel = threading.Event()
+    boer = bo.Backoffer(10_000_000, cancel=cancel)
+    boer.backoff(bo.BO_RPC, RegionError("x"))  # fine while unset
+    cancel.set()
+    with pytest.raises(TaskCancelled):
+        boer.backoff(bo.BO_RPC, RegionError("x"))
+    # forks inherit the cancel event
+    with pytest.raises(TaskCancelled):
+        boer.fork().backoff(bo.BO_RPC, RegionError("x"))
+
+
+def test_reader_ladder_exhausts_against_live_lock(tk):
+    """A lock whose owner is alive (long TTL, primary undecided) must
+    walk txnLockFast to BackoffExceeded — typed, no hang."""
+    s, info = tk
+    key = tablecodec.encode_row_key(info.id, 10)
+    txn = s.storage.begin()
+    val = txn.get(key)
+    txn.rollback()
+    holder = s.storage.begin()
+    holder.set(key, val)
+    from tinysql_tpu.kv.txn import TwoPhaseCommitter
+    committer = TwoPhaseCommitter(holder)
+    # prewrite with a LONG ttl directly (the chaos fixture's 1ms default
+    # would let the reader resolve it instead of waiting)
+    from tinysql_tpu.kv.rpc import RegionCtx
+    for r, muts in s.storage.cache.group_by_region(
+            committer.mutations, lambda m: m.key):
+        s.storage.client.kv_prewrite(RegionCtx(r.id, r.epoch), muts,
+                                     committer.primary, holder.start_ts,
+                                     60_000)
+    reader = Session(s.storage, current_db="c")
+    reader.execute("set @@tidb_use_tpu = 0")
+    with pytest.raises(BackoffExceeded):
+        reader.query("select b from t where a = 10")
+    # release: roll the holder's lock back; reads recover
+    s.storage.client.kv_rollback(
+        RegionCtx(r.id, r.epoch), [m.key for m in committer.mutations],
+        holder.start_ts)
+    assert reader.query("select count(*) from t where a = 10").rows \
+        == [[1]]
+
+
+def test_commit_phase_backoffer_exempt_from_kill():
+    """Once the primary batch committed the txn is durable: the 2PC
+    commit ladder (interruptible=False) must NOT abort on a statement
+    kill — only interruptible ladders do."""
+    from tinysql_tpu.kv import backoff as bo
+    from tinysql_tpu.utils import interrupt
+    g = interrupt.StatementGuard()
+    g.begin()
+    g.kill()
+    tok = interrupt.activate(g)
+    try:
+        commit_boer = bo.Backoffer(1000, interruptible=False)
+        commit_boer.backoff(bo.BO_RPC, RegionError("x"))  # no raise
+        assert commit_boer.fork().interruptible is False
+        with pytest.raises(QueryKilled):
+            bo.Backoffer(1000).backoff(bo.BO_RPC, RegionError("x"))
+    finally:
+        interrupt.deactivate(tok)
+
+
+def test_reader_resolves_expired_lock_through_ladder(tk):
+    """The expired-lock branch: TTL lapses -> check_txn_status rolls the
+    crashed writer back -> the SAME statement completes (resolve-retry,
+    not an error)."""
+    s, _ = tk
+    with fail.armed("beforeCommit", panic=True, times=1):
+        with pytest.raises(fail.Panic):
+            s.execute("delete from t where a = 11")
+    _settle()  # 1ms TTL lapses
+    reader = Session(s.storage, current_db="c")
+    reader.execute("set @@tidb_use_tpu = 0")
+    assert reader.query("select count(*) from t where a = 11").rows \
+        == [[1]]
+
+
+# =========================================================================
+# registry mechanics
+# =========================================================================
+
+def test_arming_unregistered_failpoint_rejected():
+    with pytest.raises(ValueError):
+        fail.arm("noSuchPoint", exc=RuntimeError("x"))
+
+
+def test_times_limits_fires():
+    fail.arm("execSlowNext", value=7, times=2)
+    try:
+        assert fail.eval_point("execSlowNext") == 7
+        assert fail.eval_point("execSlowNext") == 7
+        assert fail.eval_point("execSlowNext") is None
+    finally:
+        fail.disarm("execSlowNext")
+
+
+def test_armed_block_restores_previous_arming():
+    """A with-block override must hand the point back to whatever armed
+    it before (env/sysvar arming survives scoped test arming)."""
+    fail.arm("execSlowNext", value=1)
+    try:
+        with fail.armed("execSlowNext", value=2):
+            assert fail.eval_point("execSlowNext") == 2
+        assert fail.eval_point("execSlowNext") == 1
+    finally:
+        fail.disarm("execSlowNext")
+    assert fail.eval_point("execSlowNext") is None
+
+
+def test_sysvar_arming_roundtrip(tk):
+    s, _ = tk
+    s.execute("set @@tidb_failpoints = 'execSlowNext=return(5)'")
+    try:
+        assert fail.eval_point("execSlowNext") == 5
+    finally:
+        s.execute("set @@tidb_failpoints = ''")
+    assert fail.eval_point("execSlowNext") is None
+    with pytest.raises(SessionError):
+        s.execute("set @@tidb_failpoints = 'bogusName=error(x)'")
+
+
+def test_configure_empty_consumes_env_spec(monkeypatch):
+    """SET tidb_failpoints = '' must stay disarmed even when a
+    TINYSQL_FAILPOINTS env spec has not been lazily loaded yet."""
+    import tinysql_tpu.fail as f
+    monkeypatch.setenv("TINYSQL_FAILPOINTS", "execSlowNext=error(leaked)")
+    monkeypatch.setattr(f, "_ENV_LOADED", False)
+    f.configure("")
+    assert f.eval_point("execSlowNext") is None
+
+
+def test_error_action_raises_fresh_instance_per_fire():
+    """A multi-shot error action must not re-raise the ONE stored
+    exception object (shared-traceback growth, cross-thread mutation)."""
+    fail.arm("execSlowNext", exc=ValueError("boom"))
+    try:
+        seen = []
+        for _ in range(2):
+            with pytest.raises(ValueError) as ei:
+                fail.inject("execSlowNext")
+            seen.append(ei.value)
+        assert seen[0] is not seen[1]
+        assert seen[0].args == seen[1].args
+    finally:
+        fail.disarm("execSlowNext")
+
+
+def test_spec_parser_actions():
+    acts = fail.parse_spec(
+        "copTaskError=3*error(boom);execSlowNext=sleep(0.5);"
+        "rpcServerBusy=return(42);beforeCommit=panic")
+    assert acts["copTaskError"].kind == "error"
+    assert acts["copTaskError"].times == 3
+    assert acts["execSlowNext"].value == 0.5
+    assert acts["rpcServerBusy"].value == 42
+    assert acts["beforeCommit"].kind == "panic"
+    with pytest.raises(ValueError):
+        fail.parse_spec("copTaskError=explode()")

@@ -127,3 +127,53 @@ def test_concurrent_lock_resolution(tk):
                                   [Mutation(0, key, val)], key, ts, 0)
     # reader: must resolve the expired lock (rollback) and not hang
     assert tk.query("select count(*) from t").rows == [[200]]
+
+
+def test_early_close_leaves_no_live_workers(tk):
+    """Regression (chaos PR): a root LIMIT abandoning the scatter-gather
+    mid-scan must cancel pending tasks AND join the pool — thread count
+    returns to its pre-scan baseline (the reference copIterator Close
+    contract; distsql/client.py early-close path)."""
+    import threading
+    import time
+
+    from tinysql_tpu.codec import tablecodec
+    from tinysql_tpu.distsql import DAGRequest, ScanInfo, select
+    from tinysql_tpu.distsql.exprpb import _ft_to_pb
+    from tinysql_tpu.kv import backoff
+
+    info = _split(tk, 8)
+    tk.storage.cluster.set_delay(1, 5)  # keep tasks in flight at close
+    old_scale = backoff.SLEEP_SCALE
+    backoff.SLEEP_SCALE = 0
+    try:
+        pk = info.get_pk_handle_col()
+        scan = ScanInfo(
+            table_id=info.id,
+            col_ids=[c.id for c in info.columns],
+            col_fts=[_ft_to_pb(c.ft) for c in info.columns],
+            col_defaults=[None] * len(info.columns),
+            handle_slots=[],
+            pk_id=pk.id if pk is not None else None,
+        )
+        req = DAGRequest(start_ts=tk.storage.oracle.get_timestamp(),
+                         scan=scan)
+        before = set(threading.enumerate())
+        it = select(tk.storage, req,
+                    [tablecodec.record_range(info.id)], concurrency=8)
+        next(it)       # first batch arrived; tasks still pending
+        it.close()     # the root-LIMIT early close
+        leaked = [t for t in threading.enumerate() if t not in before]
+        assert not leaked, f"workers outlived the iterator: {leaked}"
+        # and the full SQL-level path (LIMIT over a multi-region scan)
+        # drains cleanly too
+        before_n = threading.active_count()
+        assert len(tk.query("select a from t limit 5").rows) == 5
+        deadline = time.time() + 2
+        while threading.active_count() > before_n \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        assert threading.active_count() <= before_n
+    finally:
+        backoff.SLEEP_SCALE = old_scale
+        tk.storage.cluster.set_delay(1, 0)

@@ -309,3 +309,53 @@ def test_cli_clean_on_tree_trace_locks():
         [sys.executable, LINT, "--pass", "trace", "--pass", "locks"],
         capture_output=True, text=True, timeout=300, cwd=REPO)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---- pass 5: fail discipline (FP5xx) ------------------------------------
+
+def test_fail_fixture_fires_fp_rules():
+    from tinysql_tpu.analysis import lint_fail_discipline
+    sf = SourceFile(os.path.join(FIXDIR, "bad_retry.py"))
+    got = lint_fail_discipline(sf)
+    assert [d.rule for d in got].count("FP501") == 1, \
+        [d.format() for d in got]
+    assert [d.rule for d in got].count("FP502") == 2, \
+        [d.format() for d in got]
+
+
+def test_fail_backoffer_module_exempt(tmp_path):
+    # backoff.py OWNS sleeping (budget metering, SLEEP_SCALE, cancel)
+    from tinysql_tpu.analysis import lint_fail_discipline
+    p = tmp_path / "backoff.py"
+    p.write_text("import time\n\n\ndef backoff(ms):\n"
+                 "    time.sleep(ms / 1000.0)\n")
+    assert lint_fail_discipline(SourceFile(str(p))) == []
+
+
+def test_fail_registered_and_dynamic_names_clean(tmp_path):
+    from tinysql_tpu.analysis import lint_fail_discipline
+    p = tmp_path / "seams.py"
+    p.write_text("from tinysql_tpu.utils import failpoint\n\n\n"
+                 "def seam(name):\n"
+                 "    failpoint.inject('copTaskError')\n"
+                 "    failpoint.inject(name)  # dynamic: runtime-checked\n")
+    assert lint_fail_discipline(SourceFile(str(p))) == []
+
+
+def test_tree_fail_discipline_clean():
+    from tinysql_tpu.analysis import lint_fail_discipline
+    diags = []
+    for rel in _lint_cli_module().FAIL_SCOPE:
+        for sf in gather_sources(os.path.join(REPO, rel)):
+            diags.extend(sf.check_suppression_syntax())
+            diags.extend(lint_fail_discipline(sf))
+    assert not diags, "\n".join(d.format() for d in diags)
+
+
+def test_cli_exits_nonzero_on_fail_fixture():
+    r = subprocess.run(
+        [sys.executable, LINT, "--pass", "fail",
+         os.path.join(FIXDIR, "bad_retry.py")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "FP50" in r.stdout

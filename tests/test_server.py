@@ -642,3 +642,69 @@ def test_plain_server_does_not_offer_ssl(server):
     c = MiniClient(server.port)
     assert not (c.server_caps & 0x0800)
     c.close()
+
+
+def test_kill_query_over_wire(server):
+    """KILL QUERY <id> from a second connection aborts the first
+    connection's running statement with MySQL error 1317, and the victim
+    connection stays usable (reference: server kill dispatch)."""
+    import threading
+    import time as _t
+
+    from tinysql_tpu import fail
+
+    c1 = MiniClient(server.port)
+    c1.query("create database if not exists killdb")
+    c1.query("use killdb")
+    c1.query("create table if not exists k (a int primary key, b int)")
+    c1.query("insert into k values " + ", ".join(
+        f"({i}, {i})" for i in range(1, 101)))
+    c1.query("set @@tidb_use_tpu = 0")
+    c1.query("set @@tidb_max_chunk_size = 8")
+    victim_id = max(server.conns)  # c1 is the newest connection
+    c2 = MiniClient(server.port)
+    box = []
+
+    def slow():
+        try:
+            box.append(c1.query("select * from k"))
+        except RuntimeError as e:
+            box.append(e)
+    fail.arm("execSlowNext", sleep=0.02)
+    try:
+        t = threading.Thread(target=slow)
+        t.start()
+        _t.sleep(0.1)
+        c2.query(f"kill query {victim_id}")
+        t.join(10)
+        assert not t.is_alive()
+    finally:
+        fail.disarm("execSlowNext")
+    assert isinstance(box[0], RuntimeError) and "1317" in str(box[0]), \
+        box[0]
+    # the killed CONNECTION survives a KILL QUERY
+    assert c1.query("select count(*) from k")[1] == [["100"]]
+    c1.close()
+    c2.close()
+
+
+def test_plain_kill_drops_connection(server):
+    import socket as _socket
+
+    c1 = MiniClient(server.port)
+    c1.query("select 1")
+    victim_id = max(server.conns)
+    c2 = MiniClient(server.port)
+    c2.query(f"kill {victim_id}")
+    # the victim's next command gets a closed socket (server dropped it
+    # after the in-flight command window)
+    deadline = __import__("time").time() + 5
+    dead = False
+    while __import__("time").time() < deadline and not dead:
+        try:
+            c1.query("select 1")
+            __import__("time").sleep(0.05)
+        except (RuntimeError, ConnectionError, OSError, _socket.error):
+            dead = True
+    assert dead, "plain KILL did not drop the victim connection"
+    c2.close()

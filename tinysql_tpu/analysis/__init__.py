@@ -22,6 +22,10 @@ Three passes over the invariants nothing else checks mechanically:
   ``STATS[...]`` writes outside the owning device-layer modules — only
   the ``kernels.stats_add``/``stats_hwm`` accessors fan increments out
   to per-query observability scopes (obs/context.py).
+- **fail-discipline** (`fail_discipline.py`, FP5xx): retry paths may
+  only sleep through ``Backoffer`` (FP501), and every failpoint inject
+  site must name a point registered in the ``fail/points.py`` catalogue
+  (FP502) so the chaos suite can arm it.
 
 Every pass honors inline suppressions with REQUIRED justification text:
 
@@ -31,6 +35,7 @@ See docs/LINT.md and tools/lint.py.
 """
 from .diag import (Diagnostic, Severity, SourceFile, format_diagnostics,
                    gather_sources)
+from .fail_discipline import lint_fail_discipline
 from .lock_discipline import lint_lock_discipline
 from .obs_discipline import lint_obs_discipline
 from .plan_device import PlanDeviceError, check_plan, verify_plan
@@ -39,5 +44,6 @@ from .trace_safety import lint_trace_safety
 __all__ = [
     "Diagnostic", "Severity", "SourceFile", "format_diagnostics",
     "gather_sources", "lint_trace_safety", "lint_lock_discipline",
-    "lint_obs_discipline", "check_plan", "verify_plan", "PlanDeviceError",
+    "lint_obs_discipline", "lint_fail_discipline", "check_plan",
+    "verify_plan", "PlanDeviceError",
 ]

@@ -1,0 +1,100 @@
+"""qlint pass: failure-injection discipline (FP5xx).
+
+Two invariants keep the resilience surface testable:
+
+- **FP501** — no raw ``time.sleep`` in retry-path modules outside
+  ``Backoffer`` (kv/backoff.py owns sleeping: it meters every wait
+  against the typed budget, scales under ``SLEEP_SCALE`` so chaos tests
+  run the full ladder without wall-clock, wakes on cancel events, and
+  checks the statement kill flag).  A raw sleep in a retry loop is
+  invisible to all four — a statement stuck in it cannot be killed and
+  a chaos run cannot accelerate it.
+- **FP502** — every ``failpoint.inject("name")`` / ``eval`` site must
+  name a point registered in the ``tinysql_tpu/fail/points.py``
+  catalogue.  The chaos suite enumerates that catalogue and proves each
+  point degrades cleanly; an unregistered name is a seam no chaos test
+  will ever arm.
+
+Scope is set by tools/lint.py (``FAIL_SCOPE``): the kv/distsql/ddl
+retry ladders, the device tier, and the executor layer.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from .diag import Diagnostic, SourceFile, register_rules
+
+register_rules({
+    "FP501": "raw time.sleep in a retry path — only Backoffer may sleep "
+             "(budget metering, SLEEP_SCALE, cancellation, kill checks)",
+    "FP502": "failpoint name not registered in tinysql_tpu/fail/points.py "
+             "— the chaos suite cannot arm it",
+})
+
+#: files that legitimately own sleeping
+_SLEEP_OWNERS = ("backoff.py",)
+
+#: module aliases whose .inject/.eval calls are failpoint sites
+_FAIL_MODULES = {"failpoint", "fail", "_fail"}
+_FAIL_VERBS = {"inject", "eval", "eval_point"}
+
+
+def _registered_names() -> Set[str]:
+    from .. import fail
+    return set(fail.catalogue())
+
+
+def _is_time_sleep(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "sleep" \
+            and isinstance(f.value, ast.Name) \
+            and f.value.id in ("time", "time_mod", "_time"):
+        return True
+    return False
+
+
+def _failpoint_name(call: ast.Call) -> Optional[str]:
+    """The literal name of a failpoint call site, or None when the call
+    is not one (or the name is dynamic — out of static scope)."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _FAIL_VERBS \
+            and isinstance(f.value, ast.Name) and f.value.id in _FAIL_MODULES:
+        pass
+    elif isinstance(f, ast.Name) and f.id in ("inject", "eval_point"):
+        pass
+    else:
+        return None
+    if not call.args:
+        return None
+    a = call.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        return a.value
+    return None
+
+
+def lint_fail_discipline(sf: SourceFile) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    base = os.path.basename(sf.path)
+    sleep_ok = base in _SLEEP_OWNERS
+    registered = _registered_names()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not sleep_ok and _is_time_sleep(node):
+            diags.append(Diagnostic(
+                "FP501",
+                "raw time.sleep in a retry path — meter the wait through "
+                "Backoffer (or arm a failpoint sleep action) so chaos "
+                "tests can scale it and KILL can interrupt it",
+                sf.path, node.lineno))
+        name = _failpoint_name(node)
+        if name is not None and name not in registered:
+            diags.append(Diagnostic(
+                "FP502",
+                f"failpoint {name!r} is not registered in "
+                "tinysql_tpu/fail/points.py — register it so the chaos "
+                "suite can arm it",
+                sf.path, node.lineno))
+    return sf.filter(diags)

@@ -15,6 +15,7 @@ from typing import Iterable, List, Optional, Sequence
 import numpy as np
 
 from ..mytypes import EvalType, FieldType, Datum
+from ..utils import memory as _memory
 
 _INIT_CAP = 32
 
@@ -38,6 +39,9 @@ class Column:
         self._data = np.zeros(max(cap, 1), dtype=dt)
         self._null = np.zeros(max(cap, 1), dtype=bool)
         self._len = 0
+        # per-query memory quota (utils/memory.py): charge the buffer
+        # capacity; no-op without an active tidb_mem_quota_query tracker
+        _memory.consume(self._data.nbytes + self._null.nbytes)
 
     # ---- constructors -------------------------------------------------
     @classmethod
@@ -50,6 +54,7 @@ class Column:
         c._null = (np.zeros(n, dtype=bool) if null is None
                    else np.asarray(null, dtype=bool).copy())
         c._len = n
+        _memory.consume(c._data.nbytes + c._null.nbytes)
         return c
 
     @classmethod
@@ -87,6 +92,8 @@ class Column:
         if self._len + need <= cap:
             return
         new_cap = max(cap * 2, self._len + need)
+        _memory.consume((new_cap - cap)
+                        * (self._data.itemsize + self._null.itemsize))
         self._data = np.resize(self._data, new_cap)
         self._null = np.resize(self._null, new_cap)
 

@@ -7,17 +7,35 @@ distsql/distsql.go Select / select_result.go SelectResult).
 bounded worker pool (`tidb_distsql_scan_concurrency`), retries region
 errors after re-splitting against the refreshed cache, resolves lock
 conflicts, and yields each task's rows in task (key) order.
+
+Robustness contract:
+
+- every task attempt passes the ``copTaskError`` failpoint, so chaos
+  tests can drive the whole retry ladder (RegionError -> re-split,
+  KeyIsLocked -> resolve) or surface a typed error;
+- workers run inside a COPY of the caller's context, so the statement
+  kill flag / max_execution_time deadline (utils/interrupt.py) and the
+  per-query observability scope both reach them;
+- early close (a root LIMIT abandoning the iterator) sets a cancel
+  event that every worker observes at its next attempt or mid-backoff
+  (Backoffer wakes on it), then joins the pool with
+  ``shutdown(wait=True, cancel_futures=True)`` — no worker thread
+  survives the generator (the reference copIterator Close contract).
 """
 from __future__ import annotations
 
 import concurrent.futures as cf
+import contextvars
+import threading
 from dataclasses import replace
-from typing import Iterator, List, Tuple
+from typing import Iterator, List, Optional, Tuple
 
+from .. import fail
 from ..kv import backoff as bo
 from ..kv.backoff import Backoffer
-from ..kv.errors import KeyIsLocked, RegionError
+from ..kv.errors import KeyIsLocked, RegionError, TaskCancelled
 from ..kv.rpc import RegionCtx
+from ..utils import interrupt
 from .request import DAGRequest
 
 DEFAULT_CONCURRENCY = 15
@@ -35,13 +53,24 @@ class CopClient:
                 tasks.append((region, s, e))
         return tasks
 
-    def _run_task(self, req: DAGRequest, region, s: bytes, e: bytes) -> list:
+    def _run_task(self, req: DAGRequest, region, s: bytes, e: bytes,
+                  cancel: Optional[threading.Event] = None,
+                  boer: Optional[Backoffer] = None) -> list:
         """Execute one region task with backoff; re-splits on region errors
-        (reference: coprocessor.go handleTaskOnce + onRegionError)."""
-        boer = Backoffer(bo.COP_NEXT_MAX_BACKOFF)
+        (reference: coprocessor.go handleTaskOnce + onRegionError).  The
+        Backoffer is threaded through re-split recursion — each level
+        must spend the SAME retry budget, so a persistently failing
+        region exhausts it as a typed BackoffExceeded instead of
+        recursing a fresh budget per level."""
+        if boer is None:
+            boer = Backoffer(bo.COP_NEXT_MAX_BACKOFF, cancel=cancel)
         resolved: Tuple[int, ...] = req.resolved
         while True:
+            interrupt.check()
+            if cancel is not None and cancel.is_set():
+                raise TaskCancelled("cop task cancelled")
             try:
+                fail.inject("copTaskError")
                 return self.storage.client.coprocessor(
                     RegionCtx(region.id, region.epoch),
                     {"req": replace(req, resolved=resolved), "range": (s, e)})
@@ -51,13 +80,22 @@ class CopClient:
                 out = []
                 for r2, s2, e2 in \
                         self.storage.cache.split_range_by_regions(s, e):
-                    out.extend(self._run_task(req, r2, s2, e2))
+                    out.extend(self._run_task(req, r2, s2, e2, cancel,
+                                              boer))
                 return out
             except KeyIsLocked as lk:
-                if not self.storage.resolver.resolve(boer, lk):
+                if self.storage.resolver.resolve(boer, lk):
+                    # outcome KNOWN (committed/rolled back) and the
+                    # resolve was sent: the server may now ignore this
+                    # txn's leftovers.  A still-LIVE lock must NOT be
+                    # added — reading around it would miss a commit
+                    # that lands with commit_ts below our snapshot
+                    # (chaos-suite find: stale point reads under a
+                    # pending 2PC)
+                    if lk.lock_ts not in resolved:
+                        resolved = resolved + (lk.lock_ts,)
+                else:
                     boer.backoff(bo.BO_TXN_LOCK_FAST, lk)
-                resolved = resolved + (lk.lock_ts,) \
-                    if lk.lock_ts not in resolved else resolved
 
     def select(self, req: DAGRequest, ranges: List[Tuple[bytes, bytes]],
                concurrency: int = DEFAULT_CONCURRENCY) -> Iterator[list]:
@@ -71,23 +109,37 @@ class CopClient:
                 yield self._run_task(req, region, s, e)
             return
         # bounded in-flight window: at most `concurrency` region results
-        # buffered (the reference copIterator's respChan backpressure);
-        # early close (root LIMIT satisfied) cancels pending tasks
+        # buffered (the reference copIterator's respChan backpressure)
+        cancel = threading.Event()
         pool = cf.ThreadPoolExecutor(max_workers=min(concurrency, len(tasks)))
+
+        def submit(task):
+            region, s, e = task
+            # fresh context COPY per task: one Context object cannot be
+            # entered concurrently, and workers must see the caller's
+            # statement guard + obs scope
+            ctx = contextvars.copy_context()
+            return pool.submit(ctx.run, self._run_task, req, region, s, e,
+                               cancel)
         try:
             futs = []
             nxt = 0
             done = 0
             while done < len(tasks):
                 while nxt < len(tasks) and nxt - done < concurrency:
-                    region, s, e = tasks[nxt]
-                    futs.append(pool.submit(self._run_task, req, region, s, e))
+                    futs.append(submit(tasks[nxt]))
                     nxt += 1
                 yield futs[done].result()
                 futs[done] = None  # release the buffered rows
                 done += 1
-        except GeneratorExit:
-            pool.shutdown(wait=False, cancel_futures=True)
+        except BaseException:
+            # early close (root LIMIT satisfied -> GeneratorExit), a
+            # statement kill raised out of .result(), or any task error:
+            # cancel pending work and JOIN the pool — a worker mid-retry
+            # observes `cancel` at its next attempt or mid-backoff, so
+            # the join is bounded and no thread outlives the iterator
+            cancel.set()
+            pool.shutdown(wait=True, cancel_futures=True)
             raise
         pool.shutdown(wait=True)
 

@@ -63,7 +63,9 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from .. import fail as _fail
 from ..obs import context as _obs
+from ..utils import interrupt as _interrupt
 
 from ..chunk import Chunk, Column as CCol
 from ..expression import Column as ExprColumn, Constant
@@ -162,6 +164,11 @@ class BlockPipeline:
 
     def _stage_timed(self, item):
         t0 = time.time()
+        # both run inside the creator's copied context: a statement kill
+        # or deadline stops the producer between blocks, and the staging
+        # failpoint exercises the error-delivery contract below
+        _interrupt.check()
+        _fail.inject("devpipeStageError")
         with _obs.span("stage", cat="pipeline"):
             out = self._stage(item)
         dt = time.time() - t0
@@ -2532,6 +2539,8 @@ class DevPipeExec:
     def drain(self) -> List[list]:
         rows = []
         while True:
+            _interrupt.check()
+            _fail.inject("execSlowNext")
             chk = self.next()
             if chk is None:
                 break

@@ -17,7 +17,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import fail
 from ..catalog.table import Table
+from ..utils import interrupt
 from ..chunk import Chunk, MAX_CHUNK_SIZE
 from ..expression import Schema, vectorized_filter
 from ..mytypes import EvalType, sort_key
@@ -69,6 +71,12 @@ class Executor:
     def drain(self) -> List[list]:
         rows = []
         while True:
+            # THE root block boundary: statement kill and the
+            # max_execution_time deadline land between blocks here (the
+            # all-consuming operators below add their own inner checks);
+            # execSlowNext lets chaos tests stretch any statement
+            interrupt.check()
+            fail.inject("execSlowNext")
             chk = self.next()
             if chk is None:
                 break
@@ -709,6 +717,7 @@ class HashAggExec(Executor):
         child = self.children[0]
         chunks = []
         while True:
+            interrupt.check()
             chk = child.next()
             if chk is None:
                 break
@@ -854,6 +863,7 @@ class HashAggExec(Executor):
         gb_vals: Dict[tuple, list] = {}
         child = self.children[0]
         while True:
+            interrupt.check()
             chk = child.next()
             if chk is None:
                 break
@@ -938,6 +948,7 @@ class HashJoinExec(Executor):
                 [c.ret_type for c in self.children[1].schema.columns])
         nat_keys: List[np.ndarray] = []
         while True:
+            interrupt.check()
             chk = build.next()
             if chk is None:
                 break
@@ -1295,6 +1306,7 @@ class SortExec(Executor):
         child = self.children[0]
         all_chk = Chunk(self.field_types(), cap=MAX_CHUNK_SIZE)
         while True:
+            interrupt.check()
             chk = child.next()
             if chk is None:
                 break
@@ -1401,6 +1413,7 @@ class TopNExec(Executor):
             child = self.children[0]
             all_chk = Chunk(self.field_types(), cap=MAX_CHUNK_SIZE)
             while True:
+                interrupt.check()
                 chk = child.next()
                 if chk is None:
                     break

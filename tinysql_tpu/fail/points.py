@@ -1,0 +1,79 @@
+"""THE failpoint catalogue: every named inject site in the engine.
+
+qlint FP502 statically checks that each ``failpoint.inject("...")`` /
+``eval`` site names a point registered here, and the chaos suite
+(tests/test_chaos.py) asserts it has a driver for EVERY name below — so
+a new failpoint cannot be added without both a registration and a chaos
+proof that arming it degrades cleanly.
+"""
+from __future__ import annotations
+
+from . import register
+
+# ---- kv / 2PC (store/tikv lineage) ----------------------------------------
+RPC_SERVER_BUSY = register(
+    "rpcServerBusy",
+    "RPC region check raises RegionError(server_busy) — drives the "
+    "BO_REGION_MISS retry ladder (kv/rpc.py)")
+PREWRITE_ERROR = register(
+    "prewriteError",
+    "kv_prewrite raises before touching MVCC — 2PC must clean up, no "
+    "locks left (kv/rpc.py)")
+COMMIT_ERROR = register(
+    "commitError",
+    "kv_commit raises for every batch (kv/rpc.py)")
+COMMIT_PRIMARY_ERROR = register(
+    "commitPrimaryError",
+    "commit RPC on the PRIMARY batch fails — outcome undetermined, "
+    "UndeterminedError must surface (kv/txn.py)")
+COMMIT_SECONDARY_ERROR = register(
+    "commitSecondaryError",
+    "commit RPC on a secondary batch fails — txn stays durable, later "
+    "readers resolve the leftover locks (kv/txn.py)")
+BEFORE_COMMIT = register(
+    "beforeCommit",
+    "between prewrite and commit_keys — a panic here models the classic "
+    "Percolator crashed-committer window (kv/txn.py)")
+
+# ---- distsql coprocessor ---------------------------------------------------
+COP_TASK_ERROR = register(
+    "copTaskError",
+    "start of every region task attempt in the scatter-gather pool — "
+    "RegionError retries through re-split, generic errors surface typed "
+    "(distsql/client.py)")
+
+# ---- device tier -----------------------------------------------------------
+DEVPIPE_STAGE_ERROR = register(
+    "devpipeStageError",
+    "block-staging function of the async pipeline — the producer's "
+    "error contract must deliver it to the consumer in order "
+    "(executor/devpipe.py BlockPipeline)")
+KERNEL_DISPATCH_ERROR = register(
+    "kernelDispatchError",
+    "every compiled-program dispatch (ops/kernels.py counted_jit) — "
+    "armed with degrade.DeviceLost it models a TPU dying mid-statement")
+KERNEL_D2H_ERROR = register(
+    "kernelD2HError",
+    "every device->host materialization (ops/kernels.py d2h/d2h_many)")
+BACKEND_PROBE_FAIL = register(
+    "backendProbeFail",
+    "backend liveness probe reports the device backend unreachable — "
+    "engine must pin jax_platforms=cpu instead of hanging "
+    "(ops/kernels.py ensure_live_backend)")
+
+# ---- DDL -------------------------------------------------------------------
+DDL_STEP_ERROR = register(
+    "ddlStepError",
+    "one DDL worker state-machine step fails — the job retries/rolls "
+    "back, the queue never wedges (ddl/worker.py)")
+REORG_BATCH_ERROR = register(
+    "reorgBatchError",
+    "one index-backfill batch fails — reorg resumes from the checkpoint "
+    "handle (ddl/worker.py)")
+
+# ---- executor --------------------------------------------------------------
+EXEC_SLOW_NEXT = register(
+    "execSlowNext",
+    "fires once per root drain block — a sleep action makes any "
+    "statement controllably long-running (KILL / max_execution_time "
+    "tests; executor/executors.py Executor.drain)")

@@ -136,4 +136,4 @@ class Cluster:
         with self._mu:
             d = self.stores[sid].delay_ms if sid in self.stores else 0
         if d:
-            time.sleep(d / 1000.0)
+            time.sleep(d / 1000.0)  # qlint: disable=FP501 -- the injected store latency IS the simulated fault, not a retry sleep

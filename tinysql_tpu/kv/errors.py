@@ -63,6 +63,12 @@ class UndeterminedError(KVError):
     reference: 2pc.go:417-428."""
 
 
+class TaskCancelled(KVError):
+    """A cooperative cancel (early close of a scatter-gather, statement
+    kill) interrupted this task's retry loop — never user-visible: the
+    canceller discards the task's result."""
+
+
 class SchemaOutdated(RetryableError):
     """Schema changed during txn; lease check failed
     (reference: domain/schema_validator.go)."""

@@ -265,7 +265,12 @@ class TwoPhaseCommitter:
     def commit_keys(self) -> None:
         keys = [m.key for m in self.mutations]
         groups = self.storage.cache.group_keys_by_region(keys)
-        boer = Backoffer(bo.COMMIT_MAX_BACKOFF)
+        # NOT interruptible: the primary batch runs first, and once it
+        # committed the txn is durable — a statement kill aborting a
+        # secondary retry here would report 1317 for a COMMITTED txn and
+        # skip the columnar invalidation (kills land before/after the
+        # commit phase instead, via the executor checks and prewrite)
+        boer = Backoffer(bo.COMMIT_MAX_BACKOFF, interruptible=False)
 
         def one(batch: Tuple[Region, List[bytes]]) -> None:
             r, ks = batch

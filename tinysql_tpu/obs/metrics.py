@@ -144,6 +144,42 @@ def render_prometheus() -> str:
         emit("tinysql_progcache_programs", "Registered compiled programs",
              "gauge", [((), psize)])
 
+    # resilience counters: failpoint fires (per name), device-loss
+    # degradation, memory-quota aborts — chaos runs read these to prove
+    # every injected fault was actually observed
+    try:
+        from .. import fail
+        fhits = fail.hits()
+    except Exception:
+        fhits = {}
+    if fhits:
+        emit("tinysql_failpoint_hits_total", "Failpoint fires by name",
+             "counter",
+             [((("name", k),), v) for k, v in sorted(fhits.items())])
+    try:
+        from ..ops import degrade
+        dsnap = degrade.snapshot()
+    except Exception:
+        dsnap = None
+    if dsnap is not None:
+        emit("tinysql_device_loss_total",
+             "Mid-statement accelerator losses observed", "counter",
+             [((), dsnap["device_loss_total"])])
+        emit("tinysql_degraded_statements_total",
+             "Statements transparently re-executed on CPU after a "
+             "device loss", "counter",
+             [((), dsnap["degraded_statements_total"])])
+        emit("tinysql_cpu_pinned",
+             "1 while planning is pinned to CPU (device-loss cooldown)",
+             "gauge", [((), dsnap["cpu_pinned"])])
+    try:
+        from ..utils import memory as mem
+        emit("tinysql_mem_quota_exceeded_total",
+             "Statements aborted by tidb_mem_quota_query", "counter",
+             [((), mem.aborts_total())])
+    except Exception:
+        pass
+
     from .trace import recent_traces
     emit("tinysql_trace_ring_entries", "Query traces buffered for "
          "/debug/trace", "gauge", [((), len(recent_traces()))])

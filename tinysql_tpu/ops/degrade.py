@@ -1,0 +1,105 @@
+"""Runtime device-loss degradation.
+
+The planner's device enforcer promises a CPU fallback (ROADMAP north
+star); this module supplies the RUNTIME half: when a compiled-program
+dispatch or a device->host transfer dies mid-statement (TPU tunnel
+dropped, device reset — surfaced by jax as ``XlaRuntimeError``, or
+injected via the ``kernelDispatchError``/``kernelD2HError`` failpoints
+raising :class:`DeviceLost`), the session
+
+1. records the loss (counters below, exported to /metrics),
+2. pins planning to the CPU tier for a cooldown window
+   (``tidb_device_cooldown`` seconds; every ``Session._use_tpu`` read
+   consults :func:`cpu_pinned`), and
+3. transparently re-executes the statement once on the CPU volcano
+   path — READ-ONLY statements only; writes surface the error, because
+   a re-run after a partially-dispatched write is not idempotent.
+
+Detection is conservative: only :class:`DeviceLost` and exception types
+named like jax runtime/backend failures count — a TypeError from a
+kernel bug must fail the statement loudly, not silently demote the
+process to CPU.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+DEFAULT_COOLDOWN_S = 30.0
+
+#: exception type names that mean "the device/backend died", not "bug"
+_DEVICE_ERROR_TYPES = ("XlaRuntimeError", "JaxRuntimeError",
+                      "DeviceLost")
+
+
+class DeviceLost(RuntimeError):
+    """Raised (or injected) at the dispatch/transfer boundary when the
+    accelerator vanished mid-statement."""
+
+
+_mu = threading.Lock()
+_pinned_until = 0.0
+_losses = 0
+_degraded_statements = 0
+
+
+#: failpoints that sit ON the device boundary: a generic Injected error
+#: from them models the accelerator dying (spec strings cannot name an
+#: exception class, so `tidb_failpoints='kernelDispatchError=error(x)'`
+#: must degrade exactly like a programmatic DeviceLost)
+_DEVICE_FAILPOINTS = ("kernelDispatchError", "kernelD2HError")
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    for e in (exc, exc.__cause__, exc.__context__):
+        if e is None:
+            continue
+        if isinstance(e, DeviceLost):
+            return True
+        if type(e).__name__ in _DEVICE_ERROR_TYPES:
+            return True
+        if getattr(e, "failpoint", None) in _DEVICE_FAILPOINTS:
+            return True
+    return False
+
+
+def record_loss(cooldown_s: float = DEFAULT_COOLDOWN_S) -> None:
+    """One observed device loss: bump counters, open/extend the CPU pin
+    window."""
+    global _pinned_until, _losses
+    until = time.monotonic() + max(0.0, float(cooldown_s))
+    with _mu:
+        _losses += 1
+        _pinned_until = max(_pinned_until, until)
+    try:
+        from ..obs import context as _obs
+        _obs.record("device_loss", 1)
+    except Exception:
+        pass
+
+
+def record_degraded_statement() -> None:
+    global _degraded_statements
+    with _mu:
+        _degraded_statements += 1
+
+
+def cpu_pinned() -> bool:
+    with _mu:
+        return time.monotonic() < _pinned_until
+
+
+def snapshot() -> dict:
+    with _mu:
+        return {"device_loss_total": _losses,
+                "degraded_statements_total": _degraded_statements,
+                "cpu_pinned": 1 if time.monotonic() < _pinned_until else 0}
+
+
+def reset() -> None:
+    """Tests only."""
+    global _pinned_until, _losses, _degraded_statements
+    with _mu:
+        _pinned_until = 0.0
+        _losses = 0
+        _degraded_statements = 0

@@ -23,6 +23,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import progcache
+from .. import fail
 from ..obs import context as _obs
 
 _jax = None
@@ -63,6 +64,23 @@ def ensure_live_backend(jax_mod=None, timeout: float = None,
     except Exception:
         plats = ""
     effective = want or plats
+    try:
+        probe_fail = bool(fail.eval_point("backendProbeFail"))
+    except Exception:
+        # ANY armed action (return, error, ...) means "the probe failed":
+        # the contract is pin-cpu-never-hang, not propagate
+        probe_fail = True
+    if probe_fail:
+        # injected probe failure: behave exactly like an unreachable
+        # backend — pin cpu, never hang
+        logging.getLogger("tinysql_tpu").warning(
+            "jax backend %r probe failed (injected) — pinning "
+            "jax_platforms=cpu", effective or "<default>")
+        try:
+            jax_mod.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        return
     names = [p.strip() for p in effective.split(",") if p.strip()]
     if not names or all(n == "cpu" for n in names):
         # nothing pinned to a device backend: plain auto-detect (cpu on
@@ -136,7 +154,7 @@ def ensure_live_backend(jax_mod=None, timeout: float = None,
             logging.getLogger("tinysql_tpu").warning(
                 "jax backend %r probe attempt %d/%d failed — retrying "
                 "in %.0fs", effective, i + 1, attempts, wait)
-            time_mod.sleep(wait)
+            time_mod.sleep(wait)  # qlint: disable=FP501 -- process-start probe retry; no Backoffer exists before a backend does
     def _touch(path):
         try:
             os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -374,6 +392,7 @@ def counted_jit(fn, **kw):
     costs: Dict[tuple, Optional[tuple]] = {}
 
     def call(*a, **k):
+        fail.inject("kernelDispatchError")
         stats_add("dispatches", 1)
         if _COST_TRACKING["on"]:
             spec = _arg_spec((a, k))
@@ -395,6 +414,7 @@ def counted_jit(fn, **kw):
 
 def d2h(dev_arr) -> np.ndarray:
     """Counted device->host materialization."""
+    fail.inject("kernelD2HError")
     with _obs.span("drain", cat="device"):
         out = np.asarray(dev_arr)
     stats_add("d2h_transfers", 1)
@@ -408,6 +428,7 @@ def d2h_many(dev_arrs) -> List[np.ndarray]:
     kernel result split across the int64 and float64 streams pays the
     link's per-transfer latency once, not once per stream (the Q6
     dispatches=1 / d2h_transfers=2 accounting bug, BENCH_r05)."""
+    fail.inject("kernelD2HError")
     with _obs.span("drain", cat="device"):
         outs = [np.asarray(a) for a in jax().device_get(list(dev_arrs))]
     stats_add("d2h_transfers", 1)

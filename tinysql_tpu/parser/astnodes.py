@@ -344,6 +344,14 @@ class AdminStmt(StmtNode):
 
 
 @dataclass
+class KillStmt(StmtNode):
+    # KILL [QUERY|CONNECTION] <conn_id>: QUERY aborts the target's
+    # running statement; plain/CONNECTION also drops the connection
+    conn_id: int = 0
+    query_only: bool = False
+
+
+@dataclass
 class EmptyStmt(StmtNode):
     pass
 

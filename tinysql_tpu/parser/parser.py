@@ -154,7 +154,19 @@ class Parser:
             return AnalyzeTableStmt(tables)
         if kw == "admin":
             return self._admin_stmt()
+        if kw == "kill":
+            return self._kill_stmt()
         raise ParseError(f"unsupported statement {t.text!r}", t.pos)
+
+    def _kill_stmt(self) -> KillStmt:
+        """KILL [TIDB] [QUERY | CONNECTION] <conn_id> (reference:
+        executor/simple.go executeKill + server kill dispatch)."""
+        self._advance()
+        self._accept_kw("tidb")
+        query_only = self._accept_kw("query") is not None
+        if not query_only:
+            self._accept_kw("connection")
+        return KillStmt(conn_id=self._uint_literal(), query_only=query_only)
 
     # ---- SELECT ------------------------------------------------------------
     def _select_stmt(self) -> SelectStmt:

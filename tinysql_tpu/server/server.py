@@ -20,14 +20,25 @@ from .packetio import PacketIO
 log = logging.getLogger("tinysql_tpu.server")
 
 
+def _err_packet_for(e: Exception) -> bytes:
+    """Map a statement error onto the wire: typed errors carry their own
+    MySQL code/sqlstate (QueryKilled 1317, QueryTimeout 3024,
+    MemQuotaExceeded 8175, coded SessionErrors); everything else is the
+    generic 1105."""
+    return p.err_packet(getattr(e, "mysql_code", 1105), str(e),
+                        getattr(e, "sqlstate", "HY000"))
+
+
 class ClientConn:
-    def __init__(self, server: "Server", conn: socket.socket, conn_id: int):
+    def __init__(self, server: "Server", conn: socket.socket):
         self.server = server
         self.sock = conn
-        self.conn_id = conn_id
         self.io = PacketIO(conn)
         self.tls = False
         self.session = Session(server.storage, domain=server.domain)
+        # the wire thread-id IS the session's process-unique conn id, so
+        # the id a client sees in the handshake is a valid KILL target
+        self.conn_id = self.session.conn_id
         self.alive = True
         # prepared statements: id -> [sql_parts, types] (binary protocol)
         self._stmts: dict = {}
@@ -132,9 +143,13 @@ class ClientConn:
                     log.warning("conn-%d command error: %s",
                                 self.conn_id, e)
                     try:
-                        self.io.write_packet(p.err_packet(1105, str(e)))
+                        self.io.write_packet(_err_packet_for(e))
                     except OSError:
                         return
+                if self.session.killed:
+                    # plain KILL <id>: the connection drops after the
+                    # current command's response went out
+                    return
         finally:
             try:
                 self.session.rollback_txn()
@@ -273,7 +288,7 @@ class ClientConn:
                 rs = self.session._execute_stmt(stmt)
             except Exception as e:
                 log.debug("query error: %s", e)
-                self.io.write_packet(p.err_packet(1105, str(e)))
+                self.io.write_packet(_err_packet_for(e))
                 return  # error aborts the remaining statements
             if isinstance(rs, ResultSet):
                 self._write_resultset(rs, more)
@@ -325,7 +340,6 @@ class Server:
         self.port = port
         self.sock: Optional[socket.socket] = None
         self.conns: Dict[int, ClientConn] = {}
-        self._next_id = 0
         self._mu = threading.Lock()
         self._closed = threading.Event()
 
@@ -350,13 +364,11 @@ class Server:
                 conn, addr = self.sock.accept()
             except OSError:
                 return
+            cc = ClientConn(self, conn)
             with self._mu:
-                self._next_id += 1
-                cid = self._next_id
-                cc = ClientConn(self, conn, cid)
-                self.conns[cid] = cc
+                self.conns[cc.conn_id] = cc
             threading.Thread(target=cc.run, daemon=True,
-                             name=f"conn-{cid}").start()
+                             name=f"conn-{cc.conn_id}").start()
 
     def remove_conn(self, cid: int) -> None:
         with self._mu:

@@ -28,6 +28,7 @@ from ..planner.logical import LogicalSelection
 from ..planner.optimizer import optimize
 from ..expression import Column as ExprColumn, split_cnf
 from ..mytypes import new_int_type
+from ..utils import interrupt, memory
 
 DEFAULT_SYSVARS: Dict[str, Datum] = {
     # reference: sessionctx/variable/tidb_vars.go defaults
@@ -79,7 +80,18 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # exceeds it emit a structured JSONL record (obs/slowlog.py)
     "tidb_slow_log_threshold": 300,
     "sql_mode": "STRICT_TRANS_TABLES",
+    # SELECT wall-clock budget in MILLISECONDS (0 = unlimited): checked
+    # at every block boundary (utils/interrupt.py), surfaces MySQL 3024
     "max_execution_time": 0,
+    # per-query chunk-allocation budget in BYTES (0 = unlimited): blown
+    # quota aborts the statement with error 8175 (utils/memory.py)
+    "tidb_mem_quota_query": 0,
+    # seconds the backend stays pinned to CPU after a mid-statement
+    # device loss (ops/degrade.py runtime degradation)
+    "tidb_device_cooldown": 30,
+    # failpoint arming spec (fail.configure): "name=error(msg);..." —
+    # process-global, empty string disarms everything
+    "tidb_failpoints": "",
 }
 
 
@@ -97,7 +109,15 @@ class ResultSet:
 
 
 class SessionError(Exception):
-    pass
+    """Statement-level error with an optional MySQL wire code (the
+    server maps ``mysql_code``/``sqlstate`` into its ERR packet;
+    1105 = generic server error)."""
+
+    def __init__(self, msg: str, mysql_code: int = 1105,
+                 sqlstate: str = "HY000"):
+        super().__init__(msg)
+        self.mysql_code = mysql_code
+        self.sqlstate = sqlstate
 
 
 SLOW_QUERY_THRESHOLD_MS = 300.0  # fallback when the sysvar is unset/bad
@@ -140,6 +160,13 @@ class Session:
         # the last statement's observability scope (obs/context.QueryObs):
         # per-query device counters, per-operator RuntimeStats, span trace
         self.last_query_stats = None
+        # statement interruption (utils/interrupt.py): a process-unique
+        # connection id (the KILL target / server thread id) + the guard
+        # any thread may flip to abort the running statement
+        self.conn_id = interrupt.register_session(self)
+        self.guard = interrupt.StatementGuard(self.conn_id)
+        self.killed = False  # plain KILL: server drops the conn after
+        #                      the current command
 
     def _globals(self) -> Dict[str, Datum]:
         g = getattr(self.storage, "_global_vars", None)
@@ -338,6 +365,38 @@ class Session:
         return out[0]
 
     def _execute_stmt(self, stmt: ast.StmtNode) -> Optional[ResultSet]:
+        # arm the interruption guard + memory quota for THIS statement.
+        # Done here (not in execute()) because the server's query/prepared
+        # paths enter per statement through this method directly.
+        deadline = None
+        if isinstance(stmt, ast.SelectStmt):
+            # max_execution_time applies to SELECT (MySQL semantics);
+            # value is validated at SET time, so a bad stored value is a
+            # config bug — fall back to no deadline instead of failing
+            try:
+                met = int(self.get_sysvar("max_execution_time") or 0)
+            except (TypeError, ValueError):
+                met = 0
+            if met > 0:
+                deadline = time.monotonic() + met / 1000.0
+        self.guard.begin(deadline)
+        gtok = interrupt.activate(self.guard)
+        mtok = None
+        try:
+            quota = int(self.get_sysvar("tidb_mem_quota_query") or 0)
+        except (TypeError, ValueError):
+            quota = 0
+        if quota > 0:
+            mtok = memory.activate(memory.MemTracker(quota))
+        try:
+            return self._execute_stmt_guarded(stmt)
+        finally:
+            if mtok is not None:
+                memory.deactivate(mtok)
+            interrupt.deactivate(gtok)
+
+    def _execute_stmt_guarded(self, stmt: ast.StmtNode) \
+            -> Optional[ResultSet]:
         # statement-level rollback inside an explicit txn (reference:
         # session/txn.go StmtRollback): a failed statement undoes only its
         # own buffered writes, the transaction stays open
@@ -357,9 +416,11 @@ class Session:
         except Exception as e:
             if not isinstance(stmt, ast.ShowStmt):
                 # SHOW ERRORS reports the failed statement (reference:
-                # fetchShowWarnings(errors=true)); 1105 = generic server
-                # error, the wire layer's own mapping
-                self.last_warnings.append(("Error", 1105, str(e)))
+                # fetchShowWarnings(errors=true)); typed errors carry
+                # their MySQL code (kill 1317, timeout 3024, OOM 8175),
+                # 1105 = generic server error
+                self.last_warnings.append(
+                    ("Error", getattr(e, "mysql_code", 1105), str(e)))
             if cp is not None and self._txn is not None:
                 self._txn.restore(cp)
             elif in_txn_scope and self._txn is not None:
@@ -413,40 +474,103 @@ class Session:
             return self._exec_analyze(stmt)
         if isinstance(stmt, ast.AdminStmt):
             return self._exec_admin(stmt)
+        if isinstance(stmt, ast.KillStmt):
+            # KILL [QUERY] <id> (reference: executor/simple.go Kill +
+            # server.Kill): resolves through the process-global session
+            # registry, so embedded sessions and server connections are
+            # both killable
+            if not interrupt.kill(stmt.conn_id, stmt.query_only):
+                raise SessionError(f"Unknown thread id: {stmt.conn_id}",
+                                   mysql_code=1094)
+            return None
         if isinstance(stmt, ast.EmptyStmt):
             return None
         raise SessionError(f"unsupported statement {type(stmt).__name__}")
 
     # ---- SELECT ---------------------------------------------------------
+    def _use_tpu(self) -> bool:
+        """The device switch, gated by the runtime degradation pin: a
+        mid-statement device loss pins planning to the CPU tier for the
+        tidb_device_cooldown window (ops/degrade.py)."""
+        from ..ops import degrade
+        return bool(self.get_sysvar("tidb_use_tpu")) \
+            and not degrade.cpu_pinned()
+
     def _exec_select(self, stmt: ast.SelectStmt) -> ResultSet:
         from ..obs import context as obs_context
-        from ..obs.runtime_stats import instrument_tree
+        from ..ops import degrade
         qobs = obs_context.current()
         t0 = time.perf_counter()
         builder = PlanBuilder(self)
         with obs_context.span("plan"):
             logical = builder.build_select(stmt)
         columns = [c.name for c in logical.schema.columns]
-        use_tpu = bool(self.get_sysvar("tidb_use_tpu"))
+        use_tpu = self._use_tpu()
         with obs_context.span("place", tpu=use_tpu):
             phys = self._optimize(logical, use_tpu)
         t_plan = time.perf_counter() - t0
         if qobs is not None:
             from ..planner.explain import plan_digest
             qobs.plan_digest = plan_digest(phys)
-        ex = build_executor(phys, use_tpu=use_tpu)
-        instrument_tree(ex, qobs)
-        ex.open(ExecContext(self.get_txn(), self.sysvars,
-                            self.infoschema(), self.storage))
         try:
-            rows = ex.drain()
-        finally:
-            ex.close()
+            rows = self._run_phys(phys, use_tpu, qobs)
+        except Exception as e:
+            # runtime device-loss degradation: a SELECT is read-only, so
+            # one transparent CPU re-execution is safe; anything that is
+            # not a device loss stays a loud statement error
+            if not (use_tpu and degrade.is_device_loss(e)):
+                raise
+            rows = self._degraded_rerun(logical, qobs, e)
         # compile/plan vs run split surfaces in last_query_info (the
         # reference's DurationCompile analogue; exec_s wraps both)
         self._plan_s = t_plan
         return ResultSet(columns, rows,
                          [c.ret_type for c in logical.schema.columns])
+
+    def _run_phys(self, phys, use_tpu: bool, qobs) -> List[list]:
+        from ..obs.runtime_stats import instrument_tree
+        ex = build_executor(phys, use_tpu=use_tpu)
+        instrument_tree(ex, qobs)
+        ex.open(ExecContext(self.get_txn(), self.sysvars,
+                            self.infoschema(), self.storage))
+        try:
+            return ex.drain()
+        finally:
+            ex.close()
+
+    def _degraded_rerun(self, logical, qobs, cause: Exception) \
+            -> List[list]:
+        """The accelerator died mid-SELECT: record the loss, pin the
+        backend to CPU for the cooldown window, and re-execute this one
+        statement on the CPU volcano path (reads only — writes never
+        reach here; their executors surface the error)."""
+        from ..obs import context as obs_context
+        from ..ops import degrade
+        try:
+            cooldown = float(self.get_sysvar("tidb_device_cooldown") or 0)
+        except (TypeError, ValueError):
+            cooldown = degrade.DEFAULT_COOLDOWN_S
+        degrade.record_loss(cooldown)
+        degrade.record_degraded_statement()
+        logging.getLogger("tinysql_tpu").warning(
+            "device lost mid-statement (%s) — re-executing on CPU, "
+            "backend pinned to CPU for %.0fs", cause, cooldown)
+        self.add_warning("Warning", 1105,
+                         f"device lost mid-statement ({cause}); "
+                         "re-executed on the CPU path")
+        # fresh memory tracker for the rerun: the dead TPU attempt's
+        # allocations are not live, and double-counting them would turn
+        # a transient device loss into a spurious quota abort
+        mt = memory.current()
+        mtok = memory.activate(memory.MemTracker(mt.quota)) \
+            if mt is not None else None
+        try:
+            with obs_context.span("degraded-rerun"):
+                phys = self._optimize(logical, False)
+                return self._run_phys(phys, False, qobs)
+        finally:
+            if mtok is not None:
+                memory.deactivate(mtok)
 
     def select_metadata(self, stmt) -> Optional[tuple]:
         """(column names, FieldTypes) of a SELECT WITHOUT executing it —
@@ -493,7 +617,7 @@ class Session:
 
     def _run_select_plan(self, stmt: ast.SelectStmt, txn) -> List[list]:
         builder = PlanBuilder(self)
-        use_tpu = bool(self.get_sysvar("tidb_use_tpu"))
+        use_tpu = self._use_tpu()
         phys = self._optimize(builder.build_select(stmt), use_tpu)
         ex = build_executor(phys, use_tpu=use_tpu)
         ex.open(ExecContext(txn, self.sysvars, self.infoschema(),
@@ -529,7 +653,7 @@ class Session:
         if stmt.where is not None:
             rw = ExprRewriter(plan.schema, builder)
             plan = LogicalSelection(split_cnf(rw.rewrite(stmt.where)), plan)
-        use_tpu = bool(self.get_sysvar("tidb_use_tpu"))
+        use_tpu = self._use_tpu()
         phys = self._optimize(plan, use_tpu)
         txn = self.get_txn()
         ex = build_executor(phys, use_tpu=use_tpu)
@@ -607,12 +731,52 @@ class Session:
         return None
 
     # ---- SET -------------------------------------------------------------
+    #: sysvars that must be non-negative integers, validated AT SET TIME
+    #: (reference: variable sysvar type validation; a bad value must fail
+    #: the SET, not silently disable the feature at read time)
+    _UINT_SYSVARS = ("max_execution_time", "tidb_mem_quota_query")
+
+    @staticmethod
+    def _validate_uint_sysvar(name: str, v: Datum) -> int:
+        if isinstance(v, bool) or isinstance(v, float):
+            # 1232: Incorrect argument type (floats are not valid here)
+            raise SessionError(
+                f"Incorrect argument type to variable '{name}'",
+                mysql_code=1232, sqlstate="42000")
+        if isinstance(v, str):
+            try:
+                v = int(v.strip())
+            except ValueError:
+                raise SessionError(
+                    f"Incorrect argument type to variable '{name}'",
+                    mysql_code=1232, sqlstate="42000")
+        if not isinstance(v, int):
+            raise SessionError(
+                f"Incorrect argument type to variable '{name}'",
+                mysql_code=1232, sqlstate="42000")
+        if v < 0:
+            raise SessionError(
+                f"Variable '{name}' can't be set to the value of '{v}'",
+                mysql_code=1231, sqlstate="42000")
+        return v
+
     def _exec_set(self, stmt: ast.SetStmt) -> None:
         for scope, name, expr in stmt.assignments:
             v = self.eval_const_expr(expr)
             if scope == "user":
                 self.uservars[name] = v
                 continue
+            if name in self._UINT_SYSVARS:
+                v = self._validate_uint_sysvar(name, v)
+            if name == "tidb_failpoints":
+                # validate + apply atomically BEFORE storing: a bad spec
+                # must fail the SET and leave the armed set unchanged
+                from .. import fail
+                try:
+                    fail.configure(str(v) if v else "")
+                except ValueError as e:
+                    raise SessionError(str(e), mysql_code=1231,
+                                       sqlstate="42000")
             if scope == "global":
                 self._globals()[name] = v
             else:
@@ -701,7 +865,7 @@ class Session:
             raise SessionError("EXPLAIN supports SELECT only for now")
         from ..obs import context as obs_context
         builder = PlanBuilder(self)
-        use_tpu = bool(self.get_sysvar("tidb_use_tpu"))
+        use_tpu = self._use_tpu()
         with obs_context.span("plan"):
             logical = builder.build_select(stmt.stmt)
         with obs_context.span("place", tpu=use_tpu):

@@ -1,70 +1,39 @@
-"""Failpoint injection library (reference: pingcap/failpoint — 20 inject
-sites across the reference, SURVEY §5.3).
+"""Back-compat shim over the ``tinysql_tpu.fail`` registry.
 
-Usage at an inject site:
-    failpoint.inject("commitFailed")          # raises if enabled w/ error
-    if failpoint.eval("rpcHang"):             # truthy value if enabled
-        ...
-Tests:
-    with failpoint.enable("commitFailed", exc=IOError("boom")): ...
-    failpoint.enable_times("x", exc=..., times=2)  # fire twice then off
+The original failpoint library grew into a full package (``fail/`` —
+catalogue, env/sysvar arming, action verbs, hit counters); existing
+call sites and tests keep this module's surface.  New code should import
+``tinysql_tpu.fail`` directly.
 """
 from __future__ import annotations
 
 import contextlib
-import threading
-from typing import Any, Dict, Optional
+from typing import Any, Optional
 
-_mu = threading.Lock()
-_points: Dict[str, dict] = {}
+from .. import fail
 
-
-def enable_point(name: str, value: Any = True, exc: Optional[Exception] = None,
-                 times: int = -1) -> None:
-    with _mu:
-        _points[name] = {"value": value, "exc": exc, "times": times}
-
-
-def disable_point(name: str) -> None:
-    with _mu:
-        _points.pop(name, None)
-
-
-def disable_all() -> None:
-    with _mu:
-        _points.clear()
+disable_point = fail.disarm
+disable_all = fail.disarm_all
+inject = fail.inject
+eval = fail.eval_point       # noqa: A001 - mirrors failpoint.Eval
 
 
 @contextlib.contextmanager
-def enable(name: str, value: Any = True, exc: Optional[Exception] = None,
-           times: int = -1):
-    enable_point(name, value, exc, times)
-    try:
+def enable(name: str, value: Any = True,
+           exc: Optional[Exception] = None, times: int = -1):
+    """The OLD positional signature — (name, value, exc, times) — which
+    ``fail.armed`` no longer matches (it grew sleep/panic between exc
+    and times); aliasing it would silently rebind a positional ``times``
+    as a sleep duration."""
+    with fail.armed(name, value=value, exc=exc, times=times):
         yield
-    finally:
-        disable_point(name)
 
 
-def _consume(name: str) -> Optional[dict]:
-    with _mu:
-        p = _points.get(name)
-        if p is None:
-            return None
-        if p["times"] == 0:
-            return None
-        if p["times"] > 0:
-            p["times"] -= 1
-        return p
+def enable_point(name: str, value: Any = True,
+                 exc: Optional[Exception] = None, times: int = -1) -> None:
+    fail.arm(name, value=value, exc=exc, times=times)
 
 
-def eval(name: str) -> Any:  # noqa: A001 - mirrors failpoint.Eval
-    p = _consume(name)
-    if p is None:
-        return None
-    if p["exc"] is not None:
-        raise p["exc"]
-    return p["value"]
-
-
-def inject(name: str) -> None:
-    eval(name)
+def enable_times(name: str, value: Any = True,
+                 exc: Optional[Exception] = None, times: int = 1) -> None:
+    fail.arm(name, value=value, exc=exc, times=times)

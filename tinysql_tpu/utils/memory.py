@@ -1,0 +1,103 @@
+"""Per-query memory quota (reference: util/memory.Tracker +
+``tidb_mem_quota_query`` with the CANCEL OOM action).
+
+A statement whose session sets ``tidb_mem_quota_query > 0`` runs with a
+:class:`MemTracker` installed in a contextvar; the chunk layer
+(chunk/column.py) charges every column-buffer allocation —
+``Column.__init__`` capacity, ``_grow`` deltas, ``from_numpy``
+materializations — against it.  Blowing the quota raises
+:class:`MemQuotaExceeded` (MySQL error 8175), aborting the statement
+through the session's normal error path instead of letting a hash build
+or sort materialization OOM the process.
+
+Accounting model: CUMULATIVE bytes allocated into chunk columns over
+the statement (buffers are not released back on operator close).  That
+is stricter than a live-set tracker for long streaming plans — the
+documented trade for a dependency-free implementation; zero-copy views
+(``Column.wrap_raw`` over replica arrays) are never charged.
+"""
+from __future__ import annotations
+
+import contextvars
+import threading
+from typing import Optional
+
+#: process-total statements aborted by quota (exported to /metrics)
+_aborts_mu = threading.Lock()
+_ABORTS = 0
+
+
+class MemQuotaExceeded(Exception):
+    """TiDB error 8175 (ErrMemoryExceedForQuery)."""
+    mysql_code = 8175
+    sqlstate = "HY000"
+
+    def __init__(self, consumed: int, quota: int):
+        super().__init__(
+            "Out Of Memory Quota! query tried to allocate "
+            f"{consumed} bytes with tidb_mem_quota_query = {quota}")
+        self.consumed = consumed
+        self.quota = quota
+
+
+class MemTracker:
+    """Byte accumulator with a hard quota.  ``consume`` is called from
+    the statement thread and any pipeline producer threads (context is
+    copied across), so it locks."""
+
+    __slots__ = ("quota", "consumed", "_aborted", "_mu")
+
+    def __init__(self, quota: int):
+        self.quota = int(quota)
+        self.consumed = 0
+        self._aborted = False
+        self._mu = threading.Lock()
+
+    def consume(self, n: int) -> None:
+        global _ABORTS
+        if n <= 0:
+            return
+        with self._mu:
+            self.consumed += n
+            over = 0 < self.quota < self.consumed
+            consumed = self.consumed
+            # the statement-abort counter counts STATEMENTS: the first
+            # over-quota consume trips it; re-raises while the doomed
+            # statement unwinds (producer thread, cleanup allocs) don't
+            first = over and not self._aborted
+            if over:
+                self._aborted = True
+        if over:
+            if first:
+                with _aborts_mu:
+                    _ABORTS += 1
+            raise MemQuotaExceeded(consumed, self.quota)
+
+
+_TRACKER: contextvars.ContextVar = contextvars.ContextVar(
+    "tinysql_mem_tracker", default=None)
+
+
+def activate(tracker: MemTracker):
+    return _TRACKER.set(tracker)
+
+
+def deactivate(token) -> None:
+    _TRACKER.reset(token)
+
+
+def current() -> Optional[MemTracker]:
+    return _TRACKER.get()
+
+
+def consume(n: int) -> None:
+    """The allocation hook: charges the active statement's tracker;
+    zero-cost (one contextvar read) when no quota is set."""
+    t = _TRACKER.get()
+    if t is not None:
+        t.consume(n)
+
+
+def aborts_total() -> int:
+    with _aborts_mu:
+        return _ABORTS

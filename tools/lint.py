@@ -39,6 +39,19 @@ LOCK_SCOPE = [
     "tinysql_tpu/executor/devpipe.py",  # BlockPipeline staging queue
 ]
 
+#: retry-path scope of the fail-discipline pass (FP5xx): where raw
+#: time.sleep is banned outside Backoffer and where failpoint inject
+#: sites must name a registered catalogue entry
+FAIL_SCOPE = [
+    "tinysql_tpu/kv",
+    "tinysql_tpu/distsql",
+    "tinysql_tpu/ddl",
+    "tinysql_tpu/ops",
+    "tinysql_tpu/executor",
+    "tinysql_tpu/session",
+    "tinysql_tpu/fail",
+]
+
 
 def _force_cpu_backend() -> None:
     try:
@@ -78,6 +91,16 @@ def run_obs(paths):
     return diags
 
 
+def run_fail(paths):
+    from tinysql_tpu.analysis import gather_sources, lint_fail_discipline
+    diags = []
+    for p in paths:
+        for sf in gather_sources(p):
+            diags.extend(sf.check_suppression_syntax())
+            diags.extend(lint_fail_discipline(sf))
+    return diags
+
+
 def run_plans(fuzz_n=None):
     _force_cpu_backend()
     from tinysql_tpu.analysis.plan_device import check_corpus
@@ -91,9 +114,10 @@ def main(argv=None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="run all passes over their default scopes")
     ap.add_argument("--pass", dest="passes", action="append",
-                    choices=["trace", "locks", "obs", "plans", "all"],
-                    help="which pass(es) to run (default: trace+locks+obs "
-                         "over paths; all under --strict)")
+                    choices=["trace", "locks", "obs", "fail", "plans",
+                             "all"],
+                    help="which pass(es) to run (default: trace+locks+obs"
+                         "+fail over paths; all under --strict)")
     ap.add_argument("--rules", action="store_true",
                     help="print the rule catalogue and exit")
     ap.add_argument("--fuzz-n", type=int, default=None,
@@ -111,9 +135,9 @@ def main(argv=None) -> int:
 
     passes = set(args.passes or [])
     if args.strict or "all" in passes:
-        passes = {"trace", "locks", "obs", "plans"}
+        passes = {"trace", "locks", "obs", "fail", "plans"}
     elif not passes:
-        passes = {"trace", "locks", "obs"}
+        passes = {"trace", "locks", "obs", "fail"}
 
     pkg = os.path.join(REPO_ROOT, "tinysql_tpu")
     paths = args.paths or [pkg]
@@ -127,6 +151,11 @@ def main(argv=None) -> int:
         diags.extend(run_locks(lock_paths))
     if "obs" in passes:
         diags.extend(run_obs(paths))
+    if "fail" in passes:
+        fail_paths = (args.paths if args.paths
+                      else [os.path.join(REPO_ROOT, p)
+                            for p in FAIL_SCOPE])
+        diags.extend(run_fail(fail_paths))
     if "plans" in passes:
         diags.extend(run_plans(args.fuzz_n))
 
