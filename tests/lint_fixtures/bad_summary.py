@@ -1,0 +1,41 @@
+"""OB403 fixture: statement-summary store writes outside the designated
+session statement-close hook.
+
+Every line marked OB403 below must fire the rule; the clean read
+patterns at the bottom must stay silent.  Never imported — parsed by
+test_lint.py.
+"""
+from tinysql_tpu.obs import stmtsummary
+from tinysql_tpu.obs import stmtsummary as sm
+from tinysql_tpu.obs.stmtsummary import STORE, ingest
+
+
+def sneak_aggregation(info, device):
+    stmtsummary.ingest(sql="select 1", stmt_type="select",     # OB403
+                       schema_name="", plan_digest="",
+                       info=info, device=device)
+    STORE.ingest(sql="select 1", stmt_type="select",           # OB403
+                 schema_name="", plan_digest="",
+                 info=info, device=device)
+    ingest(sql="select 1", stmt_type="select", schema_name="",  # OB403
+           plan_digest="", info=info, device=device)
+
+
+def sneak_reset():
+    stmtsummary.STORE.reset()                                  # OB403
+
+
+def sneak_via_module_alias(info, device):
+    sm.ingest(sql="select 1", stmt_type="select",              # OB403
+              schema_name="", plan_digest="",
+              info=info, device=device)
+    sm.STORE.reset()                                           # OB403
+
+
+def clean_reads():
+    # reads are fine anywhere — the mem-table and /metrics render them
+    rows = stmtsummary.rows()
+    snap = stmtsummary.STORE.snapshot()
+    hist = stmtsummary.histogram_snapshot()
+    digest, text = stmtsummary.normalize("select 1")
+    return rows, snap, hist, digest, text

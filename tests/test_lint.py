@@ -237,12 +237,61 @@ def test_stats_fixture_fires_obs_rules():
         [d.format() for d in diags]
 
 
+def test_summary_fixture_fires_ob403():
+    sf = SourceFile(os.path.join(FIXDIR, "bad_summary.py"))
+    diags = lint_obs_discipline(sf)
+    assert [d.rule for d in diags].count("OB403") == 6, \
+        [d.format() for d in diags]
+
+
+def test_summary_writer_modules_exempt(tmp_path):
+    # the session statement-close hook and the store's own module are
+    # THE designated writers
+    for name in ("session.py", "stmtsummary.py"):
+        p = tmp_path / name
+        p.write_text("from tinysql_tpu.obs import stmtsummary\n"
+                     "stmtsummary.ingest(sql='select 1')\n")
+        assert lint_obs_discipline(SourceFile(str(p))) == [], name
+
+
+def test_summary_reads_not_flagged(tmp_path):
+    p = tmp_path / "reader.py"
+    p.write_text("from tinysql_tpu.obs import stmtsummary\n"
+                 "rows = stmtsummary.rows()\n"
+                 "snap = stmtsummary.snapshot()\n"
+                 "h = stmtsummary.histogram_snapshot()\n"
+                 "d, t = stmtsummary.normalize('select 1')\n")
+    assert lint_obs_discipline(SourceFile(str(p))) == []
+
+
 def test_obs_owning_modules_exempt(tmp_path):
     # kernels.py ITSELF may write STATS (it owns the accessors); a file
     # of the same name elsewhere is exempt by basename — the rule's
     # contract is "outside the owning module"
     p = tmp_path / "kernels.py"
     p.write_text("STATS = {}\nSTATS['dispatches'] = 1\n")
+    assert lint_obs_discipline(SourceFile(str(p))) == []
+
+
+def test_owning_modules_not_exempt_from_ob403(tmp_path):
+    # the STATS ownership exemption must not cover the summary store:
+    # kernels/progcache are exactly the modules tempted to push
+    # counters at it
+    p = tmp_path / "kernels.py"
+    p.write_text("from tinysql_tpu.obs import stmtsummary\n"
+                 "stmtsummary.ingest(sql='select 1')\n")
+    diags = lint_obs_discipline(SourceFile(str(p)))
+    assert [d.rule for d in diags] == ["OB403"], diags
+
+
+def test_ob403_ignores_unrelated_ingest_and_store(tmp_path):
+    # a local helper named `ingest` or an unrelated STORE global must
+    # not trip the rule — only names provably from stmtsummary qualify
+    p = tmp_path / "loader.py"
+    p.write_text("STORE = {}\n"
+                 "def ingest(batch):\n    return batch\n"
+                 "ingest([1])\n"
+                 "STORE.clear()\n")
     assert lint_obs_discipline(SourceFile(str(p))) == []
 
 
@@ -294,6 +343,7 @@ def test_corpus_plans_clean():
     ("trace", "bad_suppress.py"),
     ("trace", "bad_pipeline.py"),
     ("obs", "bad_stats.py"),
+    ("obs", "bad_summary.py"),
 ])
 def test_cli_exits_nonzero_on_fixture(passname, fixture):
     r = subprocess.run(

@@ -17,9 +17,17 @@ Rules:
   the owning modules.
 - **OB402**: mutating-method call (``STATS.update/clear/setdefault/
   pop``) on such a target outside the owning modules.
+- **OB403**: statement-summary store write (``stmtsummary.ingest`` /
+  ``STORE.ingest`` / ``.reset``) outside the designated session
+  statement-close hook (``session/session.py _finish_obs``) and the
+  store's own module.  Any other writer double-counts statements or
+  bypasses the window-rotation/eviction accounting behind
+  ``information_schema.statements_summary`` and the /metrics latency
+  histograms.
 
-Reads (``STATS["dispatches"]``, ``dict(STATS)``) are fine anywhere —
-that is what /metrics does.
+Reads (``STATS["dispatches"]``, ``dict(STATS)``, ``stmtsummary.rows()``,
+``snapshot()``, ``histogram_snapshot()``) are fine anywhere — that is
+what /metrics and the mem-tables do.
 """
 from __future__ import annotations
 
@@ -34,12 +42,21 @@ register_rules({
              "kernels.stats_add/stats_hwm so per-query scopes see it",
     "OB402": "mutating STATS method call (update/clear/setdefault/pop) "
              "outside the owning module",
+    "OB403": "statement-summary store write outside the designated "
+             "session statement-close hook",
 })
 
 #: modules that own a STATS dict and its accessors
 OWNING_MODULES = ("kernels.py", "progcache.py")
 
+#: modules allowed to write the statement-summary store: the store
+#: itself and the session statement-close hook that feeds it
+SUMMARY_WRITER_MODULES = ("stmtsummary.py", "session.py")
+
 _MUTATORS = {"update", "clear", "setdefault", "pop", "popitem"}
+
+#: mutating entry points on the summary store / its module facade
+_SUMMARY_WRITERS = {"ingest", "reset"}
 
 
 def _is_stats_target(e: ast.expr) -> bool:
@@ -51,10 +68,84 @@ def _is_stats_target(e: ast.expr) -> bool:
     return False
 
 
-def lint_obs_discipline(sf: SourceFile) -> List[Diagnostic]:
-    if os.path.basename(sf.path) in OWNING_MODULES:
-        return []
+def _is_summary_target(e: ast.expr, module_aliases: set,
+                       store_aliases: set) -> bool:
+    """``stmtsummary`` (under any import alias) / ``obs.stmtsummary`` /
+    ``stmtsummary.STORE`` / a ``STORE`` imported FROM stmtsummary — but
+    not an unrelated module-level ``STORE`` global."""
+    if isinstance(e, ast.Name):
+        return e.id in module_aliases or e.id in store_aliases
+    if isinstance(e, ast.Attribute):
+        if e.attr == "stmtsummary":
+            return True
+        return e.attr == "STORE" \
+            and _is_summary_target(e.value, module_aliases,
+                                   store_aliases)
+    return False
+
+
+def _summary_import_aliases(sf: SourceFile):
+    """(module aliases, writer names, STORE names) bound by any import
+    of stmtsummary — ``from …obs import stmtsummary as sm`` /
+    ``import …obs.stmtsummary as z`` / ``from …stmtsummary import
+    ingest as x, STORE as st``.  Only names provably from stmtsummary
+    qualify, so an unrelated local ``ingest`` helper or ``STORE``
+    global stays silent."""
+    modules, writers, stores = {"stmtsummary"}, set(), set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.rsplit(".", 1)[-1] == "stmtsummary" \
+                        and alias.asname:
+                    modules.add(alias.asname)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.rsplit(".", 1)[-1] == "stmtsummary":
+                for alias in node.names:
+                    if alias.name in _SUMMARY_WRITERS:
+                        writers.add(alias.asname or alias.name)
+                    elif alias.name == "STORE":
+                        stores.add(alias.asname or alias.name)
+            else:
+                for alias in node.names:
+                    if alias.name == "stmtsummary":
+                        modules.add(alias.asname or alias.name)
+    return modules, writers, stores
+
+
+def _lint_summary_writes(sf: SourceFile) -> List[Diagnostic]:
     diags: List[Diagnostic] = []
+    module_aliases, writer_aliases, store_aliases = \
+        _summary_import_aliases(sf)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = (isinstance(f, ast.Attribute)
+               and f.attr in _SUMMARY_WRITERS
+               and _is_summary_target(f.value, module_aliases,
+                                      store_aliases)) \
+            or (isinstance(f, ast.Name) and f.id in writer_aliases)
+        if hit:
+            diags.append(Diagnostic(
+                "OB403",
+                "statement-summary store write — only the session's "
+                "statement-close hook (_finish_obs) may ingest; any "
+                "other writer double-counts or bypasses window/eviction "
+                "accounting",
+                sf.path, node.lineno))
+    return diags
+
+
+def lint_obs_discipline(sf: SourceFile) -> List[Diagnostic]:
+    base = os.path.basename(sf.path)
+    diags: List[Diagnostic] = []
+    # OB403 has its OWN allowlist: the STATS-owning modules are exactly
+    # the ones most tempted to push counters at the summary store, so
+    # the OB401/OB402 ownership exemption must not cover them here
+    if base not in SUMMARY_WRITER_MODULES:
+        diags.extend(_lint_summary_writes(sf))
+    if base in OWNING_MODULES:
+        return sf.filter(diags)
     for node in ast.walk(sf.tree):
         targets = []
         if isinstance(node, ast.Assign):

@@ -1,38 +1,90 @@
 """Virtual INFORMATION_SCHEMA mem-tables (reference: infoschema/tables.go —
 schema-backed tables computed on read, no storage).
 
-Supported: SCHEMATA, TABLES, COLUMNS, STATISTICS (index metadata).
-Rows are produced from the live InfoSchema at query time.
+Supported: SCHEMATA, TABLES, COLUMNS, STATISTICS (index metadata) plus
+the observability tables the volcano executor can scan, join, and
+filter like any other source:
+
+- ``statements_summary``: the windowed per-(sql digest, plan digest)
+  aggregation store (obs/stmtsummary.py);
+- ``processlist``: live sessions from the interruption registry
+  (utils/interrupt.py) joined with their MemTracker bytes and elapsed
+  statement time;
+- ``slow_query``: the structured slow-log ring (obs/slowlog.py).
+
+Rows are produced from the live InfoSchema / obs stores at query time.
+The catalog lists ITSELF: ``information_schema`` appears in SCHEMATA,
+and every mem-table (id -1 = virtual) in TABLES/COLUMNS, so tooling
+that introspects the catalog sees the whole surface.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+import time
+from typing import List, Tuple
 
-from ..mytypes import FieldType, new_int_type, new_string_type
+from ..mytypes import (FieldType, new_int_type, new_real_type,
+                       new_string_type)
 
 DB_NAME = "information_schema"
 
-# table name -> (column name, field type factory)
+_KIND = {"int": new_int_type, "str": new_string_type,
+         "real": new_real_type}
+
+
+def _summary_cols():
+    from ..obs.stmtsummary import COLUMNS
+    return [(name, kind) for name, kind in COLUMNS]
+
+
+# table name -> [(column name, kind)];  statements_summary's layout is
+# owned by obs/stmtsummary.COLUMNS (one definition for store + catalog)
 _TABLES = {
-    "schemata": [("catalog_name", new_string_type),
-                 ("schema_name", new_string_type)],
-    "tables": [("table_schema", new_string_type),
-               ("table_name", new_string_type),
-               ("tidb_table_id", new_int_type)],
-    "columns": [("table_schema", new_string_type),
-                ("table_name", new_string_type),
-                ("column_name", new_string_type),
-                ("ordinal_position", new_int_type),
-                ("data_type", new_string_type),
-                ("is_nullable", new_string_type),
-                ("column_key", new_string_type)],
-    "statistics": [("table_schema", new_string_type),
-                   ("table_name", new_string_type),
-                   ("non_unique", new_int_type),
-                   ("index_name", new_string_type),
-                   ("seq_in_index", new_int_type),
-                   ("column_name", new_string_type)],
+    "schemata": [("catalog_name", "str"),
+                 ("schema_name", "str")],
+    "tables": [("table_schema", "str"),
+               ("table_name", "str"),
+               ("tidb_table_id", "int")],
+    "columns": [("table_schema", "str"),
+                ("table_name", "str"),
+                ("column_name", "str"),
+                ("ordinal_position", "int"),
+                ("data_type", "str"),
+                ("is_nullable", "str"),
+                ("column_key", "str")],
+    "statistics": [("table_schema", "str"),
+                   ("table_name", "str"),
+                   ("non_unique", "int"),
+                   ("index_name", "str"),
+                   ("seq_in_index", "int"),
+                   ("column_name", "str")],
+    "statements_summary": _summary_cols,
+    "statements_summary_history": _summary_cols,
+    "processlist": [("id", "int"),
+                    ("user", "str"),
+                    ("db", "str"),
+                    ("command", "str"),
+                    ("time_ms", "int"),
+                    ("state", "str"),
+                    ("mem_bytes", "int"),
+                    ("info", "str"),
+                    ("plan_digest", "str")],
+    "slow_query": [("time", "str"),
+                   ("conn_id", "int"),
+                   ("db", "str"),
+                   ("success", "int"),
+                   ("total_ms", "real"),
+                   ("parse_ms", "real"),
+                   ("plan_ms", "real"),
+                   ("exec_ms", "real"),
+                   ("plan_digest", "str"),
+                   ("sql_digest", "str"),
+                   ("query", "str")],
 }
+
+
+def _columns_of(table: str) -> List[Tuple[str, str]]:
+    spec = _TABLES[table]
+    return spec() if callable(spec) else spec
 
 
 def is_memtable(db: str, table: str) -> bool:
@@ -40,13 +92,24 @@ def is_memtable(db: str, table: str) -> bool:
 
 
 def memtable_columns(table: str) -> List[Tuple[str, FieldType]]:
-    return [(n, f()) for n, f in _TABLES[table.lower()]]
+    return [(n, _KIND[k]()) for n, k in _columns_of(table.lower())]
 
 
 def memtable_rows(infoschema, table: str) -> List[list]:
     t = table.lower()
+    if t == "statements_summary":
+        from ..obs import stmtsummary
+        return stmtsummary.rows()
+    if t == "statements_summary_history":
+        from ..obs import stmtsummary
+        return stmtsummary.history_rows()
+    if t == "processlist":
+        return _processlist_rows()
+    if t == "slow_query":
+        return _slow_query_rows()
     out: List[list] = []
     if t == "schemata":
+        out.append(["def", DB_NAME])
         for db in infoschema.all_schemas():
             out.append(["def", db.name])
         return out
@@ -66,6 +129,62 @@ def memtable_rows(infoschema, table: str) -> List[list]:
                         out.append([db.name, ti.name,
                                     0 if idx.unique else 1,
                                     idx.name, seq + 1, ic.name])
+    # the catalog's own virtual tables (id -1: no storage behind them)
+    if t == "tables":
+        for name in sorted(_TABLES):
+            out.append([DB_NAME, name, -1])
+    elif t == "columns":
+        for name in sorted(_TABLES):
+            for i, (cn, ft) in enumerate(memtable_columns(name)):
+                out.append([DB_NAME, name, cn, i + 1, _type_name(ft),
+                            "YES", ""])
+    return out
+
+
+def _processlist_rows() -> List[list]:
+    """Live sessions (reference: infoschema PROCESSLIST fed from the
+    server's ShowProcessList): one row per registered session; running
+    statements carry their SQL, elapsed wall, and the statement
+    MemTracker's live byte count."""
+    from ..utils import interrupt
+    now = time.time()
+    out: List[list] = []
+    for cid, sess in interrupt.sessions():
+        running = bool(getattr(sess, "stmt_running", False))
+        qobs = getattr(sess, "last_query_stats", None)
+        elapsed_ms = 0
+        mem = 0
+        info = ""
+        digest = ""
+        if running and qobs is not None:
+            elapsed_ms = int((now - qobs.started_at) * 1e3)
+            info = qobs.sql[:512]
+            digest = qobs.plan_digest
+            mt = getattr(sess, "_stmt_mem", None)
+            if mt is not None:
+                mem = mt.consumed
+        out.append([cid, getattr(sess, "user", "") or "",
+                    getattr(sess, "current_db", ""),
+                    "Query" if running else "Sleep", elapsed_ms,
+                    "executing" if running else "", mem, info, digest])
+    out.sort(key=lambda r: r[0])
+    return out
+
+
+def _slow_query_rows() -> List[list]:
+    from ..obs import slowlog
+    out: List[list] = []
+    for r in slowlog.recent():
+        out.append([r.get("time", ""), int(r.get("conn_id", 0) or 0),
+                    r.get("db", ""),
+                    1 if r.get("success", True) else 0,
+                    float(r.get("total_ms", 0.0)),
+                    float(r.get("parse_ms", 0.0)),
+                    float(r.get("plan_ms", 0.0)),
+                    float(r.get("exec_ms", 0.0)),
+                    r.get("plan_digest", "") or "",
+                    r.get("sql_digest", "") or "",
+                    r.get("sql", "")])
     return out
 
 

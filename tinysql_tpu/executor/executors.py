@@ -113,6 +113,7 @@ class TableReaderExec(Executor):
         self._pos = 0
         self._iter = None
         self._cop = None
+        self._cop_rest = None  # (batch, cursor) of a partially-emitted batch
         self._local_agg = None
         self._hydrate = None
         dirty = (ctx.txn is not None and ctx.storage is not None
@@ -232,13 +233,29 @@ class TableReaderExec(Executor):
         return None if chk is None else self._apply_filters(chk)
 
     def _next_cop(self) -> Optional[Chunk]:
+        # one cop task returns a whole region's batch; emit it in
+        # tidb_max_chunk_size slices so root drain-block boundaries
+        # (kill / deadline checks, processlist progress) stay fine-
+        # grained on large scans.  The leftover rides an integer cursor
+        # (one slice copy per chunk, no quadratic re-slicing).  Pushed-
+        # agg batches are tiny partial results and pass through whole.
+        limit = max(self.ctx.max_chunk_size, 1)
         while True:
-            batch = next(self._cop, None)
-            if batch is None:
-                self._cop = iter(())
-                return None
-            if not batch:
-                continue
+            if self._cop_rest is not None:
+                rest, pos = self._cop_rest
+                batch = rest[pos:pos + limit]
+                pos += limit
+                self._cop_rest = (rest, pos) if pos < len(rest) else None
+            else:
+                batch = next(self._cop, None)
+                if batch is None:
+                    self._cop = iter(())
+                    return None
+                if not batch:
+                    continue
+                if len(batch) > limit and self.scan.pushed_agg is None:
+                    self._cop_rest = (batch, limit)
+                    batch = batch[:limit]
             chk = Chunk(self.field_types(), cap=len(batch))
             for row in batch:
                 chk.append_row(row)
@@ -301,12 +318,17 @@ class TableReaderExec(Executor):
         return Chunk.from_columns(cols), list(self.scan.filters), rep
 
     def _next_fast_raw(self) -> Optional[Chunk]:
-        """Next unfiltered slice of the columnar replica."""
+        """Next unfiltered slice of the columnar replica.  The slice is
+        capped by tidb_max_chunk_size: drain-block boundaries are where
+        statement kill / deadline checks land and where processlist
+        observes progress, so one monolithic slice would make a large
+        scan uninterruptible and invisible."""
         rep = self._replica
         if self._pos >= rep.n_rows:
             self._slice_range = None
             return None
-        lo, hi = self._pos, min(self._pos + self.FAST_CHUNK, rep.n_rows)
+        step = min(self.FAST_CHUNK, max(self.ctx.max_chunk_size, 1))
+        lo, hi = self._pos, min(self._pos + step, rep.n_rows)
         self._pos = hi
         self._slice_range = (lo, hi)
         from ..chunk import Column as CCol
@@ -426,6 +448,7 @@ class TableReaderExec(Executor):
     def close(self) -> None:
         self._iter = None
         self._cop = None
+        self._cop_rest = None
         self._hydrate = None
         super().close()
 

@@ -28,6 +28,12 @@ Three cooperating pieces:
   threshold sysvar ``tidb_slow_log_threshold``), and the bucket-prewarm
   feedback file (`feedback.py`, consumed by ``tools/warm.py
   --from-stats``).
+- **SQL-queryable aggregates** (`stmtsummary.py`): the windowed,
+  evicting per-(sql digest, plan digest) summary store behind
+  ``information_schema.statements_summary`` / ``processlist`` /
+  ``slow_query`` (catalog/memtables.py), ``EXPLAIN FOR CONNECTION``,
+  and the ``/metrics`` per-phase latency histograms.  Written ONLY from
+  the session statement-close hook (qlint OB403).
 
 See docs/OBSERVABILITY.md.
 """

@@ -84,6 +84,9 @@ class QueryObs:
         self.started_at = time.time()
         self.tracer = Tracer()
         self.plan_digest = ""
+        #: rendered EXPLAIN rows of the placed plan (set by the session
+        #: select/explain paths; statements_summary samples them)
+        self.plan_rows = None
         self.info: Dict[str, float] = {}
         self._mu = threading.Lock()
         self._counters: Dict[str, float] = {}

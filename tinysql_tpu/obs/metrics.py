@@ -180,6 +180,32 @@ def render_prometheus() -> str:
     except Exception:
         pass
 
+    # per-phase statement latency histograms, fed from the statement
+    # summary store's ingest path (obs/stmtsummary.py) — the SQL-visible
+    # aggregates and the Prometheus histograms share one write hook
+    try:
+        from .stmtsummary import histogram_snapshot
+        hists = histogram_snapshot()
+    except Exception:
+        hists = {}
+    if any(h["count"] for h in hists.values()):
+        name = "tinysql_stmt_phase_seconds"
+        lines.append(f"# HELP {name} Statement latency by phase "
+                     "(statement summary store)")
+        lines.append(f"# TYPE {name} histogram")
+        for phase in sorted(hists):
+            h = hists[phase]
+            cum = 0
+            for le, count in h["buckets"]:
+                cum += count
+                lines.append(f'{name}_bucket{{phase="{phase}",'
+                             f'le="{le:g}"}} {cum}')
+            lines.append(f'{name}_bucket{{phase="{phase}",le="+Inf"}} '
+                         f'{h["count"]}')
+            lines.append(f'{name}_sum{{phase="{phase}"}} '
+                         f'{_fmt_value(float(h["sum"]))}')
+            lines.append(f'{name}_count{{phase="{phase}"}} {h["count"]}')
+
     from .trace import recent_traces
     emit("tinysql_trace_ring_entries", "Query traces buffered for "
          "/debug/trace", "gauge", [((), len(recent_traces()))])

@@ -5,11 +5,17 @@ Replaces the inline ``logging.warning("slow query ...")`` in
 session/session.py with one JSON object per slow statement: timings,
 plan digest, per-query device counters, and per-operator RuntimeStats —
 enough to answer "where did the time go" without re-running the query.
+``conn_id`` / ``db`` / ``success`` / ``sql_digest`` make every record
+joinable against ``information_schema.statements_summary`` and
+``processlist`` (the ``slow_query`` mem-table reads the ring below).
 
 Destinations:
 - the ``tinysql_tpu.slowlog`` logger (one JSON line per record);
-- an append-only JSONL file when ``TINYSQL_SLOW_LOG`` names a path;
-- an in-process ring (``recent``) for tests and debug endpoints.
+- an append-only JSONL file when ``TINYSQL_SLOW_LOG`` names a path
+  (resolved once per env value, not per record);
+- an in-process ring (``recent``) for tests, debug endpoints, and the
+  ``slow_query`` mem-table — ``TINYSQL_SLOW_LOG_RING`` sizes it
+  (default 64; applied on the next :func:`clear`).
 
 The threshold lives in the ``tidb_slow_log_threshold`` sysvar
 (milliseconds, default 300 — the reference's default).
@@ -22,25 +28,61 @@ import os
 import threading
 import time
 from collections import deque
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 LOGGER = logging.getLogger("tinysql_tpu.slowlog")
 
+DEFAULT_RING = 64
+
+
+def _ring_maxlen() -> int:
+    try:
+        n = int(os.environ.get("TINYSQL_SLOW_LOG_RING", DEFAULT_RING))
+    except ValueError:
+        n = DEFAULT_RING
+    return n if n > 0 else DEFAULT_RING
+
+
 _mu = threading.Lock()
-_RING: deque = deque(maxlen=64)
+_RING: deque = deque(maxlen=_ring_maxlen())
+
+#: (raw env value, resolved absolute path) — the path is resolved ONCE
+#: per distinct env value instead of per record; tests that monkeypatch
+#: the env var get a fresh resolution automatically
+_PATH_CACHE: Tuple[Optional[str], Optional[str]] = (None, None)
 
 
-def build_record(sql: str, info: dict, qobs=None) -> dict:
+def _log_path() -> Optional[str]:
+    global _PATH_CACHE
+    raw = os.environ.get("TINYSQL_SLOW_LOG")
+    cached_raw, cached_path = _PATH_CACHE
+    if raw == cached_raw:
+        return cached_path
+    path = os.path.abspath(raw) if raw else None
+    _PATH_CACHE = (raw, path)
+    return path
+
+
+def build_record(sql: str, info: dict, qobs=None, *, conn_id: int = 0,
+                 db: str = "", success: bool = True,
+                 sql_digest: str = "") -> dict:
     """One slow-log record; ``info`` is the session's per-statement
-    timing dict (parse_s is the per-BATCH parse wall, reported once)."""
+    timing dict (parse_s is the per-BATCH parse wall, reported once).
+    ``conn_id``/``db``/``success``/``sql_digest`` are the join keys the
+    ``slow_query`` mem-table exposes."""
     rec = {
         "time": time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime()),
         "sql": sql[:2048].replace("\n", " "),
+        "conn_id": int(conn_id),
+        "db": db,
+        "success": bool(success),
         "total_ms": round(info.get("total_s", 0.0) * 1e3, 3),
         "parse_ms": round(info.get("parse_s", 0.0) * 1e3, 3),
         "plan_ms": round(info.get("plan_s", 0.0) * 1e3, 3),
         "exec_ms": round(info.get("exec_s", 0.0) * 1e3, 3),
     }
+    if sql_digest:
+        rec["sql_digest"] = sql_digest
     if qobs is not None:
         rec["plan_digest"] = qobs.plan_digest
         rec["device"] = qobs.device_totals()
@@ -51,7 +93,7 @@ def build_record(sql: str, info: dict, qobs=None) -> dict:
 def log_slow(record: dict) -> None:
     line = json.dumps(record, default=str, sort_keys=True)
     LOGGER.warning("%s", line)
-    path = os.environ.get("TINYSQL_SLOW_LOG")
+    path = _log_path()
     if path:
         try:
             with open(path, "a", encoding="utf-8") as f:
@@ -69,5 +111,8 @@ def recent(n: Optional[int] = None) -> List[dict]:
 
 
 def clear() -> None:
+    """Drop buffered records; re-reads ``TINYSQL_SLOW_LOG_RING`` so
+    tests can resize the ring without reloading the module."""
+    global _RING
     with _mu:
-        _RING.clear()
+        _RING = deque(maxlen=_ring_maxlen())

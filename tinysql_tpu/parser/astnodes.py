@@ -24,7 +24,10 @@ class ExprNode(Node):
 
 
 class StmtNode(Node):
-    pass
+    #: this statement's own source slice within the parsed batch
+    #: (Parser.parse fills it in) — the observability layer normalizes
+    #: and samples THIS, never the display label a batch decorates
+    src: str = ""
 
 
 # ---------------- expressions ----------------------------------------------
@@ -330,6 +333,9 @@ class RollbackStmt(StmtNode):
 class ExplainStmt(StmtNode):
     stmt: StmtNode = None
     analyze: bool = False
+    # EXPLAIN FOR CONNECTION <id>: render the target session's last
+    # plan via the interrupt registry (stmt is None in that form)
+    for_conn: Optional[int] = None
 
 
 @dataclass

@@ -90,7 +90,14 @@ class Parser:
         while self._cur().kind != T_EOF:
             if self._accept_op(";"):
                 continue
-            stmts.append(self._statement())
+            start = self._cur().pos
+            stmt = self._statement()
+            end = self._cur().pos if self._cur().kind != T_EOF \
+                else len(sql)
+            # the statement's OWN source slice: digest normalization and
+            # sampling must see it, not a batch-decorated display label
+            stmt.src = sql[start:end].strip().rstrip(";").rstrip()
+            stmts.append(stmt)
             if self._cur().kind != T_EOF:
                 self._expect_op(";")
         return stmts
@@ -646,6 +653,8 @@ class Parser:
             stmt = ShowStmt("indexes", table=self._table_name())
         elif self._accept_kw("variables"):
             stmt = ShowStmt("variables", global_scope=glob)
+        elif self._accept_kw("processlist"):
+            stmt = ShowStmt("processlist")
         elif self._accept_kw("warnings"):
             stmt = ShowStmt("warnings")
         elif self._accept_kw("errors"):
@@ -711,6 +720,11 @@ class Parser:
             # DESC t == SHOW COLUMNS FROM t
             return ShowStmt("columns", table=self._table_name())
         analyze = bool(self._accept_kw("analyze"))
+        if not analyze and self._accept_kw("for"):
+            # EXPLAIN FOR CONNECTION <id> (reference: common_plans.go
+            # ExplainFor — the plan of whatever the target conn ran last)
+            self._expect_kw("connection")
+            return ExplainStmt(None, for_conn=self._uint_literal())
         return ExplainStmt(self._statement(), analyze=analyze)
 
     def _admin_stmt(self) -> AdminStmt:

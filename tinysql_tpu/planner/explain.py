@@ -4,6 +4,7 @@ RuntimeStats for the ANALYZE columns)."""
 from __future__ import annotations
 
 import hashlib
+import re
 from typing import List, Optional
 
 from .physical import (PhysicalHashAgg, PhysicalHashJoin,
@@ -110,10 +111,21 @@ def explain_text(p: PhysicalPlan, depth: int = 0,
     return out
 
 
+_COL_ID_RE = re.compile(r"col#(\d+)")
+
+
 def plan_digest(p: PhysicalPlan) -> str:
     """Stable digest of the plan SHAPE (operator tree + operator info,
-    estimates excluded so stats drift keeps the digest) — the slow-log /
-    feedback-file join key (reference: plan digest in the slow log)."""
+    estimates excluded so stats drift keeps the digest) — the join key
+    across the slow log, the feedback file, and
+    ``information_schema.statements_summary`` (reference: plan digest in
+    the slow log).
+
+    Column references render as ``col#<unique_id>`` from a PROCESS-GLOBAL
+    allocator, so re-planning the identical statement produces fresh ids;
+    they are canonicalized to first-seen order here — without this, no
+    two executions ever shared a digest and every digest join was
+    silently empty."""
     parts: List[str] = []
 
     def walk(n, depth):
@@ -124,7 +136,17 @@ def plan_digest(p: PhysicalPlan) -> str:
             walk(c, depth + 1)
 
     walk(p, 0)
-    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:16]
+    text = "|".join(parts)
+    seen: dict = {}
+
+    def canon(m):
+        uid = m.group(1)
+        if uid not in seen:
+            seen[uid] = len(seen)
+        return f"col#{seen[uid]}"
+
+    text = _COL_ID_RE.sub(canon, text)
+    return hashlib.sha1(text.encode()).hexdigest()[:16]
 
 
 # ---- EXPLAIN ANALYZE -----------------------------------------------------

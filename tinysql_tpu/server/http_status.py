@@ -52,6 +52,11 @@ def _make_handler(server_ref):
                 from ..obs.slowlog import recent
                 self._send(200, json.dumps(recent(), default=str).encode())
                 return
+            if parsed.path == "/debug/stmtsummary":
+                from ..obs.stmtsummary import snapshot
+                self._send(200, json.dumps(snapshot(),
+                                           default=str).encode())
+                return
             if parsed.path == "/status":
                 from ..server.protocol import SERVER_VERSION
                 body = json.dumps({
@@ -75,6 +80,7 @@ def _make_handler(server_ref):
                            b'<a href="/metrics">metrics</a> '
                            b'<a href="/debug/trace">traces</a> '
                            b'<a href="/debug/slowlog">slowlog</a> '
+                           b'<a href="/debug/stmtsummary">stmtsummary</a> '
                            b'<a href="/debug/threads">threads</a>',
                            "text/html")
             else:

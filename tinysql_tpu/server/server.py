@@ -96,6 +96,7 @@ class ClientConn:
                 self.io.write_packet(p.err_packet(1049, str(e), "42000"))
                 return False
         self.user = resp["user"]
+        self.session.user = resp["user"]  # PROCESSLIST identity
         self.io.write_packet(p.ok_packet())
         return True
 
@@ -265,7 +266,7 @@ class ClientConn:
                 1064, "prepared statement must be a single statement",
                 "42000"))
             return
-        rs = self.session._execute_stmt(stmts[0])
+        rs = self.session.execute_stmt(stmts[0], sql)
         if isinstance(rs, ResultSet):
             self._write_resultset(rs, binary=True)
         else:
@@ -284,8 +285,12 @@ class ClientConn:
             return
         for i, stmt in enumerate(stmts):
             more = i + 1 < len(stmts)
+            label = sql if len(stmts) == 1 else \
+                f"{sql[:200]} [stmt {i + 1}/{len(stmts)}]"
             try:
-                rs = self.session._execute_stmt(stmt)
+                # the full-lifecycle entry: wire statements get QueryObs
+                # scopes, summary/slow-log records, and processlist info
+                rs = self.session.execute_stmt(stmt, label)
             except Exception as e:
                 log.debug("query error: %s", e)
                 self.io.write_packet(_err_packet_for(e))

@@ -79,6 +79,14 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # tidb_slow_log_threshold, default 300): statements whose exec wall
     # exceeds it emit a structured JSONL record (obs/slowlog.py)
     "tidb_slow_log_threshold": 300,
+    # statement-summary window length in SECONDS: when the current
+    # aggregation window of information_schema.statements_summary is
+    # older, it rotates into bounded history (obs/stmtsummary.py)
+    "tidb_stmt_summary_refresh_interval": 1800,
+    # max distinct (sql digest, plan digest) keys per summary window;
+    # beyond it the least-recently-seen record folds into the single
+    # 'evicted' tombstone row
+    "tidb_stmt_summary_max_stmt_count": 200,
     "sql_mode": "STRICT_TRANS_TABLES",
     # SELECT wall-clock budget in MILLISECONDS (0 = unlimited): checked
     # at every block boundary (utils/interrupt.py), surfaces MySQL 3024
@@ -160,6 +168,18 @@ class Session:
         # the last statement's observability scope (obs/context.QueryObs):
         # per-query device counters, per-operator RuntimeStats, span trace
         self.last_query_stats = None
+        # live-statement state surfaced by information_schema.processlist:
+        # stmt_running flips inside _execute_stmt; _stmt_mem is the
+        # always-installed per-statement MemTracker (quota 0 = track only)
+        self.stmt_running = False
+        self._stmt_mem = None
+        # rendered EXPLAIN rows of the last planned statement — the
+        # EXPLAIN FOR CONNECTION <id> payload (set before execution so a
+        # live statement's plan is readable from another session)
+        self.last_plan_rows = None
+        # wire identity (the server fills this in after auth; embedded
+        # sessions have no user)
+        self.user = ""
         # statement interruption (utils/interrupt.py): a process-unique
         # connection id (the KILL target / server thread id) + the guard
         # any thread may flip to abort the running statement
@@ -257,7 +277,6 @@ class Session:
 
     # ---- entry -----------------------------------------------------------
     def execute(self, sql: str) -> List[Optional[ResultSet]]:
-        from ..obs import context as obs_context
         t0 = time.perf_counter()
         stmts = parse(sql)
         t_parse = time.perf_counter() - t0
@@ -267,35 +286,16 @@ class Session:
             for i, s in enumerate(stmts):
                 label = sql if len(stmts) == 1 else \
                     f"{sql[:200]} [stmt {i + 1}/{len(stmts)}]"
-                qobs = obs_context.QueryObs(sql=label)
-                if i == 0:
-                    # TRUE per-batch parse wall, reported ONCE — not
-                    # amortized into every statement and re-added to each
-                    # statement's total_s
-                    qobs.tracer.add_complete(
-                        "parse", t0, t_parse,
-                        args={"statements": len(stmts)})
-                tok = obs_context.activate(qobs)
-                self.last_query_stats = qobs
-                t1 = time.perf_counter()
-                self._plan_s = 0.0
-                err = True
                 try:
-                    with obs_context.span("execute",
-                                          kind=type(s).__name__):
-                        out.append(self._execute_stmt(s))
-                    err = False
+                    out.append(self._execute_one(
+                        s, label,
+                        parse_wall=t_parse if i == 0 else 0.0,
+                        parse_t0=t0 if i == 0 else None,
+                        n_stmts=len(stmts)))
                 finally:
-                    obs_context.deactivate(tok)
-                    t_exec = time.perf_counter() - t1
-                    parse_share = t_parse if i == 0 else 0.0
-                    info = {"parse_s": parse_share,
-                            "plan_s": self._plan_s,
-                            "exec_s": t_exec,
-                            "total_s": parse_share + t_exec}
-                    stmt_infos.append(info)
-                    qobs.info = info
-                    self._finish_obs(s, qobs, info, err)
+                    q = self.last_query_stats
+                    if q is not None and q.info:
+                        stmt_infos.append(q.info)
         finally:
             if stmt_infos:
                 # batch scope throughout, so the fields ADD UP: total =
@@ -311,13 +311,59 @@ class Session:
                 }
         return out
 
+    def execute_stmt(self, stmt: ast.StmtNode,
+                     sql_text: str = "") -> Optional[ResultSet]:
+        """One pre-parsed statement under the FULL observability
+        lifecycle (QueryObs scope, statement-summary ingest, slow log,
+        trace ring) — the server's COM_QUERY / COM_STMT_EXECUTE entry,
+        so wire connections are first-class obs citizens exactly like
+        :meth:`execute` callers."""
+        return self._execute_one(stmt, sql_text or type(stmt).__name__)
+
+    def _execute_one(self, s: ast.StmtNode, label: str,
+                     parse_wall: float = 0.0,
+                     parse_t0: Optional[float] = None,
+                     n_stmts: int = 1) -> Optional[ResultSet]:
+        from ..obs import context as obs_context
+        qobs = obs_context.QueryObs(sql=label)
+        if parse_t0 is not None:
+            # TRUE per-batch parse wall, reported ONCE — not amortized
+            # into every statement and re-added to each total_s
+            qobs.tracer.add_complete("parse", parse_t0, parse_wall,
+                                     args={"statements": n_stmts})
+        tok = obs_context.activate(qobs)
+        self.last_query_stats = qobs
+        t1 = time.perf_counter()
+        self._plan_s = 0.0
+        err = True
+        n_rows = 0
+        try:
+            with obs_context.span("execute", kind=type(s).__name__):
+                rs = self._execute_stmt(s)
+            n_rows = len(rs.rows) if isinstance(rs, ResultSet) \
+                else self.last_affected
+            err = False
+            return rs
+        finally:
+            obs_context.deactivate(tok)
+            t_exec = time.perf_counter() - t1
+            info = {"parse_s": parse_wall,
+                    "plan_s": self._plan_s,
+                    "exec_s": t_exec,
+                    "total_s": parse_wall + t_exec}
+            qobs.info = info
+            self._finish_obs(s, qobs, info, err, n_rows)
+
     def _finish_obs(self, stmt: ast.StmtNode, qobs, info: Dict[str, float],
-                    err: bool) -> None:
+                    err: bool, rows_returned: int = 0) -> None:
         """Post-statement observability fan-out: query metrics, the trace
-        ring (/debug/trace), the structured slow-query log, and the
-        bucket-prewarm feedback file.  Never raises."""
+        ring (/debug/trace), the structured slow-query log, the
+        statement-summary store (THE designated stmtsummary write hook —
+        qlint OB403), and the bucket-prewarm feedback file.  Never
+        raises."""
         from ..obs import metrics as obs_metrics
         from ..obs import slowlog as obs_slowlog
+        from ..obs import stmtsummary
         from ..obs.feedback import maybe_emit
         from ..obs.trace import publish_trace
         try:
@@ -349,9 +395,42 @@ class Session:
                     "total_ms": round(total_ms, 3), "error": err,
                     "spans": qobs.tracer.spans(),
                 })
+            # digest/sample from the statement's OWN source slice: a
+            # batch label ("... [stmt 2/3]") would fall back to raw-text
+            # normalization and never share a digest with the
+            # standalone form
+            src = getattr(stmt, "src", "") or qobs.sql
+            sql_digest = digest_text = ""
+            if not isinstance(stmt, ast.EmptyStmt):
+                sql_digest, digest_text = stmtsummary.normalize(src)
             if slow:
-                obs_slowlog.log_slow(
-                    obs_slowlog.build_record(qobs.sql, info, qobs))
+                obs_slowlog.log_slow(obs_slowlog.build_record(
+                    src, info, qobs, conn_id=self.conn_id,
+                    db=self.current_db, success=not err,
+                    sql_digest=sql_digest))
+            if not isinstance(stmt, ast.EmptyStmt):
+                try:
+                    interval = float(self.get_sysvar(
+                        "tidb_stmt_summary_refresh_interval") or 0)
+                except (TypeError, ValueError):
+                    interval = stmtsummary.DEFAULT_REFRESH_INTERVAL_S
+                try:
+                    max_count = int(self.get_sysvar(
+                        "tidb_stmt_summary_max_stmt_count") or 0)
+                except (TypeError, ValueError):
+                    max_count = stmtsummary.DEFAULT_MAX_STMT_COUNT
+                mem = self._stmt_mem.consumed \
+                    if self._stmt_mem is not None else 0
+                stmtsummary.ingest(
+                    sql=src, sql_digest=sql_digest,
+                    digest_text=digest_text, stmt_type=kind,
+                    schema_name=self.current_db,
+                    plan_digest=qobs.plan_digest, info=info,
+                    device=qobs.device_totals(),
+                    rows_returned=rows_returned, error=err, max_mem=mem,
+                    plan_rows=qobs.plan_rows,
+                    refresh_interval_s=interval,
+                    max_stmt_count=max_count)
             if not err:
                 maybe_emit(qobs)
         except Exception:
@@ -381,18 +460,21 @@ class Session:
                 deadline = time.monotonic() + met / 1000.0
         self.guard.begin(deadline)
         gtok = interrupt.activate(self.guard)
-        mtok = None
         try:
             quota = int(self.get_sysvar("tidb_mem_quota_query") or 0)
         except (TypeError, ValueError):
             quota = 0
-        if quota > 0:
-            mtok = memory.activate(memory.MemTracker(quota))
+        # the tracker is ALWAYS installed (quota 0 = track, never abort):
+        # information_schema.processlist reports its live byte count and
+        # statements_summary its per-statement high-water mark
+        self._stmt_mem = memory.MemTracker(quota if quota > 0 else 0)
+        mtok = memory.activate(self._stmt_mem)
+        self.stmt_running = True
         try:
             return self._execute_stmt_guarded(stmt)
         finally:
-            if mtok is not None:
-                memory.deactivate(mtok)
+            self.stmt_running = False
+            memory.deactivate(mtok)
             interrupt.deactivate(gtok)
 
     def _execute_stmt_guarded(self, stmt: ast.StmtNode) \
@@ -509,9 +591,13 @@ class Session:
         with obs_context.span("place", tpu=use_tpu):
             phys = self._optimize(logical, use_tpu)
         t_plan = time.perf_counter() - t0
+        from ..planner.explain import explain_text, plan_digest
+        # published BEFORE execution: a concurrently-running statement's
+        # plan is readable via EXPLAIN FOR CONNECTION <id> / processlist
+        self.last_plan_rows = explain_text(phys)
         if qobs is not None:
-            from ..planner.explain import plan_digest
             qobs.plan_digest = plan_digest(phys)
+            qobs.plan_rows = self.last_plan_rows
         try:
             rows = self._run_phys(phys, use_tpu, qobs)
         except Exception as e:
@@ -734,7 +820,9 @@ class Session:
     #: sysvars that must be non-negative integers, validated AT SET TIME
     #: (reference: variable sysvar type validation; a bad value must fail
     #: the SET, not silently disable the feature at read time)
-    _UINT_SYSVARS = ("max_execution_time", "tidb_mem_quota_query")
+    _UINT_SYSVARS = ("max_execution_time", "tidb_mem_quota_query",
+                     "tidb_stmt_summary_refresh_interval",
+                     "tidb_stmt_summary_max_stmt_count")
 
     @staticmethod
     def _validate_uint_sysvar(name: str, v: Datum) -> int:
@@ -853,6 +941,19 @@ class Session:
                 ["Database", "Create Database"],
                 [[d.name, f"CREATE DATABASE `{d.name}` /*!40100 DEFAULT "
                           "CHARACTER SET utf8mb4 */"]])
+        if stmt.tp == "processlist":
+            # SHOW [FULL] PROCESSLIST (reference: executor/show.go
+            # fetchShowProcessList) — same feed as the
+            # information_schema.processlist mem-table
+            from ..catalog.memtables import memtable_rows
+            rows = []
+            for (cid, user, db, cmd, time_ms, state, mem,
+                 info, _digest) in memtable_rows(isc, "processlist"):
+                info_out = info if stmt.full else info[:100]
+                rows.append([cid, user, "", db, cmd, time_ms // 1000,
+                             state, info_out, mem])
+            return ResultSet(["Id", "User", "Host", "db", "Command",
+                              "Time", "State", "Info", "Mem"], rows)
         if stmt.tp in ("warnings", "errors"):
             rows = [[lv, cd, msg] for lv, cd, msg in self.last_warnings
                     if stmt.tp == "warnings" or lv == "Error"]
@@ -861,6 +962,24 @@ class Session:
 
     # ---- EXPLAIN ---------------------------------------------------------
     def _exec_explain(self, stmt: ast.ExplainStmt) -> ResultSet:
+        if stmt.for_conn is not None:
+            # EXPLAIN FOR CONNECTION <id> (reference: common_plans.go
+            # ExplainFor): render the target session's last placed plan
+            # through the process-global registry — works for live
+            # statements (the plan publishes before execution) and for
+            # idle connections (their most recent plan)
+            target = interrupt.lookup(stmt.for_conn)
+            if target is None:
+                raise SessionError(
+                    f"Unknown thread id: {stmt.for_conn}",
+                    mysql_code=1094)
+            rows = getattr(target, "last_plan_rows", None)
+            if not rows:
+                raise SessionError(
+                    f"connection {stmt.for_conn} has no recorded plan "
+                    "(no SELECT/EXPLAIN executed yet)")
+            return ResultSet(["id", "estRows", "task", "operator info"],
+                             [list(r) for r in rows])
         if not isinstance(stmt.stmt, ast.SelectStmt):
             raise SessionError("EXPLAIN supports SELECT only for now")
         from ..obs import context as obs_context
@@ -878,10 +997,12 @@ class Session:
             from ..obs.runtime_stats import instrument_tree
             from ..planner.explain import (EXPLAIN_ANALYZE_COLUMNS,
                                            explain_analyze_text,
-                                           plan_digest)
+                                           explain_text, plan_digest)
+            self.last_plan_rows = explain_text(phys)
             qobs = obs_context.current()
             if qobs is not None:
                 qobs.plan_digest = plan_digest(phys)
+                qobs.plan_rows = self.last_plan_rows
             ex = build_executor(phys, use_tpu=use_tpu)
             instrument_tree(ex, qobs)
             ex.open(ExecContext(self.get_txn(), self.sysvars,
@@ -894,6 +1015,7 @@ class Session:
                              explain_analyze_text(phys, qobs))
         from ..planner.explain import explain_text
         rows = explain_text(phys)
+        self.last_plan_rows = rows
         return ResultSet(["id", "estRows", "task", "operator info"], rows)
 
     @property

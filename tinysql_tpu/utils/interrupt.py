@@ -133,6 +133,21 @@ def lookup(conn_id: int):
     return ref() if ref is not None else None
 
 
+def sessions():
+    """Snapshot of live registered sessions as ``(conn_id, session)``
+    pairs (the ``information_schema.processlist`` feed).  Dead weakrefs
+    are skipped; the strong refs live only as long as the caller's
+    iteration."""
+    with _reg_mu:
+        refs = list(_SESSIONS.items())
+    out = []
+    for cid, ref in refs:
+        sess = ref()
+        if sess is not None:
+            out.append((cid, sess))
+    return out
+
+
 def kill(conn_id: int, query_only: bool = True) -> bool:
     """KILL [QUERY] <conn_id>.  Returns False when the id is unknown.
     ``query_only=False`` (plain KILL) also marks the session killed so

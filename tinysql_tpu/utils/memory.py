@@ -43,7 +43,11 @@ class MemQuotaExceeded(Exception):
 class MemTracker:
     """Byte accumulator with a hard quota.  ``consume`` is called from
     the statement thread and any pipeline producer threads (context is
-    copied across), so it locks."""
+    copied across).  With a quota armed it locks (the abort decision
+    must see a consistent total); with quota 0 — the always-installed
+    tracker feeding ``processlist.mem_bytes`` — it is a bare ``+=``:
+    display-only accounting tolerates the rare torn update under
+    producer threads, and the hot allocation path stays lock-free."""
 
     __slots__ = ("quota", "consumed", "_aborted", "_mu")
 
@@ -56,6 +60,9 @@ class MemTracker:
     def consume(self, n: int) -> None:
         global _ABORTS
         if n <= 0:
+            return
+        if self.quota <= 0:
+            self.consumed += n
             return
         with self._mu:
             self.consumed += n
@@ -91,8 +98,11 @@ def current() -> Optional[MemTracker]:
 
 
 def consume(n: int) -> None:
-    """The allocation hook: charges the active statement's tracker;
-    zero-cost (one contextvar read) when no quota is set."""
+    """The allocation hook: charges the active statement's tracker —
+    one contextvar read plus a lock-free ``+=`` without a quota (the
+    session installs a quota-0 tracker for every statement so
+    ``processlist`` can report live bytes), the locked quota path
+    otherwise; a bare contextvar read outside any statement."""
     t = _TRACKER.get()
     if t is not None:
         t.consume(n)

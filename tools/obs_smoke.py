@@ -8,8 +8,15 @@ End-to-end assertion chain over a tiny TPC-H load:
 2. ``EXPLAIN ANALYZE`` Q6 and Q1 — the ROOT operator's actRows must
    equal the executed result cardinality;
 3. a ``StatusServer`` must serve ``/metrics`` exposing a nonzero
-   ``tinysql_dispatches_total`` and a ``/debug/trace`` ring containing
-   the statements above.
+   ``tinysql_dispatches_total``, per-phase latency histogram buckets
+   sourced from the statement summary store, and a ``/debug/trace``
+   ring containing the statements above;
+4. the SQL-queryable observability surface: aggregated
+   ``information_schema.statements_summary`` rows with device counters,
+   ``EXPLAIN FOR CONNECTION`` rendering the session's last plan, and —
+   through a REAL MySQL-protocol connection — a wire-level
+   ``SELECT ... FROM information_schema.statements_summary`` plus
+   ``SHOW PROCESSLIST`` showing the connection itself.
 
 Exit 0 on success; prints one line per check.
 """
@@ -81,6 +88,11 @@ def main() -> int:
                 val = float(line.split()[-1])
         check("/metrics tinysql_dispatches_total nonzero", val > 0,
               f"value={val}")
+        hist_lines = [l for l in text.splitlines()
+                      if l.startswith("tinysql_stmt_phase_seconds_bucket")]
+        check("/metrics per-phase latency histogram buckets",
+              any('phase="exec"' in l for l in hist_lines),
+              f"{len(hist_lines)} bucket lines")
         with urlopen(f"http://127.0.0.1:{st.port}/debug/trace?n=4",
                      timeout=10) as r:
             traces = json.loads(r.read().decode())
@@ -89,6 +101,39 @@ def main() -> int:
               f"{len(traces)} entries")
     finally:
         st.close()
+
+    # 4. SQL-queryable observability: statements_summary aggregates the
+    # runs above per plan digest, with the device economics attached
+    rs = s.query(
+        "select digest_text, exec_count, sum_exec_ms, dispatches, "
+        "d2h_bytes from information_schema.statements_summary")
+    agg = [r for r in rs.rows if str(r[0]).startswith("select")
+           and int(r[1]) >= 2 and int(r[3]) > 0]
+    check("statements_summary aggregates device counters per digest",
+          bool(agg), f"{len(rs.rows)} rows, {len(agg)} aggregated")
+    ex = s.query(f"explain for connection {s.conn_id}")
+    check("EXPLAIN FOR CONNECTION renders the last plan",
+          len(ex.rows) > 0, f"{len(ex.rows)} plan rows")
+
+    # 5. wire level: the same tables through the MySQL protocol server
+    from tinysql_tpu.server.server import Server
+    from tests.test_server import MiniClient
+    srv = Server(s.storage, port=0)
+    srv.start()
+    try:
+        c = MiniClient(srv.port)
+        cols, rows = c.query("select digest, exec_count from "
+                             "information_schema.statements_summary")
+        check("wire SELECT from statements_summary",
+              cols == ["digest", "exec_count"] and len(rows) > 0,
+              f"{len(rows)} rows")
+        cols, rows = c.query("show processlist")
+        check("wire SHOW PROCESSLIST includes the live connection",
+              any(r[4] == "Query" and "processlist" in (r[7] or "")
+                  for r in rows), str(rows))
+        c.close()
+    finally:
+        srv.close()
     print("[obs-smoke] all checks passed")
     return 0
 
