@@ -1,0 +1,44 @@
+"""qlint fixture: query constants baked into device closures (TS107).
+
+An expression builder that extracts a ``Constant.value`` into a Python
+scalar and lets the traced closure capture it FREELY bakes the literal
+into the XLA program signature — every distinct constant then pays its
+own 15s+ compile (the cold-start bug class literal parameterization
+kills).  The sanctioned forms are an ``exprjit.ParamTable`` slot read at
+runtime, or binding the SLOT INDEX as a default parameter.  Never
+imported, only parsed.
+"""
+
+
+def build_const(e, jn):
+    val = e.value                       # query constant extracted...
+    cval = int(val)                     # ...and transformed (still a
+    threshold = cval * 2                # ...constant, transitively)
+
+    def const_fn(cols):
+        n = cols[0][0].shape[0]
+        full = jn.full((n,), cval)      # TS107: literal baked into trace
+        mask = cols[1][0] > threshold   # TS107: transitively derived
+        return full, mask
+    return const_fn
+
+
+def build_param(e, jn, pt):
+    slot = pt.add_int(e.value)          # ParamTable slot: the right way
+    is_null = e.value is None
+
+    def const_fn(cols, params, slot=slot, is_null=is_null):
+        # slot/is_null ride DEFAULT parameters (bound, not free): the
+        # traced program reads the runtime operand vector — no bake
+        n = cols[0][0].shape[0]
+        v = jn.full((n,), 1) * params[0][slot]
+        return v, jn.full((n,), is_null, dtype=bool)
+    return const_fn
+
+
+def build_host(e):
+    val = e.value
+
+    def host_helper(rows):              # no `cols` convention, not jitted:
+        return [r for r in rows if r == val]    # host code — fine
+    return host_helper

@@ -370,6 +370,36 @@ def _slow_next(tk):
         assert s.query("select count(*) from t").rows == [[500]]
 
 
+@chaos("prewarmCompileError")
+def _prewarm_compile_error(tk):
+    """An injected compile failure in one family must be counted, start
+    that family's cooldown, and leave the worker serving later cycles —
+    never wedge the thread or surface to any query path."""
+    from tinysql_tpu.obs import stmtsummary
+    from tinysql_tpu.session.prewarm import PrewarmWorker, stats_snapshot
+    s, _ = tk
+    stmtsummary.STORE.reset()  # rank over THIS test's family only
+    s.query("select b, count(*) from t group by b")
+    s.storage._global_vars["tidb_auto_prewarm"] = 1
+    s.storage._global_vars["tidb_auto_prewarm_cooldown"] = 0
+    w = PrewarmWorker(s.storage)
+    try:
+        errs0 = stats_snapshot()["errors"]
+        with fail.armed("prewarmCompileError",
+                        exc=RuntimeError("injected compile failure")):
+            rep = w.run_cycle()
+        assert rep["errors"] >= 1 and not rep["warmed"]
+        assert stats_snapshot()["errors"] > errs0
+        # disarmed next cycle: the worker is NOT wedged — the same
+        # family (cooldown 0) warms cleanly
+        rep2 = w.run_cycle()
+        assert rep2["errors"] == 0 and rep2["warmed"], rep2
+    finally:
+        w.close()
+        s.storage._global_vars.pop("tidb_auto_prewarm", None)
+        s.storage._global_vars.pop("tidb_auto_prewarm_cooldown", None)
+
+
 def test_chaos_covers_entire_catalogue():
     """A failpoint registered without a chaos driver is a seam nobody
     proved degrades cleanly — fail loudly right here."""

@@ -89,7 +89,7 @@ def test_fused_keep_matches_extract():
     mask = np.zeros(nb, dtype=bool)
     mask[:n] = True
     spec = [("sum", True)]
-    prog = [lambda cols: cols[0]]
+    prog = [lambda cols, params: cols[0]]
     dev_cols = [(dv, dn)]
     present, outs, _ = kernels.fused_segment_aggregate(
         dev_cols, gd, 300, spec, prog, n, ("host", jn.asarray(mask)),

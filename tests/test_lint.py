@@ -54,6 +54,30 @@ def test_pipeline_clean_stage_not_flagged(tmp_path):
     assert lint_trace_safety(SourceFile(str(p))) == []
 
 
+def test_literal_fixture_fires_ts107():
+    sf = SourceFile(os.path.join(FIXDIR, "bad_literal.py"))
+    got = [d for d in lint_trace_safety(sf) if d.rule == "TS107"]
+    # cval (direct bake) + threshold (transitively derived) — and ONLY
+    # in build_const: the ParamTable/default-arg form and the host
+    # helper stay clean
+    assert len(got) == 2, [d.format() for d in got]
+    assert {"cval", "threshold"} == {d.message.split("`")[1] for d in got}
+    assert all("const_fn" in d.message for d in got)
+
+
+def test_ts107_default_param_capture_not_flagged(tmp_path):
+    # the slot-plumbing idiom: value-derived names bound as DEFAULT
+    # parameters are runtime-operand plumbing, not a bake
+    src = ("def build(e, pt, jn):\n"
+           "    slot = pt.add_int(e.value)\n"
+           "    def fn(cols, params, slot=slot):\n"
+           "        return params[0][slot]\n"
+           "    return fn\n")
+    p = tmp_path / "ok_slot.py"
+    p.write_text(src)
+    assert lint_trace_safety(SourceFile(str(p))) == []
+
+
 def test_trace_suppression_requires_justification():
     sf = SourceFile(os.path.join(FIXDIR, "bad_suppress.py"))
     # the unjustified disable does NOT silence TS101 and raises QL001
@@ -342,6 +366,7 @@ def test_corpus_plans_clean():
     ("locks", "bad_locks.py"),
     ("trace", "bad_suppress.py"),
     ("trace", "bad_pipeline.py"),
+    ("trace", "bad_literal.py"),
     ("obs", "bad_stats.py"),
     ("obs", "bad_summary.py"),
 ])

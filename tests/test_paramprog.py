@@ -1,0 +1,373 @@
+"""Literal-parameterized programs + the stats-driven auto-prewarm worker
+(ISSUE 6): one compiled program must serve an entire normalized-SQL
+digest family, and the background worker must AOT-compile the hottest
+families off the query path under top-K / budget / cooldown control.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tinysql_tpu import fail
+from tinysql_tpu.expression import Column, Constant, new_function
+from tinysql_tpu.mytypes import new_int_type, new_real_type
+from tinysql_tpu.obs import stmtsummary
+from tinysql_tpu.ops import kernels, progcache
+from tinysql_tpu.session.prewarm import (PrewarmWorker, rank_candidates,
+                                         reset_stats, stats_snapshot)
+from tinysql_tpu.session.session import new_session
+
+INT, REAL = new_int_type(), new_real_type()
+
+
+@pytest.fixture
+def tk():
+    s = new_session()
+    s.execute("create database pp")
+    s.execute("use pp")
+    s.execute("set @@tidb_use_tpu = 1")
+    s.execute("set @@tidb_tpu_min_rows = 0")
+    rows = []
+    for i in range(1, 3001):
+        rows.append(f"({i}, {i % 11}, {round((i % 97) * 0.5, 2)}, "
+                    f"{i % 7})")
+    s.execute("create table t (id bigint primary key, k bigint, "
+              "v double, w bigint)")
+    s.execute("insert into t values " + ", ".join(rows))
+    # one pure full scan hydrates the columnar replica (filtered scans
+    # ride the cop path) — the fused device paths need it, exactly like
+    # the bench's bulk-loaded tables
+    s.query("select id, k, v, w from t")
+    return s
+
+
+def _q(s, sql):
+    """(rows, stats delta) of one statement on the current tier."""
+    snap = kernels.stats_snapshot()
+    rows = s.query(sql).rows
+    return rows, kernels.stats_delta(snap)
+
+
+def _cpu_rows(s, sql):
+    s.execute("set @@tidb_use_tpu = 0")
+    try:
+        return s.query(sql).rows
+    finally:
+        s.execute("set @@tidb_use_tpu = 1")
+
+
+# =========================================================================
+# literal parameterization: same digest family -> one compiled program
+# =========================================================================
+
+GROUPBY_Q = ("select k, sum(v * ({a} - w)), count(*), min(v) from t "
+             "where v < {b} and w != {c} group by k order by k")
+SCALAR_Q = ("select sum(v * ({a} + w)), count(*) from t "
+            "where v >= {b} and k < {c}")
+
+
+def test_groupby_constant_variant_compiles_once(tk):
+    """Filter AND aggregate-argument constants are runtime operands: the
+    second constant-set must be a pure program-cache hit."""
+    base, d0 = _q(tk, GROUPBY_Q.format(a=1, b=30, c=2))
+    assert d0["dispatches"] > 0          # the fused device path ran
+    assert d0["progcache_misses"] > 0    # first sight compiles
+    var, d1 = _q(tk, GROUPBY_Q.format(a=4, b=17, c=5))
+    assert d1["progcache_misses"] == 0, d1
+    assert d1["dispatches"] > 0
+    # and the parameterized results are the CPU tier's, byte for byte
+    assert var == _cpu_rows(tk, GROUPBY_Q.format(a=4, b=17, c=5))
+    assert base != var                   # the constants genuinely matter
+
+
+def test_scalar_agg_constant_variant_compiles_once(tk):
+    _q(tk, SCALAR_Q.format(a=2, b=3.5, c=9))
+    var, d1 = _q(tk, SCALAR_Q.format(a=7, b=11.5, c=4))
+    assert d1["progcache_misses"] == 0, d1
+    assert var == _cpu_rows(tk, SCALAR_Q.format(a=7, b=11.5, c=4))
+
+
+def test_blockwise_constant_variant_compiles_once(tk):
+    """The block-streaming aggregate shares the same parameterized
+    kernels: constant changes reuse the per-block program."""
+    tk.execute("set @@tidb_device_block_rows = 1024")
+    try:
+        _q(tk, GROUPBY_Q.format(a=1, b=30, c=2))
+        var, d1 = _q(tk, GROUPBY_Q.format(a=3, b=21, c=6))
+        assert d1["progcache_misses"] == 0, d1
+        assert var == _cpu_rows(tk, GROUPBY_Q.format(a=3, b=21, c=6))
+    finally:
+        tk.execute("set @@tidb_device_block_rows = 0")
+
+
+def test_exprjit_params_byte_identical_to_literal_path():
+    """compile_expr_params must produce BYTE-identical (values, null,
+    dtypes) results to the legacy literal-baked compile_expr for the
+    same tree."""
+    from tinysql_tpu.ops.exprjit import (ParamTable, compile_expr,
+                                         compile_expr_params)
+    jn = kernels.jnp()
+    rng = np.random.default_rng(23)
+    n = 257
+    iv = rng.integers(-50, 50, n)
+    inull = rng.random(n) < 0.1
+    rv = np.round(rng.uniform(-10, 10, n), 3)
+    rnull = rng.random(n) < 0.1
+    cols = [(jn.asarray(iv), jn.asarray(inull)),
+            (jn.asarray(rv), jn.asarray(rnull))]
+    ci, cr = Column(INT, 0), Column(REAL, 1)
+    exprs = [
+        new_function("*", [cr, new_function("-", [Constant(1, INT), ci])]),
+        new_function("<", [cr, Constant(3.25, REAL)]),
+        new_function("if", [new_function(">", [ci, Constant(7, INT)]),
+                            Constant(42, INT), ci]),
+        new_function("+", [new_function("%", [ci, Constant(5, INT)]),
+                           Constant(None, INT)]),
+        new_function("in", [ci, Constant(1, INT), Constant(4, INT),
+                            Constant(9, INT)]),
+    ]
+    for e in exprs:
+        lv, lm = compile_expr(e)(cols)
+        pt = ParamTable()
+        fn = compile_expr_params(e, pt)
+        pi, pf = pt.arrays()
+        pv, pm = fn(cols, (jn.asarray(pi), jn.asarray(pf)))
+        assert str(lv.dtype) == str(pv.dtype)
+        assert np.array_equal(np.asarray(lv), np.asarray(pv)), e
+        assert np.array_equal(np.asarray(lm), np.asarray(pm)), e
+
+
+def test_shape_key_erases_values_but_not_shape():
+    from tinysql_tpu.ops.exprjit import stable_shape_key
+    ci = Column(INT, 0)
+    a = new_function("<", [ci, Constant(5, INT)])
+    b = new_function("<", [ci, Constant(900, INT)])
+    c = new_function("<", [ci, Constant(None, INT)])
+    d = new_function(">", [ci, Constant(5, INT)])
+    assert stable_shape_key(a) == stable_shape_key(b)
+    assert stable_shape_key(a) != stable_shape_key(c)  # NULL is structural
+    assert stable_shape_key(a) != stable_shape_key(d)
+
+
+# =========================================================================
+# the auto-prewarm worker
+# =========================================================================
+
+def _rec(digest, execs, max_exec_ms, stmt_type="select",
+         sql="select 1", plan_digest="p"):
+    return {"digest": digest, "stmt_type": stmt_type, "sample_sql": sql,
+            "exec_count": execs, "max_ms": {"exec": max_exec_ms},
+            "plan_digest": plan_digest, "schema": ""}
+
+
+def test_rank_candidates_topk_scoring_and_filtering():
+    recs = [
+        _rec("hot", 100, 500.0),          # score 50000
+        _rec("warmish", 10, 100.0),       # score 1000
+        _rec("cold", 1, 10.0),            # score 10
+        _rec("evicted", 9999, 9999.0),    # tombstone: never a candidate
+        _rec("write", 9999, 9999.0, stmt_type="insert"),
+        _rec("nosample", 9999, 9999.0, sql=""),
+    ]
+    got = [r["digest"] for r in rank_candidates(recs, 2)]
+    assert got == ["hot", "warmish"]
+    assert rank_candidates(recs, 0) == []
+
+
+@pytest.fixture
+def warm_env(tk):
+    """Clean global prewarm state around a worker test: summary store,
+    worker counters, and the relevant global sysvars."""
+    stmtsummary.STORE.reset()
+    reset_stats()
+    g = tk.storage._global_vars = getattr(tk.storage, "_global_vars", {})
+    g["tidb_auto_prewarm"] = 1
+    g["tidb_auto_prewarm_cooldown"] = 0
+    g["tidb_auto_prewarm_budget_ms"] = 0
+    g["tidb_auto_prewarm_top_k"] = 8
+    # the worker's INTERNAL session reads globals: placement must match
+    # the test session's row gate or it would warm the CPU plan
+    g["tidb_tpu_min_rows"] = 0
+    yield tk
+    stmtsummary.STORE.reset()
+    reset_stats()
+
+
+def test_worker_warms_family_and_later_variant_hits(warm_env):
+    """The full serving loop: a seen family + a cold program cache ->
+    one worker cycle -> the NEXT constant-variant query compiles nothing
+    and its detail carries prewarm_hits provenance."""
+    s = warm_env
+    s.query(GROUPBY_Q.format(a=1, b=30, c=2))  # feeds statements_summary
+    progcache.clear()  # a fresh process's cache, summary intact
+    w = PrewarmWorker(s.storage)
+    try:
+        rep = w.run_cycle()
+        assert rep["enabled"] and rep["warmed"], rep
+        assert progcache.stats_snapshot()["prewarm_seeded"] > 0
+        var, d = _q(s, GROUPBY_Q.format(a=8, b=12, c=1))
+        assert d["progcache_misses"] == 0, d
+        assert d["prewarm_hits"] > 0, d
+        assert var == _cpu_rows(s, GROUPBY_Q.format(a=8, b=12, c=1))
+    finally:
+        w.close()
+
+
+def test_worker_respects_top_k(warm_env):
+    s = warm_env
+    s.query(GROUPBY_Q.format(a=1, b=30, c=2))
+    s.query(GROUPBY_Q.format(a=1, b=30, c=2))  # hotter family
+    s.query(SCALAR_Q.format(a=2, b=3.5, c=9))
+    s.storage._global_vars["tidb_auto_prewarm_top_k"] = 1
+    w = PrewarmWorker(s.storage)
+    try:
+        rep = w.run_cycle()
+        assert rep["candidates"] == 1 and len(rep["warmed"]) == 1
+    finally:
+        w.close()
+
+
+def test_worker_respects_budget(warm_env):
+    s = warm_env
+    s.query(GROUPBY_Q.format(a=1, b=30, c=2))
+    s.query(SCALAR_Q.format(a=2, b=3.5, c=9))
+    # 1ms budget: the first candidate always runs (spend is checked
+    # BEFORE each family), everything after defers to the next cycle
+    s.storage._global_vars["tidb_auto_prewarm_budget_ms"] = 1
+    w = PrewarmWorker(s.storage)
+    try:
+        rep = w.run_cycle()
+        assert rep["candidates"] == 2
+        assert len(rep["warmed"]) == 1 and rep["skipped_budget"] == 1, rep
+        assert stats_snapshot()["skipped_budget"] == 1
+    finally:
+        w.close()
+
+
+def test_worker_respects_cooldown(warm_env):
+    s = warm_env
+    s.query(SCALAR_Q.format(a=2, b=3.5, c=9))
+    s.storage._global_vars["tidb_auto_prewarm_cooldown"] = 3600
+    progcache.clear()  # the warm must actually compile, or the family
+    #                    is marked satisfied and skipped for that reason
+    w = PrewarmWorker(s.storage)
+    try:
+        rep1 = w.run_cycle()
+        assert len(rep1["warmed"]) == 1
+        rep2 = w.run_cycle()
+        assert not rep2["warmed"] and rep2["skipped_cooldown"] == 1, rep2
+    finally:
+        w.close()
+
+
+def test_worker_skips_already_warm_family(warm_env):
+    """A family whose warm compiled NOTHING must not have its sample SQL
+    re-executed every cooldown expiry — skipped as satisfied until the
+    program registry is reset."""
+    s = warm_env
+    s.query(SCALAR_Q.format(a=2, b=3.5, c=9))  # compiles the programs
+    w = PrewarmWorker(s.storage)
+    try:
+        rep1 = w.run_cycle()  # executes once, compiles nothing
+        assert len(rep1["warmed"]) == 1
+        rep2 = w.run_cycle()
+        assert not rep2["warmed"] and rep2["skipped_satisfied"] == 1, rep2
+        progcache.clear()  # fresh cache (new process): re-warm engages
+        rep3 = w.run_cycle()
+        assert len(rep3["warmed"]) == 1, rep3
+    finally:
+        w.close()
+
+
+def test_worker_disabled_by_sysvar(warm_env):
+    s = warm_env
+    s.query(SCALAR_Q.format(a=2, b=3.5, c=9))
+    s.storage._global_vars["tidb_auto_prewarm"] = 0
+    w = PrewarmWorker(s.storage)
+    try:
+        assert w.run_cycle() == {"enabled": False}
+        assert stats_snapshot()["families_warmed"] == 0
+    finally:
+        w.close()
+
+
+def test_worker_compile_error_cools_down_and_recovers(warm_env):
+    """The failpoint catalogue drives the worker's error path: an
+    injected compile failure is counted, starts the family cooldown,
+    and the next healthy cycle warms normally (also exercised by the
+    chaos matrix, tests/test_chaos.py)."""
+    s = warm_env
+    s.query(SCALAR_Q.format(a=2, b=3.5, c=9))
+    s.storage._global_vars["tidb_auto_prewarm_cooldown"] = 3600
+    w = PrewarmWorker(s.storage)
+    try:
+        with fail.armed("prewarmCompileError",
+                        exc=RuntimeError("injected")):
+            rep = w.run_cycle()
+        assert rep["errors"] == 1 and not rep["warmed"]
+        # failure started the cooldown: the broken family is not
+        # hammered every cycle
+        rep2 = w.run_cycle()
+        assert rep2["skipped_cooldown"] == 1 and not rep2["errors"]
+        # cooldown 0 again: the family warms cleanly — not wedged
+        s.storage._global_vars["tidb_auto_prewarm_cooldown"] = 0
+        rep3 = w.run_cycle()
+        assert rep3["warmed"] and not rep3["errors"], rep3
+    finally:
+        w.close()
+
+
+def test_worker_session_is_internal_and_invisible(warm_env):
+    """The worker's warming executions must not feed the summary they
+    rank from (self-amplification) — exec counts stay put."""
+    s = warm_env
+    s.query(SCALAR_Q.format(a=2, b=3.5, c=9))
+
+    def fam_count():
+        for r in stmtsummary.snapshot():
+            if (r.get("stmt_type") or "") == "select":
+                return r["exec_count"]
+        return 0
+    before = fam_count()
+    w = PrewarmWorker(s.storage)
+    try:
+        rep = w.run_cycle()
+        assert rep["warmed"]
+        assert fam_count() == before
+    finally:
+        w.close()
+
+
+def test_worker_thread_lifecycle(warm_env):
+    """start()/close() must spin up and join cleanly without a cycle
+    ever firing (first fire is one full interval after start) — and a
+    RESTART after close() must yield a live worker again (the stop
+    event is cleared)."""
+    s = warm_env
+    w = PrewarmWorker(s.storage)
+    w.start()
+    assert w._thread is not None and w._thread.is_alive()
+    w.close()
+    assert w._thread is None
+    w.start()
+    assert w._thread is not None and w._thread.is_alive()
+    w.close()
+    assert stats_snapshot()["cycles"] == 0
+
+
+def test_worker_session_tracks_global_sysvars(warm_env):
+    """SET GLOBAL after the worker session exists must reach warming
+    executions — the session re-overlays globals every use."""
+    s = warm_env
+    w = PrewarmWorker(s.storage)
+    try:
+        sess = w._ensure_session()
+        assert bool(sess.get_sysvar("tidb_use_tpu"))
+        s.storage._global_vars["tidb_use_tpu"] = 0
+        sess2 = w._ensure_session()
+        assert sess2 is sess  # one long-lived internal session
+        assert not bool(sess2.get_sysvar("tidb_use_tpu"))
+    finally:
+        w.close()
+        s.storage._global_vars.pop("tidb_use_tpu", None)

@@ -41,6 +41,18 @@ Hazards:
   over a device value parks the stage thread on the device and defeats
   the overlap.  Device UPLOADS (``jn.asarray`` over host values) are the
   point of the stage and stay legal.
+- TS107: a QUERY CONSTANT baked into a device closure.  A nested
+  function that evaluates over device columns (a traced region, or an
+  expression closure by the engine's ``cols``-first-parameter
+  convention) freely referencing a variable its enclosing builder
+  derived from a ``<node>.value`` attribute (the ``Constant.value``
+  idiom, tracked transitively through local assignments) closes the
+  literal into the traced program: every distinct constant then
+  compiles its own XLA program — the 15s-cold-start-per-literal bug
+  class.  Route the constant through an ``exprjit.ParamTable`` slot
+  (a runtime operand) instead; binding it as a DEFAULT PARAMETER of
+  the closure (``slot=slot``) is the sanctioned slot-plumbing form
+  and is not flagged.
 """
 from __future__ import annotations
 
@@ -57,6 +69,8 @@ register_rules({
     "TS105": "unhashable jit cache key (list/set/dict/ndarray in key)",
     "TS106": "host sync inside a pipeline stage callback (defeats the "
              "host-staging/device-compute overlap)",
+    "TS107": "query constant baked into a device closure — route it "
+             "through a ParamTable slot",
 })
 
 _JIT_CALL_NAMES = {"jit", "counted_jit", "shard_map", "pmap", "vmap"}
@@ -528,6 +542,129 @@ def _lint_cache_keys(sf: SourceFile) -> List[Diagnostic]:
     return out
 
 
+# ---- TS107: query constants baked into device closures --------------------
+
+def _value_derived_names(fn: ast.FunctionDef) -> Dict[str, int]:
+    """Names assigned (directly or transitively through local
+    assignments) from an expression containing a ``<node>.value``
+    attribute read — the ``Constant.value`` extraction idiom — mapped to
+    the lineno of the seeding assignment.  Nested function bodies are
+    excluded (their assignments are their own scope)."""
+    nested: Set[ast.AST] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            for sub in ast.walk(node):
+                nested.add(sub)
+
+    def has_value_attr(e: ast.expr, derived: Dict[str, int]) -> bool:
+        for sub in ast.walk(e):
+            if isinstance(sub, ast.Attribute) and sub.attr == "value":
+                return True
+            if isinstance(sub, ast.Name) and sub.id in derived:
+                return True
+        return False
+
+    out: Dict[str, int] = {}
+    changed = True
+    while changed:  # transitive: cval = wrap_i64(int(val)) follows val
+        changed = False
+        for node in ast.walk(fn):
+            if node in nested or not isinstance(node, ast.Assign):
+                continue
+            if not has_value_attr(node.value, out):
+                continue
+            for tgt in node.targets:
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Name) and sub.id not in out:
+                        out[sub.id] = node.lineno
+                        changed = True
+    return out
+
+
+def _closure_bound_names(fn: ast.FunctionDef) -> Set[str]:
+    """Names bound INSIDE `fn`: parameters (incl. the `slot=slot`
+    default-capture idiom) and local assignment/loop targets."""
+    bound: Set[str] = set()
+    a = fn.args
+    for arg in (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])):
+        bound.add(arg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                for sub in ast.walk(tgt):
+                    if isinstance(sub, ast.Name):
+                        bound.add(sub.id)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For)):
+            tgt = node.target
+            for sub in ast.walk(tgt):
+                if isinstance(sub, ast.Name):
+                    bound.add(sub.id)
+        elif isinstance(node, (ast.comprehension,)):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    bound.add(sub.id)
+    return bound
+
+
+def _lint_baked_literals(sf: SourceFile,
+                         jitted: Set[str]) -> List[Diagnostic]:
+    """TS107: device closures freely capturing a value-derived constant.
+    A closure qualifies when it is a traced region (jit-passed /
+    decorated / ``emit``) or follows the engine's expression-closure
+    convention (first parameter named ``cols``)."""
+    # map each FunctionDef to its IMMEDIATELY enclosing FunctionDef (the
+    # scope whose assignments its free names resolve against first)
+    encl_of: Dict[ast.FunctionDef, ast.FunctionDef] = {}
+
+    def walk_scope(owner, node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.FunctionDef):
+                if owner is not None:
+                    encl_of[child] = owner
+                walk_scope(child, child)
+            else:
+                walk_scope(owner, child)
+
+    walk_scope(None, sf.tree)
+    out: List[Diagnostic] = []
+    derived_memo: Dict[ast.FunctionDef, Dict[str, int]] = {}
+    for inner, encl in encl_of.items():
+        args = inner.args.posonlyargs + inner.args.args
+        is_device_closure = (
+            inner.name == "emit" or inner.name in jitted
+            or _is_jit_decorated(inner)
+            or (bool(args) and args[0].arg == "cols"))
+        if not is_device_closure:
+            continue
+        if encl not in derived_memo:
+            derived_memo[encl] = _value_derived_names(encl)
+        derived = derived_memo[encl]
+        if not derived:
+            continue
+        bound = _closure_bound_names(inner)
+        flagged: Set[str] = set()
+        for sub in ast.walk(inner):
+            if not (isinstance(sub, ast.Name)
+                    and isinstance(sub.ctx, ast.Load)):
+                continue
+            name = sub.id
+            if name in bound or name in flagged or name not in derived:
+                continue
+            flagged.add(name)
+            out.append(Diagnostic(
+                "TS107",
+                f"`{name}` (derived from a `.value` constant at "
+                f"line {derived[name]}) is baked into device "
+                f"closure `{inner.name}` — every distinct literal "
+                "compiles its own XLA program; route it through an "
+                "exprjit.ParamTable slot (runtime operand) instead",
+                sf.path, sub.lineno, sub.col_offset))
+    return out
+
+
 def lint_trace_safety(sf: SourceFile) -> List[Diagnostic]:
     np_aliases = _numpy_aliases(sf.tree)
     jitted = _jitted_names(sf.tree)
@@ -546,4 +683,5 @@ def lint_trace_safety(sf: SourceFile) -> List[Diagnostic]:
     diags.extend(_lint_retrace(sf))
     diags.extend(_lint_cache_keys(sf))
     diags.extend(_lint_stage_callbacks(sf, np_aliases))
+    diags.extend(_lint_baked_literals(sf, jitted))
     return sf.filter(diags)

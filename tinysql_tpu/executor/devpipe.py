@@ -479,10 +479,12 @@ class _ReplicaLeaf:
         n = chk.full_rows()
         nb = kernels.bucket(max(n, 1))
         jn = _jn()
-        dm = _build_device_mask(self.ex, rep, chk, filters)
+        pt = ParamTable()
+        dm = _build_device_mask(self.ex, rep, chk, filters, pt)
         if dm is None:
             return None
-        mask_fn, mask_key, params, _needed = dm
+        mask_fn, mask_key, _needed = dm
+        params = pt.arrays()
         slots = []
         meta: List[tuple] = []
         dts = []

@@ -23,7 +23,6 @@ from ..expression.aggregation import (AGG_AVG, AGG_COUNT, AGG_FIRST_ROW,
                                       AGG_MAX, AGG_MIN, AGG_SUM)
 from ..mytypes import EvalType, new_real_type
 from ..ops import kernels, progcache
-from ..ops.exprjit import compile_filter
 from ..planner.physical import (PhysicalHashAgg, PhysicalHashJoin,
                                 PhysicalProjection, PhysicalSelection,
                                 PhysicalSort, PhysicalTopN)
@@ -154,17 +153,20 @@ def _code_cmp_fn(idx: int, op: str, lo_s: int, hi_s: int, card_s: int):
     return f
 
 
-def _build_device_mask(ex, rep, chk, conds):
+def _build_device_mask(ex, rep, chk, conds, pt):
     """Compile scan filters into an on-device mask program over the fused
-    kernels' dev_cols.  Returns (mask_fn, key, params, needed) — needed is
-    a set of (slot index, "codes" | "full") the program reads — or None
-    when some condition cannot run on device (host mask fallback).
-    Slot 0 of the int params is always the live row count (padding
-    guard); constants ride params so changing them never recompiles."""
-    from ..ops.exprjit import (ParamTable, compile_expr_params, is_jittable,
+    kernels' dev_cols.  Returns (mask_fn, key, needed) — needed is a set
+    of (slot index, "codes" | "full") the program reads — or None when
+    some condition cannot run on device (host mask fallback).  ``pt`` is
+    the query's shared ParamTable (aggregate arguments append to the
+    same vector): the live row count takes a slot first (padding guard),
+    then per-condition constants — so changing any literal in the family
+    never recompiles.  NOTE a None return may leave consumed slots in
+    ``pt``; callers discard and rebuild it (slot order is part of the
+    cached program's contract)."""
+    from ..ops.exprjit import (compile_expr_params, is_jittable,
                                stable_shape_key)
-    pt = ParamTable()
-    pt.add_int(chk.full_rows())
+    row_slot = pt.add_int(chk.full_rows())
     fns = []
     keys = []
     needed = set()
@@ -192,12 +194,12 @@ def _build_device_mask(ex, rep, chk, conds):
             return None
 
     def mask_fn(cols, params, row_idx):
-        m = row_idx < params[0][0]
+        m = row_idx < params[0][row_slot]
         for f in fns:
             v, null = f(cols, params)
             m = m & (v != 0) & ~null
         return m
-    return mask_fn, tuple(keys), pt.arrays(), needed
+    return mask_fn, tuple(keys), needed
 
 
 def rep_string_codes(rep, sid, v, null):
@@ -301,10 +303,32 @@ def _child_input(ex: Executor) -> Chunk:
 def _count_mask_program(slot: int):
     """COUNT(col) consumes only the column's null mask; the value half of
     the device pair may be absent (string columns upload masks only)."""
-    def fn(cols):
+    def fn(cols, params):
         null = cols[slot][1]
         return null, null
     return fn
+
+
+def _lower_agg_args(arg_exprs, pt):
+    """Aggregate-argument entries -> ((cols, params) programs, shape-keyed
+    program_key tuple).  ONE lowering for the whole-table fused path and
+    the block-pipeline path: the cache-key contract (same key => same
+    ParamTable slot layout) spans both, so they must never diverge.
+    Constants ride ``pt`` — a changed literal is a program-cache HIT."""
+    from ..ops.exprjit import compile_expr_params, stable_shape_key
+    progs = []
+    pk_parts = []
+    for a in arg_exprs:
+        if isinstance(a, tuple):
+            progs.append(_count_mask_program(a[1]))
+            pk_parts.append(f"mask@{a[1]}")
+        elif a is None:
+            progs.append(None)
+            pk_parts.append("-")
+        else:
+            progs.append(compile_expr_params(a, pt))
+            pk_parts.append(stable_shape_key(a))
+    return progs, tuple(pk_parts)
 
 
 def _encode_key(e, chk: Chunk) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
@@ -401,7 +425,7 @@ class TPUHashAggExec(Executor):
         one XLA program end to end.  Returns an output Chunk or None to
         fall back."""
         from .executors import TableReaderExec
-        from ..ops.exprjit import is_jittable, stable_key
+        from ..ops.exprjit import is_jittable
         plan = self.plan
         child = self.children[0]
         if not isinstance(child, TableReaderExec):
@@ -561,14 +585,19 @@ class TPUHashAggExec(Executor):
 
         # ---- filter mask: on-device program when every condition lowers
         # (constants as runtime params — zero recompiles across constant
-        # changes, ~100-byte upload); host numpy + nb-bool upload otherwise
-        dev_mask = _build_device_mask(child, rep, chk, filters)
+        # changes, ~100-byte upload); host numpy + nb-bool upload otherwise.
+        # ONE ParamTable serves the mask AND the aggregate arguments: the
+        # whole fused program's constants ride a single runtime vector.
+        from ..ops.exprjit import ParamTable
+        pt = ParamTable()
+        dev_mask = _build_device_mask(child, rep, chk, filters, pt)
         if dev_mask is None:
+            pt = ParamTable()  # discard half-consumed mask slots
             fmask = _fold_filter_masks(child, rep, chk, filters) \
                 if filters else None
             mask_needed = set()
         else:
-            mask_fn, mask_prog_key, mask_params, mask_needed = dev_mask
+            mask_fn, mask_prog_key, mask_needed = dev_mask
             fmask = None
 
         # ---- device columns (memoized per replica + bucket) -------------
@@ -605,34 +634,25 @@ class TPUHashAggExec(Executor):
             if dev_cols[idx] is None or dv is not None:
                 dev_cols[idx] = (dv, dn)
 
-        # count-over-column programs read only the null mask
-        progs = []
-        for a in arg_exprs:
-            if isinstance(a, tuple):
-                slot = a[1]
-                progs.append(_count_mask_program(slot))
-            else:
-                progs.append(a)
+        # aggregate-argument programs: params-compiled against the SAME
+        # ParamTable as the mask, program cache keyed by expression SHAPE
+        progs, program_key = _lower_agg_args(arg_exprs, pt)
+        params = pt.arrays()
 
         # ---- mask spec for the kernels ----------------------------------
         if dev_mask is not None:
-            mask_spec = ("dev", mask_fn, mask_prog_key, mask_params)
+            mask_spec = ("dev", mask_fn, mask_prog_key)
         else:
             mask = np.zeros(nb, dtype=bool)
             mask[:n] = fmask if fmask is not None else True
             mask_spec = ("host", jn.asarray(mask))
-
-        program_key = tuple(
-            f"mask@{a[1]}" if isinstance(a, tuple)
-            else (stable_key(a) if a is not None else "-")
-            for a in arg_exprs)
 
         # ---- run --------------------------------------------------------
         if not plan.group_by:
             out_keys = []
             out_aggs, first_orig = kernels.fused_scalar_aggregate(
                 dev_cols, specs, progs, n, nb, mask_spec,
-                program_key=program_key)
+                program_key=program_key, params=params)
         else:
             gid_dev = rep.memo(
                 ("gid_dev", tuple(slot_ids[e.index]
@@ -644,19 +664,20 @@ class TPUHashAggExec(Executor):
                 present, out_aggs, first_orig = \
                     kernels.fused_segment_aggregate_sharded(
                         mesh, dev_cols, gid_dev, n_segments, specs, progs,
-                        n, mask_spec, program_key=program_key)
+                        n, mask_spec, program_key=program_key,
+                        params=params)
             elif self._can_device_passthrough(plan, slots, key_layouts):
                 ids, live, out_aggs_d, np_, ob = \
                     kernels.fused_segment_aggregate_keep(
                         dev_cols, gid_dev, n_segments, specs, progs,
-                        mask_spec, program_key=program_key)
+                        mask_spec, program_key=program_key, params=params)
                 return self._assemble_device_output(
                     plan, slots, key_layouts, ids, live, out_aggs_d, np_)
             else:
                 present, out_aggs, first_orig = \
                     kernels.fused_segment_aggregate(
                         dev_cols, gid_dev, n_segments, specs, progs, n,
-                        mask_spec, program_key=program_key)
+                        mask_spec, program_key=program_key, params=params)
             out_keys = self._decode_present(present, key_layouts)
         return self._assemble_output(chk, plan, slots, out_keys, out_aggs,
                                      first_orig,
@@ -680,24 +701,18 @@ class TPUHashAggExec(Executor):
         work overlap instead of alternating (tidb_pipeline_depth /
         TINYSQL_PIPELINE_DEPTH=0 restores the serial order; the fold
         order is block order either way, so results are identical)."""
-        from ..ops.exprjit import stable_key
+        from ..ops.exprjit import ParamTable
         from .devpipe import BlockPipeline, pipeline_depth
         jn = kernels.jnp()
         # host filter mask over the full table; reuse the caller's when
         # it already folded one (the dev-mask path leaves it None)
         if fmask is None and filters:
             fmask = _fold_filter_masks(child, rep, chk, filters)
-        # argument programs (count-over-column reads only the null mask)
-        progs = []
-        for a in arg_exprs:
-            if isinstance(a, tuple):
-                progs.append(_count_mask_program(a[1]))
-            else:
-                progs.append(a)
-        program_key = tuple(
-            f"mask@{a[1]}" if isinstance(a, tuple)
-            else (stable_key(a) if a is not None else "-")
-            for a in arg_exprs)
+        # argument programs: params-compiled so a changed literal reuses
+        # the block kernel
+        pt = ParamTable()
+        progs, program_key = _lower_agg_args(arg_exprs, pt)
+        params = pt.arrays()
         needed = set()
         for a in arg_exprs:
             if isinstance(a, tuple):
@@ -768,13 +783,13 @@ class TPUHashAggExec(Executor):
             if key_layouts:
                 present, outs, first = kernels.fused_segment_aggregate(
                     dev_cols, gid_b, ns, specs, progs, m_rows, mask_spec,
-                    program_key=program_key)
+                    program_key=program_key, params=params)
             else:
                 # scalar contract (_unpack_scalar_agg): zero-or-one-row
                 # arrays; an empty block contributes nothing
                 outs, first = kernels.fused_scalar_aggregate(
                     dev_cols, specs, progs, m_rows, bb, mask_spec,
-                    program_key=program_key)
+                    program_key=program_key, params=params)
                 present = np.zeros(len(first), dtype=np.int64)
                 outs = [(np.asarray(v_), np.asarray(m_))
                         for v_, m_ in outs]
@@ -1069,6 +1084,7 @@ class TPUHashAggExec(Executor):
                                for e in self.plan.group_by)),
             lambda: self._compose_gid(key_layouts, n))
         ns = n_segments
+        kernels.host_dispatch()  # the twin IS the kernel on this backend
         g_valid = gid if fmask is None else gid[fmask]
         presence = np.bincount(g_valid, minlength=ns)
         present = np.nonzero(presence > 0)[0]

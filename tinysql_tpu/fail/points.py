@@ -71,6 +71,13 @@ REORG_BATCH_ERROR = register(
     "one index-backfill batch fails — reorg resumes from the checkpoint "
     "handle (ddl/worker.py)")
 
+# ---- auto-prewarm ----------------------------------------------------------
+PREWARM_COMPILE_ERROR = register(
+    "prewarmCompileError",
+    "start of one family's warm attempt in the auto-prewarm worker — "
+    "the worker must count the error, start the family's cooldown, and "
+    "keep serving later candidates and cycles (session/prewarm.py)")
+
 # ---- executor --------------------------------------------------------------
 EXEC_SLOW_NEXT = register(
     "execSlowNext",

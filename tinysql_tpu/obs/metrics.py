@@ -32,6 +32,9 @@ _DEVICE_METRICS = {
                       "Device-to-host transfer operations"),
     "d2h_bytes": ("tinysql_d2h_bytes_total",
                   "Bytes materialized device-to-host"),
+    "host_dispatches": ("tinysql_host_dispatches_total",
+                        "Host-twin kernel invocations (numpy twins "
+                        "serving the XLA:CPU backend)"),
     "flops": ("tinysql_device_flops_total",
               "XLA cost-analysis FLOPs of dispatched programs"),
     "bytes_accessed": ("tinysql_device_bytes_accessed_total",
@@ -140,9 +143,29 @@ def render_prometheus() -> str:
         emit("tinysql_progcache_misses_total",
              "In-process program-registry misses (program builds)",
              "counter", [((), pstats.get("misses", 0))])
+        emit("tinysql_prewarm_seeded_total",
+             "Programs compiled inside a prewarm scope (auto-prewarm "
+             "worker / tools/warm.py)", "counter",
+             [((), pstats.get("prewarm_seeded", 0))])
+        emit("tinysql_prewarm_hits_total",
+             "Query-path registry hits on prewarm-seeded programs "
+             "(compiles the prewarmer saved real queries)", "counter",
+             [((), pstats.get("prewarm_hits", 0))])
     if psize is not None:
         emit("tinysql_progcache_programs", "Registered compiled programs",
              "gauge", [((), psize)])
+
+    # auto-prewarm worker counters (session/prewarm.py PrewarmWorker)
+    try:
+        from ..session.prewarm import stats_snapshot as prewarm_stats
+        pw = prewarm_stats()
+    except Exception:
+        pw = {}
+    if any(pw.values()):
+        for k in sorted(pw):
+            emit(f"tinysql_prewarm_worker_{k}_total",
+                 f"Auto-prewarm worker {k.replace('_', ' ')}", "counter",
+                 [((), pw[k])])
 
     # resilience counters: failpoint fires (per name), device-loss
     # degradation, memory-quota aborts — chaos runs read these to prove

@@ -250,6 +250,7 @@ def jnp():
 # staging-queue depth high-water mark (reported as an absolute value by
 # stats_delta — a high-water is not a per-interval delta).
 STATS = {"dispatches": 0, "d2h_transfers": 0, "d2h_bytes": 0,
+         "host_dispatches": 0,
          "flops": 0.0, "bytes_accessed": 0.0,
          "pipe_blocks": 0, "pipe_stage_s": 0.0, "pipe_dispatch_s": 0.0,
          "pipe_drain_s": 0.0, "pipe_wall_s": 0.0, "pipe_depth_hwm": 0}
@@ -281,6 +282,18 @@ def stats_hwm(key: str, n) -> None:
         if n > STATS.get(key, 0):
             STATS[key] = n
     _obs.record_hwm(key, n)
+
+
+def host_dispatch(n: int = 1) -> None:
+    """Count one HOST-TWIN kernel invocation — the numpy implementations
+    that deliberately serve join match / top-k selection / group-by on
+    the XLA:CPU backend (host_kernels_ok), where they beat the serial
+    device lowerings.  Without this counter a query served entirely by
+    twins reports dispatches=0 and is indistinguishable from one that
+    silently fell off the accelerated paths (the BENCH_r05 Q3 mystery);
+    bench.py asserts dispatches + host_dispatches > 0 per device-tier
+    query."""
+    stats_add("host_dispatches", n)
 
 
 def pipe_overlap_frac(d: dict) -> float:
@@ -333,6 +346,8 @@ def stats_snapshot() -> dict:
     pc = progcache.stats_snapshot()
     out["progcache_hits"] = pc["hits"]
     out["progcache_misses"] = pc["misses"]
+    out["prewarm_seeded"] = pc.get("prewarm_seeded", 0)
+    out["prewarm_hits"] = pc.get("prewarm_hits", 0)
     return out
 
 
@@ -909,15 +924,17 @@ class _SegReduce:
 
 
 def _fused_agg_outs(j, jn, agg_specs, arg_fns, cols, gid, valid,
-                    ns, presence, merge_sum, merge_min, merge_max, seg):
+                    ns, presence, merge_sum, merge_min, merge_max, seg,
+                    pr=((), ())):
     """Per-aggregate switch shared by the single-device and sharded fused
     kernels; merge_* combine per-shard partials (identity single-device,
-    psum/pmin/pmax over the mesh axis)."""
+    psum/pmin/pmax over the mesh axis); ``pr`` is the runtime constant
+    vector pair the params-compiled argument closures read."""
     outs = []
     for (func, has_arg), af in zip(agg_specs, arg_fns):
         av = an = None
         if has_arg and af is not None:
-            av, an = af(cols)
+            av, an = af(cols, pr)
         if func == "count_star":
             outs.append((presence, jn.zeros(ns, dtype=bool)))
             continue
@@ -944,15 +961,26 @@ def _fused_agg_outs(j, jn, agg_specs, arg_fns, cols, gid, valid,
 # The flagship TPU path: raw table columns live padded in HBM (memoized on
 # the columnar replica), aggregate ARGUMENT expressions evaluate on device
 # through the exprjit lowering, the whole thing is ONE XLA program, and the
-# FILTER MASK itself computes on device: scan conditions lower through
-# exprjit with constants as runtime params (exprjit.ParamTable), so the
-# per-query traffic is a ~100-byte param upload instead of an nb-bool mask.
+# FILTER MASK itself computes on device: scan conditions AND aggregate
+# arguments lower through exprjit with constants as runtime params
+# (exprjit.ParamTable), so the per-query traffic is a ~100-byte param
+# upload instead of an nb-bool mask — and the program-cache key is the
+# expression SHAPE (stable_shape_key), never a constant value: one
+# compiled program serves the whole normalized-SQL digest family.
 #
 # mask spec accepted by the fused entry points:
-#   ("host", bool_mask_dev)            — legacy: host-evaluated, uploaded
-#   ("dev", mask_fn, key, (pi64, pf64)) — mask_fn(cols, params, row_idx)
-#     traced into the kernel; `key` joins the program cache key; params
-#     are the per-query constant arrays.
+#   ("host", bool_mask_dev)     — legacy: host-evaluated, uploaded
+#   ("dev", mask_fn, key)       — mask_fn(cols, params, row_idx) traced
+#     into the kernel; `key` joins the program cache key.
+#
+# arg_exprs entries: None, a closure (cols, params) -> (values, null)
+# (the executor's params-compiled lowering / count-mask programs), or a
+# bare Expression (legacy callers: lowers literal-baked — the caller's
+# program_key must then pin constant values).
+#
+# `params`: the per-query (int64[], float64[]) constant vectors every
+# params-compiled closure reads its slots from (exprjit.ParamTable
+# .arrays()); None when nothing is parameterized.
 
 _EMPTY_I64 = None
 _EMPTY_F64 = None
@@ -961,24 +989,47 @@ _EMPTY_MASK = None
 
 def _mask_parts(mask):
     """Normalize a mask spec -> (mask_fn|None, cache key, runtime mask
-    array, params pair).  Absent runtime inputs ride 0-length arrays so
-    every variant shares one call signature."""
-    global _EMPTY_I64, _EMPTY_F64, _EMPTY_MASK
+    array).  An absent runtime mask rides a 0-length array so every
+    variant shares one call signature."""
+    global _EMPTY_MASK
+    jn = jnp()
+    if _EMPTY_MASK is None:
+        _EMPTY_MASK = jn.zeros(0, dtype=bool)
+    if mask[0] == "host":
+        return None, ("hostmask",), mask[1]
+    _, mask_fn, key = mask[:3]
+    return mask_fn, ("devmask", key), _EMPTY_MASK
+
+
+def _params_dev(params):
+    """Upload the per-query constant vectors (absent slots ride 0-length
+    arrays so parameterless programs share the call signature)."""
+    global _EMPTY_I64, _EMPTY_F64
     jn = jnp()
     if _EMPTY_I64 is None:
         _EMPTY_I64 = jn.zeros(0, dtype=jn.int64)
         _EMPTY_F64 = jn.zeros(0, dtype=jn.float64)
-        _EMPTY_MASK = jn.zeros(0, dtype=bool)
-    if mask[0] == "host":
-        return None, ("hostmask",), mask[1], (_EMPTY_I64, _EMPTY_F64)
-    _, mask_fn, key, (pi, pf) = mask
-    return (mask_fn, ("devmask", key), _EMPTY_MASK,
-            (jn.asarray(pi), jn.asarray(pf)))
+    if params is None:
+        return (_EMPTY_I64, _EMPTY_F64)
+    pi, pf = params
+    return (jn.asarray(pi), jn.asarray(pf))
+
+
+def _lower_arg(e):
+    """One aggregate-argument entry -> (cols, params) closure or None.
+    Callables pass through (the executor's params-compiled closures);
+    bare Expressions lower literal-baked via cached_compile_expr for
+    legacy callers whose program_key pins the constant values."""
+    if e is None or callable(e):
+        return e
+    from .exprjit import cached_compile_expr
+    fn = cached_compile_expr(e)
+    return lambda cols, params: fn(cols)
 
 
 def _fused_segment_raw(dev_cols, gid_dev, n_segments: int,
                        agg_specs, arg_exprs, mask,
-                       program_key: tuple = ()):
+                       program_key: tuple = (), params=None):
     """The fused segment-aggregate device program WITHOUT extraction:
     returns (presence, first_orig, outs, n_present, ns) as device arrays
     (n_present a device scalar).  Shared by the host-extract and
@@ -987,14 +1038,11 @@ def _fused_segment_raw(dev_cols, gid_dev, n_segments: int,
     jn = jnp()
     nb = int(gid_dev.shape[0])
     ns = bucket(max(n_segments, 1))
-    mask_fn, mask_key, mask_arr, params = _mask_parts(mask)
+    mask_fn, mask_key, mask_arr = _mask_parts(mask)
     key = ("seg", tuple(agg_specs), program_key, mask_key, ns, nb)
 
     def build():
-        from .exprjit import cached_compile_expr
-        arg_fns = [e if callable(e) else
-                   (cached_compile_expr(e) if e is not None else None)
-                   for e in arg_exprs]
+        arg_fns = [_lower_arg(e) for e in arg_exprs]
 
         def kernel(cols, gid, mask_in, pr):
             if mask_fn is not None:
@@ -1007,35 +1055,36 @@ def _fused_segment_raw(dev_cols, gid_dev, n_segments: int,
             ident = lambda x: x
             outs = _fused_agg_outs(j, jn, agg_specs, arg_fns, cols, gid,
                                    valid, ns, presence, ident, ident, ident,
-                                   seg=seg)
+                                   seg=seg, pr=pr)
             n_present = jn.sum((presence > 0).astype(jn.int64))
             return presence, first_orig, outs, n_present
         return counted_jit(kernel)
     fn = progcache.get(key, build)
     presence, first_orig, outs, n_present = fn(dev_cols, gid_dev,
-                                               mask_arr, params)
+                                               mask_arr,
+                                               _params_dev(params))
     return presence, first_orig, outs, n_present, ns
 
 
 def fused_segment_aggregate(dev_cols, gid_dev, n_segments: int,
                             agg_specs, arg_exprs, n_rows: int,
-                            mask, program_key: tuple = ()):
+                            mask, program_key: tuple = (), params=None):
     """dev_cols: per-schema-slot (values, null) device pairs padded to one
     bucket (None for slots no jittable expression touches); gid_dev:
     composite group ids padded with an out-of-range id; arg_exprs: the agg
-    argument expressions, lowered on device; mask: a mask spec (module
-    docstring above).  Returns the group_aggregate contract
-    (present_ids, out_aggs, first_orig)."""
+    argument programs, lowered on device; mask: a mask spec and params
+    the per-query constant vectors (module docstring above).  Returns the
+    group_aggregate contract (present_ids, out_aggs, first_orig)."""
     presence, first_orig, outs, n_present, ns = _fused_segment_raw(
         dev_cols, gid_dev, n_segments, agg_specs, arg_exprs, mask,
-        program_key=program_key)
+        program_key=program_key, params=params)
     return _present_extract(presence, first_orig, outs, n_present, ns,
                             limit=n_segments)
 
 
 def fused_segment_aggregate_keep(dev_cols, gid_dev, n_segments: int,
                                  agg_specs, arg_exprs, mask,
-                                 program_key: tuple = ()):
+                                 program_key: tuple = (), params=None):
     """Device-resident variant (late materialization, VERDICT r4 next-2):
     compacts present segments ON DEVICE and returns
     (present_ids_dev [ob], live_dev [ob], out_aggs_dev, n_present, ob)
@@ -1045,7 +1094,7 @@ def fused_segment_aggregate_keep(dev_cols, gid_dev, n_segments: int,
     jn = jnp()
     presence, _first, outs, n_present, ns = _fused_segment_raw(
         dev_cols, gid_dev, n_segments, agg_specs, arg_exprs, mask,
-        program_key=program_key)
+        program_key=program_key, params=params)
     np_ = int(n_present)  # one scalar sync
     ob = min(bucket(max(np_, 1)), ns)
     key = ("present_keep", ob, ns, len(outs),
@@ -1065,19 +1114,17 @@ def fused_segment_aggregate_keep(dev_cols, gid_dev, n_segments: int,
 
 
 def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
-                           nb: int, mask, program_key: tuple = ()):
+                           nb: int, mask, program_key: tuple = (),
+                           params=None):
     """Global-group variant of the fused path: masked reductions with
     on-device argument evaluation."""
     j = jax()
     jn = jnp()
-    mask_fn, mask_key, mask_arr, params = _mask_parts(mask)
+    mask_fn, mask_key, mask_arr = _mask_parts(mask)
     key = ("scalar", tuple(agg_specs), program_key, mask_key, nb)
 
     def build():
-        from .exprjit import cached_compile_expr
-        arg_fns = [e if callable(e) else
-                   (cached_compile_expr(e) if e is not None else None)
-                   for e in arg_exprs]
+        arg_fns = [_lower_arg(e) for e in arg_exprs]
         kernel_schema: list = []
 
         def kernel(cols, mask_in, pr):
@@ -1089,7 +1136,7 @@ def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
             for (func, has_arg), af in zip(agg_specs, arg_fns):
                 av = an = None
                 if has_arg and af is not None:
-                    av, an = af(cols)
+                    av, an = af(cols, pr)
                 if func == "count_star":
                     outs.append((jn.sum(valid.astype(jn.int64))[None],
                                  jn.zeros(1, dtype=bool)))
@@ -1123,14 +1170,14 @@ def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
             return pack_arrays(kernel_schema, items)
         return counted_jit(kernel), kernel_schema
     fn, schema = progcache.get(key, build)
-    return _unpack_scalar_agg(unpack_flat(fn(dev_cols, mask_arr, params),
-                                          schema))
+    return _unpack_scalar_agg(unpack_flat(
+        fn(dev_cols, mask_arr, _params_dev(params)), schema))
 
 
 def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
                                     n_segments: int, agg_specs, arg_exprs,
                                     n_rows: int, mask,
-                                    program_key: tuple = ()):
+                                    program_key: tuple = (), params=None):
     """Multi-chip variant of the fused aggregate (SURVEY §2.11 P5: the
     partial/final split AS a reduce-scatter schema): rows shard over the
     mesh axis, each chip segment-reduces its shard with arguments evaluated
@@ -1155,15 +1202,12 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
     # mismatched spec
     dev_shape = tuple(0 if c is None else (1 if c[0] is None else 2)
                       for c in dev_cols)
-    mask_fn, mask_key, mask_arr, params = _mask_parts(mask)
+    mask_fn, mask_key, mask_arr = _mask_parts(mask)
     key = ("seg_sharded", tuple(agg_specs), program_key, mask_key, ns, nb,
            n_dev, dev_shape)
 
     def build():
-        from .exprjit import cached_compile_expr
-        arg_fns = [e if callable(e) else
-                   (cached_compile_expr(e) if e is not None else None)
-                   for e in arg_exprs]
+        arg_fns = [_lower_arg(e) for e in arg_exprs]
 
         def kernel(cols, gid, mask_in, pr):
             rows_local = gid.shape[0]
@@ -1187,7 +1231,7 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
                 merge_sum=lambda x: j.lax.psum(x, "shard"),
                 merge_min=lambda x: j.lax.pmin(x, "shard"),
                 merge_max=lambda x: j.lax.pmax(x, "shard"),
-                seg=seg)
+                seg=seg, pr=pr)
             return presence, first_orig, outs
 
         col_spec = tuple(
@@ -1208,8 +1252,8 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
             return pack_arrays(kernel_schema, items)
         return counted_jit(packed), kernel_schema
     pfn, schema = progcache.get(key, build)
-    vals = unpack_flat(pfn(tuple(dev_cols), gid_dev, mask_arr, params),
-                       schema)
+    vals = unpack_flat(pfn(tuple(dev_cols), gid_dev, mask_arr,
+                           _params_dev(params)), schema)
     presence, first_orig = vals[0], vals[1]
     rest = vals[2:]
     present = np.nonzero(presence > 0)[0]
@@ -1423,6 +1467,7 @@ def join_match(lkey: Tuple[np.ndarray, np.ndarray], n_left: int,
             else np.asarray(lvalid[:n_left], dtype=bool)
         rv = np.ones(n_right, dtype=bool) if rvalid is None \
             else np.asarray(rvalid[:n_right], dtype=bool)
+        host_dispatch()
         return _np_join_expand(
             np.asarray(lkey[0])[:n_left], np.asarray(lkey[1])[:n_left],
             lv, np.asarray(rkey[0])[:n_right],
@@ -1600,6 +1645,7 @@ def unique_join_match(lkey, n_left: int, rkey, n_right: int,
             else np.asarray(lvalid[:n_left], dtype=bool)
         rv = np.ones(n_right, dtype=bool) if rvalid is None \
             else np.asarray(rvalid[:n_right], dtype=bool)
+        host_dispatch()
         return _np_unique_join(
             np.asarray(lkey[0])[:n_left], np.asarray(lkey[1])[:n_left],
             lv, np.asarray(rkey[0])[:n_right],
@@ -1715,6 +1761,7 @@ def _topk_single(key, desc: bool, n_rows: int, k: int):
         # partition selection is ~100x faster there.  Exact stable-tie
         # semantics: all rows above the threshold, then lowest-index rows
         # AT the threshold.
+        host_dispatch()
         s = score[:n_rows]
         kk = min(k, n_rows)
         t = np.partition(s, n_rows - kk)[n_rows - kk]
@@ -1784,6 +1831,7 @@ def host_sort_permutation(key_cols, descs, n_rows: int) -> np.ndarray:
     device kernel's exact semantics): the budget-respecting path for
     tables above tidb_device_block_rows, where uploading every sort key
     whole would violate the device memory budget."""
+    host_dispatch()
     keys = [(v[:n_rows], m[:n_rows]) for v, m in key_cols]
     return _np_lexsort_perm(keys, descs)
 
@@ -1804,6 +1852,7 @@ def _topk_multi(key_cols, descs, n_rows: int, k: int):
     cand = np.nonzero(s >= t)[0]
     if len(cand) * 4 > n_rows * 3:
         return None  # degenerate ties: the full sort is no worse
+    host_dispatch()
     order = _np_lexsort_perm(key_cols, descs, cand)
     return cand[order[:kk]]
 

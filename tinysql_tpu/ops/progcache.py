@@ -37,29 +37,67 @@ _REG: Dict[tuple, object] = {}
 _MISS = object()
 
 #: registry hit/miss counters, exported through kernels.stats_snapshot as
-#: progcache_hits / progcache_misses
-STATS = {"hits": 0, "misses": 0}
+#: progcache_hits / progcache_misses.  The prewarm pair carries program
+#: provenance: ``prewarm_seeded`` counts programs built inside a
+#: prewarm_scope (the auto-prewarm worker / tools/warm.py compiling off
+#: the query path), ``prewarm_hits`` counts query-path lookups that found
+#: such a seeded program — the compiles the prewarmer saved real queries.
+STATS = {"hits": 0, "misses": 0, "prewarm_seeded": 0, "prewarm_hits": 0}
+
+#: keys whose entries were built inside a prewarm scope
+_PREWARMED: set = set()
+
+#: thread-local prewarm marker: the worker warms on its own thread, and
+#: BlockPipeline stage threads it spawns inherit the obs context — but
+#: progcache attribution only needs the directly-calling thread
+_TLS = threading.local()
+
+
+class prewarm_scope:
+    """Mark this thread's registry builds as prewarm-seeded (reentrant)."""
+
+    def __enter__(self):
+        _TLS.depth = getattr(_TLS, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.depth -= 1
+        return False
+
+
+def prewarming() -> bool:
+    return getattr(_TLS, "depth", 0) > 0
 
 
 def get(key: tuple, build: Callable[[], object]):
     """The one lookup path: return the entry for ``key``, building (and
     publishing) it on first sight.  ``build`` runs outside the lock."""
+    warming = prewarming()
+    prewarm_hit = False
     with _mu:
         ent = _REG.get(key, _MISS)
         if ent is not _MISS:
             STATS["hits"] += 1
             hit = True
+            if not warming and key in _PREWARMED:
+                STATS["prewarm_hits"] += 1
+                prewarm_hit = True
         else:
             STATS["misses"] += 1
             hit = False
     # per-query attribution rides the obs scope (kernels.stats_snapshot
     # exports the global pair as progcache_hits/progcache_misses)
     _obs.record("progcache_hits" if hit else "progcache_misses", 1)
+    if prewarm_hit:
+        _obs.record("prewarm_hits", 1)
     if hit:
         return ent
     with _obs.span("compile", cat="device", key=str(key[0])):
         ent = build()
     with _mu:
+        if warming and key not in _PREWARMED:
+            _PREWARMED.add(key)
+            STATS["prewarm_seeded"] += 1
         return _REG.setdefault(key, ent)
 
 
@@ -85,6 +123,7 @@ def clear() -> None:
     """Drop every entry (tests; a backend reset invalidates programs)."""
     with _mu:
         _REG.clear()
+        _PREWARMED.clear()
 
 
 def stats_snapshot() -> dict:

@@ -57,6 +57,13 @@ def _make_handler(server_ref):
                 self._send(200, json.dumps(snapshot(),
                                            default=str).encode())
                 return
+            if parsed.path == "/debug/prewarm":
+                from ..session.prewarm import stats_snapshot
+                worker = getattr(srv, "prewarm", None) if srv else None
+                body = worker.snapshot() if worker is not None \
+                    else {"stats": stats_snapshot()}
+                self._send(200, json.dumps(body, default=str).encode())
+                return
             if parsed.path == "/status":
                 from ..server.protocol import SERVER_VERSION
                 body = json.dumps({
@@ -81,6 +88,7 @@ def _make_handler(server_ref):
                            b'<a href="/debug/trace">traces</a> '
                            b'<a href="/debug/slowlog">slowlog</a> '
                            b'<a href="/debug/stmtsummary">stmtsummary</a> '
+                           b'<a href="/debug/prewarm">prewarm</a> '
                            b'<a href="/debug/threads">threads</a>',
                            "text/html")
             else:

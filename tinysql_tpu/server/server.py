@@ -341,6 +341,13 @@ class Server:
         # DDL syncer barrier sees this server catch up
         from ..domain import Domain
         self.domain = Domain(storage, lease_s=lease_s, background=True)
+        # stats-driven auto-prewarm (session/prewarm.py): a background
+        # worker that AOT-compiles the hottest digest families from
+        # statements_summary off the query path — the serving-side cure
+        # for the 15s+ first-run XLA compile.  Gated at runtime by the
+        # GLOBAL tidb_auto_prewarm sysvar (re-read every cycle).
+        from ..session.prewarm import PrewarmWorker
+        self.prewarm = PrewarmWorker(storage, domain=self.domain)
         self.host = host
         self.port = port
         self.sock: Optional[socket.socket] = None
@@ -360,6 +367,7 @@ class Server:
         t = threading.Thread(target=self._accept_loop, daemon=True,
                              name="mysql-accept")
         t.start()
+        self.prewarm.start()
         log.info("listening on %s:%d", self.host, self.port)
         return self.port
 
@@ -382,6 +390,7 @@ class Server:
     def close(self) -> None:
         """Graceful drain (reference: server.go:155-283)."""
         self._closed.set()
+        self.prewarm.close()
         self.domain.close()
         if self.sock is not None:
             try:

@@ -100,6 +100,21 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # failpoint arming spec (fail.configure): "name=error(msg);..." —
     # process-global, empty string disarms everything
     "tidb_failpoints": "",
+    # stats-driven auto-prewarm (session/prewarm.py PrewarmWorker, wired
+    # into the server lifecycle): a background worker ranks the top-K
+    # (digest, bucket) families from statements_summary by exec count x
+    # observed miss cost and AOT-compiles their programs off the query
+    # path.  The worker reads the GLOBAL scope (SET GLOBAL) each cycle.
+    "tidb_auto_prewarm": 1,
+    "tidb_auto_prewarm_top_k": 8,
+    # seconds between worker cycles (first cycle fires one interval
+    # after server start, never at startup)
+    "tidb_auto_prewarm_interval": 60,
+    # per-cycle warming wall budget in MILLISECONDS (0 = unlimited):
+    # once spent, remaining candidates wait for the next cycle
+    "tidb_auto_prewarm_budget_ms": 60000,
+    # seconds a warmed (or failed) family is exempt from re-warming
+    "tidb_auto_prewarm_cooldown": 600,
 }
 
 
@@ -180,6 +195,9 @@ class Session:
         # wire identity (the server fills this in after auth; embedded
         # sessions have no user)
         self.user = ""
+        # internal sessions (auto-prewarm worker) execute real statements
+        # but stay OUT of the observability fan-out (_finish_obs)
+        self.internal = False
         # statement interruption (utils/interrupt.py): a process-unique
         # connection id (the KILL target / server thread id) + the guard
         # any thread may flip to abort the running statement
@@ -360,7 +378,12 @@ class Session:
         ring (/debug/trace), the structured slow-query log, the
         statement-summary store (THE designated stmtsummary write hook —
         qlint OB403), and the bucket-prewarm feedback file.  Never
-        raises."""
+        raises.  INTERNAL sessions (the auto-prewarm worker) skip the
+        fan-out entirely: their warming executions must not inflate
+        statements_summary (the worker ranks from it — feeding its own
+        runs back in would self-amplify), the slow log, or /metrics."""
+        if self.internal:
+            return
         from ..obs import metrics as obs_metrics
         from ..obs import slowlog as obs_slowlog
         from ..obs import stmtsummary
@@ -822,7 +845,11 @@ class Session:
     #: the SET, not silently disable the feature at read time)
     _UINT_SYSVARS = ("max_execution_time", "tidb_mem_quota_query",
                      "tidb_stmt_summary_refresh_interval",
-                     "tidb_stmt_summary_max_stmt_count")
+                     "tidb_stmt_summary_max_stmt_count",
+                     "tidb_auto_prewarm_top_k",
+                     "tidb_auto_prewarm_interval",
+                     "tidb_auto_prewarm_budget_ms",
+                     "tidb_auto_prewarm_cooldown")
 
     @staticmethod
     def _validate_uint_sysvar(name: str, v: Datum) -> int:

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Cold-start smoke (the CI ``prewarm-smoke`` job).
+
+End-to-end assertion chain over a tiny TPC-H load:
+
+1. **literal parameterization** — run Q6, then a constant-variant of Q6
+   (same normalized-SQL digest, different date / discount / quantity
+   literals): the variant must compile NOTHING (progcache miss delta 0)
+   — one compiled program serves the whole digest family;
+2. **auto-prewarm worker** — reset the program registry (a fresh
+   process's cache) while statements_summary still knows the family,
+   run one PrewarmWorker cycle, and prove the next variant query is
+   all prewarm-seeded hits (``prewarm_hits > 0``, zero compiles);
+3. **warm.py --from-stats** — with a RuntimeStats feedback file
+   recorded from real executions, drive the CLI end-to-end and assert
+   it AOT-compiled the observed buckets.
+
+Exit 0 on success; prints one line per check.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    print(f"[prewarm-smoke] {'ok' if ok else 'FAIL'}: {name}"
+          f"{' — ' + detail if detail else ''}")
+    if not ok:
+        sys.exit(1)
+
+
+def main() -> int:
+    fb_path = os.path.join(tempfile.mkdtemp(prefix="prewarm_smoke_"),
+                           "feedback.jsonl")
+    os.environ["TINYSQL_STATS_FEEDBACK"] = fb_path
+
+    from tinysql_tpu.bench import tpch
+    from tinysql_tpu.obs import stmtsummary
+    from tinysql_tpu.ops import kernels, progcache
+    from tinysql_tpu.session.prewarm import PrewarmWorker
+    from tinysql_tpu.session.session import new_session
+
+    s = new_session()
+    sf = float(os.environ.get("TPCH_SF", "0.05"))
+    tpch.load(s, sf=sf, data=tpch.generate(sf))
+    s.execute("set @@tidb_use_tpu = 1")
+
+    # ---- 1: two constant-variants of Q6 -> ONE compile ------------------
+    q6a = tpch.Q6
+    q6b = (tpch.Q6.replace("1994-01-01", "1994-02-15")
+           .replace("0.05", "0.03").replace("24", "19"))
+    snap = kernels.stats_snapshot()
+    rows_a = s.query(q6a).rows
+    d_first = kernels.stats_delta(snap)
+    snap = kernels.stats_snapshot()
+    rows_b = s.query(q6b).rows
+    d_var = kernels.stats_delta(snap)
+    check("Q6 executes", len(rows_a) == 1 and len(rows_b) == 1)
+    check("constant-variant compiles nothing",
+          d_var.get("progcache_misses", 0) == 0,
+          f"first={d_first.get('progcache_misses', 0)} compiles, "
+          f"variant={d_var.get('progcache_misses', 0)}")
+    da, db = stmtsummary.normalize(q6a)[0], stmtsummary.normalize(q6b)[0]
+    check("variants share one digest family", da == db, da)
+    # Q1 takes the fused device path at every SF (Q6 may cop-push at
+    # tiny SF): its variant changes BOTH filter and agg-arg literals
+    q1b = (tpch.Q1.replace("1998-09-02", "1998-05-05")
+           .replace("(1 - l_discount)", "(2 - l_discount)"))
+    s.query(tpch.Q1)
+    snap = kernels.stats_snapshot()
+    s.query(q1b)
+    d_q1 = kernels.stats_delta(snap)
+    check("Q1 agg-constant variant reuses the compiled family",
+          d_q1.get("progcache_misses", 0) == 0
+          and d_q1.get("dispatches", 0) > 0,
+          f"misses={d_q1.get('progcache_misses', 0)} "
+          f"dispatches={d_q1.get('dispatches', 0)}")
+
+    # ---- 2: worker cycle warms the family for a cold program cache ------
+    s.query(tpch.Q1)  # a second family with real compile weight
+    progcache.clear()
+    g = getattr(s.storage, "_global_vars", None)
+    if g is None:
+        g = s.storage._global_vars = {}
+    g.update({"tidb_auto_prewarm": 1, "tidb_auto_prewarm_cooldown": 0})
+    w = PrewarmWorker(s.storage)
+    try:
+        rep = w.run_cycle()
+        check("worker cycle warmed families", bool(rep.get("warmed")),
+              json.dumps(rep, default=str))
+        snap = kernels.stats_snapshot()
+        s.query(tpch.Q1.replace("1998-09-02", "1998-06-30"))
+        d = kernels.stats_delta(snap)
+        check("first run of a seen family avoids full compile",
+              d.get("progcache_misses", 0) == 0
+              and d.get("prewarm_hits", 0) > 0,
+              f"misses={d.get('progcache_misses', 0)} "
+              f"prewarm_hits={d.get('prewarm_hits', 0)}")
+    finally:
+        w.close()
+
+    # ---- 3: warm.py --from-stats end-to-end -----------------------------
+    check("feedback file recorded", os.path.exists(fb_path), fb_path)
+    env = dict(os.environ, TPCH_SF=str(sf))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "warm.py"),
+         "--sf", str(sf), "--queries", "Q6", "--from-stats", fb_path],
+        capture_output=True, text=True, timeout=900, env=env)
+    check("warm.py --from-stats exits 0", r.returncode == 0,
+          (r.stderr or "")[-400:])
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    check("warm.py merged observed buckets",
+          bool(out.get("observed_buckets")), json.dumps(out))
+    check("warm.py AOT-compiled programs",
+          out.get("aot_programs", 0) > 0, json.dumps(out))
+    print("[prewarm-smoke] all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

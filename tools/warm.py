@@ -34,19 +34,12 @@ sys.path.insert(0, REPO_ROOT)
 
 
 def plan_buckets(session, sql: str) -> set:
-    """Plan one statement (parse -> logical -> placed physical, no
-    execution) and return its estimated shape buckets."""
-    from tinysql_tpu.parser import parse
-    from tinysql_tpu.planner.builder import PlanBuilder
-    from tinysql_tpu.planner.buckets import bucket_estimates
-    try:
-        phys = session._optimize(
-            PlanBuilder(session).build_select(parse(sql)[0]), True)
-        return bucket_estimates(phys, session.sysvars)
-    except Exception:
-        return set()  # warming must never fail the caller
-    finally:
-        session._pinned_is = None
+    """Plan one statement (no execution) -> estimated shape buckets.
+    ONE implementation shared with the serving-side auto-prewarm worker
+    (session/prewarm.py) — this CLI is the manual/offline form of the
+    same warming."""
+    from tinysql_tpu.session.prewarm import plan_buckets as _pb
+    return _pb(session, sql)
 
 
 def warm_queries(session, queries: dict, verbose: bool = True,
@@ -56,7 +49,7 @@ def warm_queries(session, queries: dict, verbose: bool = True,
     RuntimeStats feedback file when ``stats_path`` names one), then
     execute each query once.  Returns a summary dict for the bench
     JSON."""
-    from tinysql_tpu.ops import kernels
+    from tinysql_tpu.ops import kernels, progcache
     t0 = time.time()
     snap = kernels.stats_snapshot()
     buckets = set()
@@ -76,19 +69,22 @@ def warm_queries(session, queries: dict, verbose: bool = True,
             print(f"[warm] feedback {stats_path}: buckets "
                   f"{sorted(observed)}", file=sys.stderr)
     aot = 0
-    for nb in sorted(buckets):
-        aot += kernels.prewarm_bucket(nb)
-    for name, sql in queries.items():
-        tq = time.time()
-        try:
-            session.query(sql)
-        except Exception as e:  # a broken query must not break warming
+    # prewarm scope: programs built below are marked prewarm-seeded in
+    # ops/progcache, so later query-path hits count as prewarm_hits
+    with progcache.prewarm_scope():
+        for nb in sorted(buckets):
+            aot += kernels.prewarm_bucket(nb)
+        for name, sql in queries.items():
+            tq = time.time()
+            try:
+                session.query(sql)
+            except Exception as e:  # a broken query must not break warming
+                if verbose:
+                    print(f"[warm] {name} failed: {e}", file=sys.stderr)
+                continue
             if verbose:
-                print(f"[warm] {name} failed: {e}", file=sys.stderr)
-            continue
-        if verbose:
-            print(f"[warm] {name} executed in {time.time() - tq:.2f}s",
-                  file=sys.stderr)
+                print(f"[warm] {name} executed in {time.time() - tq:.2f}s",
+                      file=sys.stderr)
     delta = kernels.stats_delta(snap)
     out = {
         "buckets": sorted(buckets),
@@ -96,6 +92,7 @@ def warm_queries(session, queries: dict, verbose: bool = True,
         "aot_programs": aot,
         "programs_traced": delta.get("progcache_misses", 0),
         "programs_reused": delta.get("progcache_hits", 0),
+        "prewarm_seeded": delta.get("prewarm_seeded", 0),
         "cache_dir": kernels._cache_dir(),
         "warm_s": round(time.time() - t0, 2),
     }
