@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Serving benchmark: N concurrent MySQL wire connections through the
+bounded statement pool (server/pool.py), admission control
+(server/admission.py), and the same-digest micro-batcher
+(ops/batching.py).
+
+Two phases over a loaded TPC-H dataset (SERVE_SF, default 0.02):
+
+1. **mixed** — every client loops a mixed workload (Q1 / Q3 / Q6
+   constant variants + point and short scans) for SERVE_REQUESTS
+   statements; per-statement latency is recorded client-side.
+2. **storm** — all clients fire SERVE_STORM same-digest Q6 constant
+   variants concurrently: the coalescer must form batches with
+   occupancy > 1 and ZERO program compiles (the family is warm), with
+   results identical to solo execution.
+
+Publishes BENCH metric lines (one JSON object per line, matching
+bench.py's contract):
+
+    {"metric": "serve_qps",    "value": ..., "unit": "qps", "detail": {...}}
+    {"metric": "serve_p99_ms", "value": ..., "unit": "ms"}
+
+Hard assertions (the serve-smoke CI gate): zero statement errors, at
+least one coalesced batch with occupancy > 1 in the storm, zero
+progcache misses across the storm, storm results == solo results.
+
+Env knobs: SERVE_CLIENTS (8), SERVE_SF (0.02), SERVE_REQUESTS (24,
+per client, mixed phase), SERVE_STORM (32, total storm statements),
+SERVE_POOL (4), SERVE_QUEUE (256).
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+# the MiniClient protocol driver lives with the wire tests — reuse it
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "tests"))
+
+
+def _pct(xs, p):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(p / 100.0 * (len(xs) - 1))))]
+
+
+def main():
+    t_start = time.time()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from tinysql_tpu.ops import kernels
+    kernels.ensure_live_backend()
+
+    n_clients = int(os.environ.get("SERVE_CLIENTS", "8"))
+    sf = float(os.environ.get("SERVE_SF", "0.02"))
+    n_requests = int(os.environ.get("SERVE_REQUESTS", "24"))
+    n_storm = int(os.environ.get("SERVE_STORM", "32"))
+
+    from test_server import MiniClient
+    from tinysql_tpu.bench import tpch
+    from tinysql_tpu.kv import new_mock_storage
+    from tinysql_tpu.ops import batching, progcache
+    from tinysql_tpu.server.admission import stats_snapshot as adm_stats
+    from tinysql_tpu.server.server import Server
+    from tinysql_tpu.session.session import Session
+
+    storage = new_mock_storage()
+    boot = Session(storage)
+    print(f"[serve] generating + loading TPC-H SF={sf} ...",
+          file=sys.stderr)
+    t0 = time.time()
+    counts = tpch.load(boot, sf=sf)
+    print(f"[serve] loaded {counts} in {time.time() - t0:.1f}s",
+          file=sys.stderr)
+    # serving knobs: the pool reads the GLOBAL scope live.  The row gate
+    # drops to 64 because smoke-scale data (SF 0.02) leaves selective
+    # filters with estRows below the default 8192 — the serve bench is
+    # about the serving path, not the placement heuristic
+    boot.execute("set global tidb_tpu_min_rows = 64")
+    boot.execute("set global tidb_slow_log_threshold = 60000")
+    boot.execute(f"set global tidb_stmt_pool_size = "
+                 f"{int(os.environ.get('SERVE_POOL', '4'))}")
+    boot.execute(f"set global tidb_stmt_pool_queue_depth = "
+                 f"{int(os.environ.get('SERVE_QUEUE', '256'))}")
+    boot.execute("set global tidb_batch_window_ms = 10")
+    boot.execute("set global tidb_auto_prewarm = 0")  # determinism
+
+    def q6_variant(i: int) -> str:
+        lo = 0.03 + (i % 5) * 0.01
+        return (tpch.Q6.replace("0.05", f"{lo:.2f}")
+                .replace("0.07", f"{lo + 0.02:.2f}")
+                .replace("24", str(20 + (i % 9))))
+
+    def q1_variant(i: int) -> str:
+        day = 1 + (i % 27)
+        return tpch.Q1.replace("1998-09-02", f"1998-08-{day:02d}")
+
+    def q3_variant(i: int) -> str:
+        day = 1 + (i % 27)
+        return tpch.Q3.replace("1995-03-15", f"1995-03-{day:02d}")
+
+    # warm the programs + teach the coalescer the digest families OUTSIDE
+    # the timed window (cold start is PR 6's prewarm story; this bench
+    # measures sustained throughput)
+    print("[serve] warming programs ...", file=sys.stderr)
+    warm = Session(storage)
+    warm.execute("use tpch")
+    t0 = time.time()
+    for sql in (tpch.Q1, tpch.Q3, tpch.Q6, q6_variant(1), q1_variant(1)):
+        warm.query(sql)
+    print(f"[serve] warm in {time.time() - t0:.1f}s", file=sys.stderr)
+
+    srv = Server(storage, port=0)
+    srv.start()
+    max_key = int(counts["lineitem"])
+
+    workload = []
+    for i in range(n_requests):
+        k = (i * 7919) % max_key + 1
+        workload.append([
+            q1_variant(i), q3_variant(i), q6_variant(i),
+            f"select l_quantity, l_extendedprice from lineitem "
+            f"where l_id = {k}",
+            "select count(*), max(o_totalprice) from orders "
+            f"where o_custkey = {k % 1000 + 1}",
+        ][i % 5])
+
+    errors = []
+    lat_ms = []
+    lat_mu = threading.Lock()
+
+    def client_loop(cid: int):
+        try:
+            c = MiniClient(srv.port, db="tpch")
+        except Exception as e:
+            errors.append(f"connect[{cid}]: {e}")
+            return
+        try:
+            for i, sql in enumerate(workload):
+                t0 = time.time()
+                try:
+                    c.query(sql)
+                except Exception as e:
+                    errors.append(f"c{cid} req{i}: {e}")
+                    continue
+                with lat_mu:
+                    lat_ms.append((time.time() - t0) * 1e3)
+        finally:
+            c.close()
+
+    print(f"[serve] mixed phase: {n_clients} clients x "
+          f"{n_requests} requests ...", file=sys.stderr)
+    t0 = time.time()
+    threads = [threading.Thread(target=client_loop, args=(i,), daemon=True)
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(600)
+    hung = sum(1 for t in threads if t.is_alive())
+    if hung:
+        # a hung client records neither an error nor a latency sample —
+        # without this the gate would pass vacuously on a wedged pool
+        errors.append(f"{hung} client thread(s) still running after join")
+    mixed_wall = time.time() - t0
+    qps = len(lat_ms) / max(mixed_wall, 1e-9)
+    p50, p99 = _pct(lat_ms, 50), _pct(lat_ms, 99)
+    print(f"[serve] mixed: {len(lat_ms)} ok in {mixed_wall:.1f}s "
+          f"qps={qps:.1f} p50={p50:.1f}ms p99={p99:.1f}ms "
+          f"errors={len(errors)}", file=sys.stderr)
+
+    # ---- storm: same-digest constant variants, coalescing required ------
+    solo_ref = {}
+    for i in range(n_storm):
+        sql = q6_variant(i)
+        if sql not in solo_ref:
+            solo_ref[sql] = warm.query(sql).rows
+    storm_errors = []
+    storm_mismatch = []
+
+    storm_done = [0]
+
+    def canon(rows):
+        return [["N" if v is None else repr(float(v)) for v in r]
+                for r in rows]
+
+    def storm_client(cid: int, jobs):
+        try:
+            c = MiniClient(srv.port, db="tpch")
+        except Exception as e:
+            storm_errors.append(f"connect[{cid}]: {e}")
+            return
+        try:
+            for sql in jobs:
+                # one try around query AND comparison: a comparison
+                # error must count as a storm error, never kill the
+                # thread silently mid-job-list
+                try:
+                    _, rows = c.query(sql)
+                    if canon(solo_ref[sql]) != canon(rows):
+                        storm_mismatch.append(
+                            (sql, solo_ref[sql], rows))
+                except Exception as e:
+                    storm_errors.append(f"c{cid}: {e!r}")
+                    continue
+                with lat_mu:
+                    storm_done[0] += 1
+        finally:
+            c.close()
+
+    storm = None
+    for attempt in range(3):
+        storm_done[0] = 0
+        jobs = [[] for _ in range(n_clients)]
+        for i in range(n_storm):
+            jobs[i % n_clients].append(q6_variant(i))
+        # per-attempt baselines: the published storm detail must cover
+        # exactly ONE storm window, not counters accumulated across
+        # retries
+        batch0 = batching.stats_snapshot()
+        miss0 = progcache.stats_snapshot()["misses"]
+        t0 = time.time()
+        threads = [threading.Thread(target=storm_client, args=(i, jobs[i]),
+                                    daemon=True)
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        if any(t.is_alive() for t in threads):
+            storm_errors.append("storm client thread(s) hung")
+        storm_wall = time.time() - t0
+        bd = {k: v - batch0.get(k, 0)
+              for k, v in batching.stats_snapshot().items()}
+        storm = {
+            "statements": n_storm, "wall_s": round(storm_wall, 3),
+            "qps": round(n_storm / max(storm_wall, 1e-9), 1),
+            "progcache_misses": progcache.stats_snapshot()["misses"]
+            - miss0,
+            "attempts": attempt + 1, **bd,
+        }
+        if bd.get("batches", 0) >= 1 and bd.get("occupancy_sum", 0) \
+                > bd.get("batches", 0):
+            break  # at least one round with occupancy > 1
+        print(f"[serve] storm attempt {attempt + 1}: no multi-member "
+              f"batch yet ({bd}), retrying", file=sys.stderr)
+    print(f"[serve] storm: {storm}", file=sys.stderr)
+
+    srv.close()
+    adm = adm_stats()
+    detail = {
+        "clients": n_clients, "sf": sf,
+        "requests_ok": len(lat_ms), "errors": len(errors),
+        "p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
+        "wall_s": round(mixed_wall, 2),
+        "admission": adm, "batching": batching.stats_snapshot(),
+        "storm": storm,
+        "total_bench_seconds": round(time.time() - t_start, 1),
+    }
+    print(json.dumps({"metric": "serve_qps", "value": round(qps, 2),
+                      "unit": "qps", "detail": detail}))
+    print(json.dumps({"metric": "serve_p99_ms", "value": round(p99, 2),
+                      "unit": "ms"}))
+
+    # ---- the serve-smoke gate -------------------------------------------
+    assert not errors, errors[:5]
+    assert not storm_errors, storm_errors[:5]
+    assert not storm_mismatch, storm_mismatch[:1]
+    assert len(lat_ms) == n_clients * n_requests, \
+        (len(lat_ms), n_clients * n_requests)
+    assert storm_done[0] == n_storm, (storm_done[0], n_storm)
+    assert qps > 0, "zero throughput"
+    assert storm["progcache_misses"] == 0, storm
+    assert storm["batches"] >= 1 and storm["occupancy_sum"] \
+        > storm["batches"], f"no coalesced batch with occupancy > 1: {storm}"
+    print("[serve] OK", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

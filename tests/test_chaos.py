@@ -400,6 +400,88 @@ def _prewarm_compile_error(tk):
         s.storage._global_vars.pop("tidb_auto_prewarm_cooldown", None)
 
 
+@chaos("admissionQueueFull")
+def _admission_queue_full(tk):
+    """Forced queue-full verdict: every pooled statement sheds with the
+    TYPED 1041 + retry hint over the real wire, control statements keep
+    answering, and disarming restores service — nothing wedges."""
+    from test_server import MiniClient
+    from tinysql_tpu.server.server import Server
+    s, _ = tk
+    srv = Server(s.storage, port=0)
+    srv.start()
+    try:
+        c = MiniClient(srv.port, db="c")
+        with fail.armed("admissionQueueFull"):
+            with pytest.raises(RuntimeError) as ei:
+                c.query("select count(*) from t")
+            assert "1041" in str(ei.value) and "retry" in str(ei.value)
+            # the control plane bypasses the pool: still answers while
+            # every pooled statement is shed
+            assert c.query("show databases")
+        # disarmed: the same connection serves again
+        assert c.query("select count(*) from t")[1] == [["500"]]
+        c.close()
+    finally:
+        srv.close()
+
+
+@chaos("admissionDelay")
+def _admission_delay(tk):
+    """A wedged pool worker (sleep action with the entry claimed): the
+    queue builds behind it, a QUEUED statement still answers KILL with
+    1317, and an error action surfaces typed — the accept loop and the
+    control plane never hang."""
+    import threading as _th
+    from test_server import MiniClient
+    from tinysql_tpu.server.server import Server
+    s, _ = tk
+    s.storage._global_vars["tidb_stmt_pool_size"] = 1
+    srv = Server(s.storage, port=0)
+    srv.start()
+    try:
+        c1 = MiniClient(srv.port, db="c")
+        victim = MiniClient(srv.port, db="c")
+        victim.query("select 1")
+        victim_id = max(srv.conns)
+        box = []
+        with fail.armed("admissionDelay", sleep=0.8, times=1):
+            t1 = _th.Thread(
+                target=lambda: box.append(c1.query("select count(*) from t")))
+            t1.start()
+            time.sleep(0.2)  # the single worker is wedged with c1's entry
+
+            def _queued():
+                try:
+                    box.append(victim.query("select count(*) from t"))
+                except RuntimeError as e:
+                    box.append(e)
+            t2 = _th.Thread(target=_queued)
+            t2.start()
+            time.sleep(0.2)
+            killer = MiniClient(srv.port)  # accept loop alive while wedged
+            killer.query(f"kill query {victim_id}")
+            t2.join(10)
+            assert not t2.is_alive(), "queued statement unkillable"
+            t1.join(10)
+        assert any(isinstance(b, RuntimeError) and "1317" in str(b)
+                   for b in box), box
+        # the wedged entry itself completed once the sleep elapsed
+        assert any(not isinstance(b, RuntimeError) for b in box), box
+        # error action: typed statement error, worker survives
+        c3 = MiniClient(srv.port, db="c")
+        with fail.armed("admissionDelay",
+                        exc=RuntimeError("injected pool fault"), times=1):
+            with pytest.raises(RuntimeError):
+                c3.query("select count(*) from t")
+        assert c3.query("select count(*) from t")[1] == [["500"]]
+        for c in (c1, victim, killer, c3):
+            c.close()
+    finally:
+        srv.close()
+        s.storage._global_vars.pop("tidb_stmt_pool_size", None)
+
+
 def test_chaos_covers_entire_catalogue():
     """A failpoint registered without a chaos driver is a seam nobody
     proved degrades cleanly — fail loudly right here."""

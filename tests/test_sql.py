@@ -253,7 +253,8 @@ def test_set_and_show_variables(tk):
     tk.must_exec("set @@tidb_max_chunk_size = 64, @x = 41")
     tk.must_query("select @@tidb_max_chunk_size, @x + 1").check(rows("64 42"))
     r = tk.must_query("show variables like 'tidb_max%'")
-    assert r.as_str() == [["tidb_max_chunk_size", "64"]]
+    assert r.as_str() == [["tidb_max_chunk_size", "64"],
+                          ["tidb_max_server_connections", "0"]]
 
 
 def test_show_statements(tk):

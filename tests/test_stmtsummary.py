@@ -194,9 +194,11 @@ def test_summary_row_carries_sample_plan_and_digest():
     rec = [r for r in stmtsummary.snapshot()
            if r["sample_sql"] == "select count(*) from t"]
     assert rec and rec[0]["plan_digest"]
+    cols = [c for c, _ in stmtsummary.COLUMNS]
+    i_sql, i_plan = cols.index("sample_sql"), cols.index("sample_plan")
     row = [r for r in stmtsummary.rows()
-           if r[27] == "select count(*) from t"][0]
-    assert "TableReader" in row[28] or "HashAgg" in row[28]  # sample_plan
+           if r[i_sql] == "select count(*) from t"][0]
+    assert "TableReader" in row[i_plan] or "HashAgg" in row[i_plan]
 
 
 def test_digest_join_slow_query_roundtrip():

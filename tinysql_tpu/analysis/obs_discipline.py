@@ -46,8 +46,11 @@ register_rules({
              "session statement-close hook",
 })
 
-#: modules that own a STATS dict and its accessors
-OWNING_MODULES = ("kernels.py", "progcache.py")
+#: modules that own a STATS dict and its accessors (the serving layer's
+#: admission/batching counters follow the same discipline: locked
+#: accessor writes inside the owning module, snapshot reads anywhere)
+OWNING_MODULES = ("kernels.py", "progcache.py", "admission.py",
+                  "batching.py")
 
 #: modules allowed to write the statement-summary store: the store
 #: itself and the session statement-close hook that feeds it

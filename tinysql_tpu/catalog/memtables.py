@@ -151,6 +151,8 @@ def _processlist_rows() -> List[list]:
     out: List[list] = []
     for cid, sess in interrupt.sessions():
         running = bool(getattr(sess, "stmt_running", False))
+        queued = not running and \
+            getattr(sess, "stmt_state", "") == "queued"
         qobs = getattr(sess, "last_query_stats", None)
         elapsed_ms = 0
         mem = 0
@@ -163,10 +165,17 @@ def _processlist_rows() -> List[list]:
             mt = getattr(sess, "_stmt_mem", None)
             if mt is not None:
                 mem = mt.consumed
+        elif queued:
+            # waiting in the statement pool's admission queue
+            # (server/pool.py): no worker yet, so no obs scope / memory
+            # — but the statement and its wait are live, KILLable state
+            info = getattr(sess, "pending_sql", "")[:512]
+            elapsed_ms = int((now - getattr(sess, "queue_ts", now)) * 1e3)
         out.append([cid, getattr(sess, "user", "") or "",
                     getattr(sess, "current_db", ""),
-                    "Query" if running else "Sleep", elapsed_ms,
-                    "executing" if running else "", mem, info, digest])
+                    "Query" if running or queued else "Sleep", elapsed_ms,
+                    "executing" if running
+                    else ("queued" if queued else ""), mem, info, digest])
     out.sort(key=lambda r: r[0])
     return out
 

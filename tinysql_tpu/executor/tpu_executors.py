@@ -650,9 +650,12 @@ class TPUHashAggExec(Executor):
         # ---- run --------------------------------------------------------
         if not plan.group_by:
             out_keys = []
+            # batchable: THE single-shot dispatch cross-query
+            # micro-batching coalesces (ops/batching.py) — blockwise /
+            # sharded / passthrough variants stay solo
             out_aggs, first_orig = kernels.fused_scalar_aggregate(
                 dev_cols, specs, progs, n, nb, mask_spec,
-                program_key=program_key, params=params)
+                program_key=program_key, params=params, batchable=True)
         else:
             gid_dev = rep.memo(
                 ("gid_dev", tuple(slot_ids[e.index]
@@ -677,7 +680,8 @@ class TPUHashAggExec(Executor):
                 present, out_aggs, first_orig = \
                     kernels.fused_segment_aggregate(
                         dev_cols, gid_dev, n_segments, specs, progs, n,
-                        mask_spec, program_key=program_key, params=params)
+                        mask_spec, program_key=program_key, params=params,
+                        batchable=True)
             out_keys = self._decode_present(present, key_layouts)
         return self._assemble_output(chk, plan, slots, out_keys, out_aggs,
                                      first_orig,

@@ -78,6 +78,18 @@ PREWARM_COMPILE_ERROR = register(
     "the worker must count the error, start the family's cooldown, and "
     "keep serving later candidates and cycles (session/prewarm.py)")
 
+# ---- serving / admission ---------------------------------------------------
+ADMISSION_QUEUE_FULL = register(
+    "admissionQueueFull",
+    "admission gate reports the statement queue full — every pooled "
+    "statement sheds with typed MySQL 1041 + retry hint; control "
+    "statements and KILL keep working (server/admission.py)")
+ADMISSION_DELAY = register(
+    "admissionDelay",
+    "statement-pool worker stalls (sleep) or fails (error) with an "
+    "entry claimed — the queue builds behind it, queued statements stay "
+    "KILLable, the accept loop never hangs (server/pool.py)")
+
 # ---- executor --------------------------------------------------------------
 EXEC_SLOW_NEXT = register(
     "execSlowNext",

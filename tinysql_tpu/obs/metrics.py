@@ -203,6 +203,52 @@ def render_prometheus() -> str:
     except Exception:
         pass
 
+    # serving-layer counters: admission verdicts (server/admission.py)
+    # and cross-query micro-batching (ops/batching.py)
+    try:
+        from ..server.admission import stats_snapshot as adm_stats
+        adm = adm_stats()
+    except Exception:
+        adm = {}
+    if adm:
+        for key, help_text in (
+                ("admitted", "Statements that began executing on the "
+                             "statement pool"),
+                ("queued", "Statements that waited in the admission "
+                           "queue first"),
+                ("rejected", "Statements shed by admission control "
+                             "(MySQL 1041)")):
+            emit(f"tinysql_admission_{key}_total", help_text, "counter",
+                 [((), adm.get(key, 0))])
+    try:
+        from ..server.pool import gauges as pool_gauges
+        pg = pool_gauges()
+    except Exception:
+        pg = None
+    if pg is not None:
+        emit("tinysql_pool_queued", "Statements waiting in the admission "
+             "queue (live pools)", "gauge", [((), pg["queued"])])
+        emit("tinysql_pool_running", "Statements executing on pool "
+             "workers (live pools)", "gauge", [((), pg["running"])])
+    try:
+        from ..ops.batching import stats_snapshot as batch_stats
+        bst = batch_stats()
+    except Exception:
+        bst = {}
+    if bst:
+        emit("tinysql_batch_rounds_total",
+             "Coalesced same-digest batch rounds dispatched", "counter",
+             [((), bst.get("batches", 0))])
+        emit("tinysql_batch_statements_total",
+             "Statements served through a batch round dispatch",
+             "counter", [((), bst.get("batched_statements", 0))])
+        emit("tinysql_batch_occupancy_sum",
+             "Summed batch occupancy (divide by rounds for the average)",
+             "counter", [((), bst.get("occupancy_sum", 0))])
+        emit("tinysql_batch_fallbacks_total",
+             "Replay consume misses that fell back to solo dispatch",
+             "counter", [((), bst.get("fallbacks", 0))])
+
     # per-phase statement latency histograms, fed from the statement
     # summary store's ingest path (obs/stmtsummary.py) — the SQL-visible
     # aggregates and the Prometheus histograms share one write hook

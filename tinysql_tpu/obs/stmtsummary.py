@@ -198,6 +198,7 @@ class StmtRecord:
             int(d.get("d2h_bytes", 0)), int(d.get("progcache_hits", 0)),
             int(d.get("progcache_misses", 0)),
             int(d.get("pipe_blocks", 0)), self._overlap_frac(),
+            int(d.get("coalesced", 0)),
             self.max_mem, self.sum_rows,
             _ts(self.first_seen) if self.first_seen else "",
             _ts(self.last_seen) if self.last_seen else "",
@@ -227,6 +228,7 @@ COLUMNS = [
     ("dispatches", "int"), ("d2h_transfers", "int"), ("d2h_bytes", "int"),
     ("compile_cache_hits", "int"), ("compile_cache_misses", "int"),
     ("pipe_blocks", "int"), ("pipe_overlap_frac", "real"),
+    ("coalesced", "int"),
     ("max_mem_bytes", "int"), ("sum_rows_returned", "int"),
     ("first_seen", "str"), ("last_seen", "str"),
     ("sample_sql", "str"), ("sample_plan", "str"),

@@ -1,0 +1,246 @@
+"""Cross-query micro-batching: the same-digest coalescer core.
+
+PR 6's literal parameterization made every query in a normalized-SQL
+digest family share ONE compiled program with its constants as runtime
+operands (exprjit.ParamTable).  This module supplies the other half of
+the serving win: when several concurrently-admitted statements belong
+to the same WARM family, the statement pool (server/pool.py) executes
+them as one *batch round* — N ParamTables through one compiled program
+in a single back-to-back device round — instead of N independent,
+interleaved dispatches.
+
+Protocol (driven by the pool's worker thread; all members run
+sequentially on it):
+
+1. **collect** — each member statement executes normally under the
+   round's collect scope.  When its fused aggregate reaches the device
+   dispatch boundary with a params-compiled dev mask AND the program
+   already warm (ops/kernels.py fused entries, ``batchable=True`` call
+   sites), it *parks*: the round captures ``(program key, cached
+   program, non-param args, this member's params)`` and the statement
+   aborts with :class:`Parked` (invisible to observability — the
+   session skips the obs fan-out for parked attempts).  Members whose
+   statements never reach a batchable dispatch (host paths, cold
+   programs, non-SELECTs) simply COMPLETE during collect: transparent
+   solo fallback.
+2. **dispatch** — the round pushes every parked member's ParamTable
+   through the captured compiled program back-to-back (one device
+   round, zero host work in between, zero compiles by construction).
+3. **replay** — each parked member re-executes; at the same boundary it
+   *consumes* its precomputed device output (matched by program key +
+   the identity of the staged device arrays + its own param bytes) and
+   the rest of the statement — unpack, d2h, result assembly,
+   observability — runs normally in the member's own scope.  A consume
+   miss (the replica rotated between phases, plan re-placed, ...)
+   falls through to a plain solo dispatch: batching is an optimization,
+   never a correctness dependency.
+
+Family eligibility is learned, not declared: the session's statement
+close hook calls :func:`note_family` for statements that executed a
+batchable fused dispatch (the ``batchable`` obs marker), and the pool
+only forms rounds for digests seen here.
+
+Counter-write discipline: ``STATS`` is written only through this
+module's accessors (qlint OB401/OB402 — batching.py is an owning
+module).
+"""
+from __future__ import annotations
+
+import contextvars
+import threading
+from typing import Dict, List, Optional
+
+#: process-total coalescing counters (exported to /metrics and the
+#: serve bench): batches = rounds that dispatched >= 1 parked member,
+#: batched_statements = members served from a round dispatch,
+#: occupancy_sum / batches = average batch occupancy, parks / replays
+#: the protocol legs, fallbacks = replay consume misses (solo re-dispatch)
+STATS = {"batches": 0, "batched_statements": 0, "occupancy_sum": 0,
+         "parks": 0, "replays": 0, "fallbacks": 0}
+_stats_mu = threading.Lock()
+
+
+def _stat_add(key: str, n: int = 1) -> None:
+    with _stats_mu:
+        STATS[key] = STATS.get(key, 0) + n
+
+
+def stats_snapshot() -> Dict[str, int]:
+    with _stats_mu:
+        return dict(STATS)
+
+
+def reset_stats() -> None:
+    """Tests only."""
+    with _stats_mu:
+        for k in STATS:
+            STATS[k] = 0
+
+
+class Parked(Exception):
+    """Control-flow signal of the collect leg: the statement reached a
+    batchable warm dispatch and its params were captured.  Never
+    surfaces to clients — only the pool's batch driver catches it, and
+    the session skips the observability fan-out for parked attempts."""
+
+
+class _ParkedDispatch:
+    __slots__ = ("key", "fn", "args", "arg_ids", "params_key", "params",
+                 "out")
+
+    def __init__(self, key, fn, args, params):
+        self.key = key
+        self.fn = fn
+        self.args = args            # positional device args WITHOUT params
+        self.arg_ids = _leaf_ids(args)
+        self.params = params        # the member's (pi, pf) host vectors
+        self.params_key = _params_key(params)
+        self.out = None
+
+
+def _params_key(params) -> bytes:
+    pi, pf = params
+    return bytes(memoryview(pi).cast("B")) + b"|" + \
+        bytes(memoryview(pf).cast("B"))
+
+
+def _leaf_ids(x) -> tuple:
+    """Structural identity of a dispatch's non-param arguments: the
+    executor rebuilds its ``dev_cols`` list (and the (values, null)
+    tuples in it) per execution, but the LEAF device arrays are
+    replica-memoized — the same objects across a family's queries until
+    a write invalidates the replica.  Matching on leaf ids is exactly
+    the guard batching needs: a replica rotation between the collect and
+    replay legs changes the leaves, the consume misses, and the member
+    falls back to a solo dispatch over the fresh data."""
+    if x is None:
+        return ("~",)
+    if isinstance(x, (list, tuple)):
+        out = ["("]
+        for v in x:
+            out.extend(_leaf_ids(v))
+        out.append(")")
+        return tuple(out)
+    return (id(x),)
+
+
+class BatchRound:
+    """One coalesced group's shared state across collect/dispatch/replay.
+    Used from the single pool worker thread driving the group (members
+    run sequentially), so no internal locking is needed beyond the
+    global counters."""
+
+    def __init__(self):
+        self.collecting = False
+        self.replaying = False
+        self._parked: List[_ParkedDispatch] = []
+        #: (key, arg_ids, params_key) -> [device outputs]: a LIST because
+        #: concurrent clients legitimately submit IDENTICAL statements —
+        #: each member consumes one stored output
+        self._results: Dict[tuple, list] = {}
+
+    # ---- collect ---------------------------------------------------------
+    def park(self, key, fn, args, params) -> None:
+        """Capture one member's dispatch and abort its collect execution
+        (raises :class:`Parked`)."""
+        self._parked.append(_ParkedDispatch(key, fn, args, params))
+        _stat_add("parks")
+        raise Parked()
+
+    @property
+    def parked_count(self) -> int:
+        return len(self._parked)
+
+    # ---- dispatch --------------------------------------------------------
+    def dispatch(self) -> int:
+        """Run every parked ParamTable through its captured compiled
+        program back-to-back; returns the round's occupancy (parked
+        member count).  Zero compiles by construction — park only
+        happens on progcache-warm programs.  A member whose dispatch
+        raises (device loss, injected fault) simply has no stored
+        result: its replay consume misses and the solo re-dispatch
+        surfaces the error through the statement's own degradation
+        path."""
+        from . import kernels
+        occ = 0
+        for p in self._parked:
+            try:
+                p.out = p.fn(*p.args, kernels._params_dev(p.params))
+            except Exception:
+                continue
+            self._results.setdefault(
+                (p.key, p.arg_ids, p.params_key), []).append(p.out)
+            occ += 1
+        if occ:
+            _stat_add("batches")
+            _stat_add("batched_statements", occ)
+            _stat_add("occupancy_sum", occ)
+        return occ
+
+    # ---- replay ----------------------------------------------------------
+    def consume(self, key, args, params):
+        """The replay-side lookup: this member's precomputed device
+        output, or None when the capture no longer matches (fall back to
+        a solo dispatch)."""
+        outs = self._results.get(
+            (key, _leaf_ids(args), _params_key(params)))
+        if outs:
+            _stat_add("replays")
+            return outs.pop()
+        _stat_add("fallbacks")
+        return None
+
+
+_ROUND: contextvars.ContextVar = contextvars.ContextVar(
+    "tinysql_batch_round", default=None)
+
+
+def activate(rnd: Optional[BatchRound]):
+    return _ROUND.set(rnd)
+
+
+def deactivate(token) -> None:
+    _ROUND.reset(token)
+
+
+def current() -> Optional[BatchRound]:
+    return _ROUND.get()
+
+
+# ---- family registry (learned batch eligibility) --------------------------
+
+#: normalized-SQL digests whose statements executed a batchable fused
+#: dispatch (dev-mask + params, single-shot path).  Bounded: serving
+#: works with O(active digest families).
+_FAM_MAX = 512
+_fam_mu = threading.Lock()
+_FAMILIES: Dict[str, int] = {}
+
+
+def note_family(sql_digest: str) -> None:
+    """Mark a digest family batchable (called from the session statement
+    close hook for statements that recorded the ``batchable`` marker)."""
+    if not sql_digest:
+        return
+    with _fam_mu:
+        if len(_FAMILIES) >= _FAM_MAX and sql_digest not in _FAMILIES:
+            _FAMILIES.pop(next(iter(_FAMILIES)))
+        _FAMILIES[sql_digest] = _FAMILIES.get(sql_digest, 0) + 1
+
+
+def family_batchable(sql_digest: str) -> bool:
+    with _fam_mu:
+        return sql_digest in _FAMILIES
+
+
+def have_families() -> bool:
+    """Cheap pre-check so the pool skips per-statement SQL
+    normalization until at least one batchable family exists."""
+    with _fam_mu:
+        return bool(_FAMILIES)
+
+
+def reset_families() -> None:
+    """Tests only."""
+    with _fam_mu:
+        _FAMILIES.clear()

@@ -1027,9 +1027,25 @@ def _lower_arg(e):
     return lambda cols, params: fn(cols)
 
 
+def _batch_round(mask, params, batchable: bool):
+    """Cross-query micro-batching eligibility at a fused dispatch site
+    (ops/batching.py): only explicitly-batchable single-shot call sites
+    with a params-compiled device mask qualify — the combination that
+    makes one compiled program serve a whole constant-variant digest
+    family.  Records the ``batchable`` obs marker (the session's close
+    hook learns family eligibility from it) and returns the active
+    batch round, or None."""
+    if not (batchable and mask[0] == "dev" and params is not None):
+        return None
+    _obs.record("batchable", 1)
+    from . import batching
+    return batching.current()
+
+
 def _fused_segment_raw(dev_cols, gid_dev, n_segments: int,
                        agg_specs, arg_exprs, mask,
-                       program_key: tuple = (), params=None):
+                       program_key: tuple = (), params=None,
+                       batchable: bool = False):
     """The fused segment-aggregate device program WITHOUT extraction:
     returns (presence, first_orig, outs, n_present, ns) as device arrays
     (n_present a device scalar).  Shared by the host-extract and
@@ -1040,6 +1056,11 @@ def _fused_segment_raw(dev_cols, gid_dev, n_segments: int,
     ns = bucket(max(n_segments, 1))
     mask_fn, mask_key, mask_arr = _mask_parts(mask)
     key = ("seg", tuple(agg_specs), program_key, mask_key, ns, nb)
+    rnd = _batch_round(mask, params, batchable)
+    if rnd is not None and rnd.collecting:
+        ent = progcache.peek(key)
+        if ent is not None:  # warm programs only: cold families stay solo
+            rnd.park(key, ent, (dev_cols, gid_dev, mask_arr), params)
 
     def build():
         arg_fns = [_lower_arg(e) for e in arg_exprs]
@@ -1060,6 +1081,16 @@ def _fused_segment_raw(dev_cols, gid_dev, n_segments: int,
             return presence, first_orig, outs, n_present
         return counted_jit(kernel)
     fn = progcache.get(key, build)
+    if rnd is not None and rnd.replaying:
+        got = rnd.consume(key, (dev_cols, gid_dev, mask_arr), params)
+        if got is not None:
+            # the member's share of the round dispatch, attributed to
+            # its own scope (the global counter accrued at dispatch time
+            # through counted_jit on the pool worker)
+            _obs.record("dispatches", 1)
+            _obs.record("coalesced", 1)
+            presence, first_orig, outs, n_present = got
+            return presence, first_orig, outs, n_present, ns
     presence, first_orig, outs, n_present = fn(dev_cols, gid_dev,
                                                mask_arr,
                                                _params_dev(params))
@@ -1068,16 +1099,19 @@ def _fused_segment_raw(dev_cols, gid_dev, n_segments: int,
 
 def fused_segment_aggregate(dev_cols, gid_dev, n_segments: int,
                             agg_specs, arg_exprs, n_rows: int,
-                            mask, program_key: tuple = (), params=None):
+                            mask, program_key: tuple = (), params=None,
+                            batchable: bool = False):
     """dev_cols: per-schema-slot (values, null) device pairs padded to one
     bucket (None for slots no jittable expression touches); gid_dev:
     composite group ids padded with an out-of-range id; arg_exprs: the agg
     argument programs, lowered on device; mask: a mask spec and params
     the per-query constant vectors (module docstring above).  Returns the
-    group_aggregate contract (present_ids, out_aggs, first_orig)."""
+    group_aggregate contract (present_ids, out_aggs, first_orig).
+    ``batchable=True`` (single-shot executor call sites only) opts the
+    dispatch into cross-query micro-batching (ops/batching.py)."""
     presence, first_orig, outs, n_present, ns = _fused_segment_raw(
         dev_cols, gid_dev, n_segments, agg_specs, arg_exprs, mask,
-        program_key=program_key, params=params)
+        program_key=program_key, params=params, batchable=batchable)
     return _present_extract(presence, first_orig, outs, n_present, ns,
                             limit=n_segments)
 
@@ -1115,13 +1149,19 @@ def fused_segment_aggregate_keep(dev_cols, gid_dev, n_segments: int,
 
 def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
                            nb: int, mask, program_key: tuple = (),
-                           params=None):
+                           params=None, batchable: bool = False):
     """Global-group variant of the fused path: masked reductions with
-    on-device argument evaluation."""
+    on-device argument evaluation.  ``batchable=True`` opts the dispatch
+    into cross-query micro-batching (ops/batching.py)."""
     j = jax()
     jn = jnp()
     mask_fn, mask_key, mask_arr = _mask_parts(mask)
     key = ("scalar", tuple(agg_specs), program_key, mask_key, nb)
+    rnd = _batch_round(mask, params, batchable)
+    if rnd is not None and rnd.collecting:
+        ent = progcache.peek(key)
+        if ent is not None:
+            rnd.park(key, ent[0], (dev_cols, mask_arr), params)
 
     def build():
         arg_fns = [_lower_arg(e) for e in arg_exprs]
@@ -1170,6 +1210,12 @@ def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
             return pack_arrays(kernel_schema, items)
         return counted_jit(kernel), kernel_schema
     fn, schema = progcache.get(key, build)
+    if rnd is not None and rnd.replaying:
+        got = rnd.consume(key, (dev_cols, mask_arr), params)
+        if got is not None:
+            _obs.record("dispatches", 1)
+            _obs.record("coalesced", 1)
+            return _unpack_scalar_agg(unpack_flat(got, schema))
     return _unpack_scalar_agg(unpack_flat(
         fn(dev_cols, mask_arr, _params_dev(params)), schema))
 

@@ -66,12 +66,18 @@ def _make_handler(server_ref):
                 return
             if parsed.path == "/status":
                 from ..server.protocol import SERVER_VERSION
+                from ..server.admission import stats_snapshot as adm
+                from ..ops.batching import stats_snapshot as batch
+                pool = getattr(srv, "pool", None) if srv else None
                 body = json.dumps({
                     "version": SERVER_VERSION,
                     "connections": len(srv.conns) if srv else 0,
                     "tls_connections": sum(
                         1 for c in list(srv.conns.values())
                         if getattr(c, "tls", False)) if srv else 0,
+                    "pool": pool.snapshot() if pool is not None else {},
+                    "admission": adm(),
+                    "batching": batch(),
                 }).encode()
                 self._send(200, body)
             elif parsed.path == "/debug/threads":

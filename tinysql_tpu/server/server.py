@@ -266,7 +266,7 @@ class ClientConn:
                 1064, "prepared statement must be a single statement",
                 "42000"))
             return
-        rs = self.session.execute_stmt(stmts[0], sql)
+        rs = self.server.pool.run(self.session, stmts[0], sql)
         if isinstance(rs, ResultSet):
             self._write_resultset(rs, binary=True)
         else:
@@ -288,9 +288,12 @@ class ClientConn:
             label = sql if len(stmts) == 1 else \
                 f"{sql[:200]} [stmt {i + 1}/{len(stmts)}]"
             try:
-                # the full-lifecycle entry: wire statements get QueryObs
-                # scopes, summary/slow-log records, and processlist info
-                rs = self.session.execute_stmt(stmt, label)
+                # the full-lifecycle entry, via the bounded statement
+                # pool (admission control + same-digest coalescing;
+                # control statements bypass it inside pool.run): wire
+                # statements get QueryObs scopes, summary/slow-log
+                # records, and processlist info
+                rs = self.server.pool.run(self.session, stmt, label)
             except Exception as e:
                 log.debug("query error: %s", e)
                 self.io.write_packet(_err_packet_for(e))
@@ -348,6 +351,11 @@ class Server:
         # GLOBAL tidb_auto_prewarm sysvar (re-read every cycle).
         from ..session.prewarm import PrewarmWorker
         self.prewarm = PrewarmWorker(storage, domain=self.domain)
+        # bounded statement execution + admission control + same-digest
+        # micro-batching (server/pool.py, server/admission.py) — the
+        # high-throughput serving path (ROADMAP open item 2)
+        from .pool import StatementPool
+        self.pool = StatementPool(storage)
         self.host = host
         self.port = port
         self.sock: Optional[socket.socket] = None
@@ -371,12 +379,34 @@ class Server:
         log.info("listening on %s:%d", self.host, self.port)
         return self.port
 
+    def _max_connections(self) -> int:
+        from .pool import read_global_int
+        return read_global_int(self.storage,
+                               "tidb_max_server_connections", 0)
+
     def _accept_loop(self) -> None:
         while not self._closed.is_set():
             try:
                 conn, addr = self.sock.accept()
             except OSError:
                 return
+            cap = self._max_connections()
+            with self._mu:
+                over_cap = cap > 0 and len(self.conns) >= cap
+            if over_cap:
+                # MySQL refuses over-cap connects with ERR 1040 as the
+                # FIRST packet (no handshake) — the unbounded accept
+                # loop was a trivial DoS before this gate
+                try:
+                    PacketIO(conn).write_packet(p.err_packet(
+                        1040, "Too many connections", "08004"))
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
             cc = ClientConn(self, conn)
             with self._mu:
                 self.conns[cc.conn_id] = cc
@@ -390,6 +420,7 @@ class Server:
     def close(self) -> None:
         """Graceful drain (reference: server.go:155-283)."""
         self._closed.set()
+        self.pool.close()
         self.prewarm.close()
         self.domain.close()
         if self.sock is not None:

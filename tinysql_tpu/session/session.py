@@ -115,6 +115,28 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     "tidb_auto_prewarm_budget_ms": 60000,
     # seconds a warmed (or failed) family is exempt from re-warming
     "tidb_auto_prewarm_cooldown": 600,
+    # ---- serving layer (server/pool.py + server/admission.py; the
+    # GLOBAL scope is what the server reads — SET GLOBAL to tune) -------
+    # accept-loop connection cap: further connects get MySQL 1040
+    # "Too many connections" before the handshake (0 = unlimited)
+    "tidb_max_server_connections": 0,
+    # statement-execution pool: worker-thread count for pooled
+    # statements (SELECT/INSERT/DELETE over the wire; 0 = pooling off,
+    # statements run on their connection thread unbounded)
+    "tidb_stmt_pool_size": 4,
+    # bounded admission queue in front of the pool; a full queue sheds
+    # load with MySQL 1041 + retry hint (server/admission.py; halved
+    # while device-loss cooldown pins the backend to CPU)
+    "tidb_stmt_pool_queue_depth": 64,
+    # aggregate in-flight statement memory (sum of running statements'
+    # MemTracker bytes) above which admission sheds new statements
+    # (0 = off)
+    "tidb_admission_mem_limit": 0,
+    # cross-query micro-batching (ops/batching.py): max same-digest
+    # statements coalesced into one device round (<2 disables), and how
+    # long a worker tops up a forming batch from the queue
+    "tidb_batch_max_size": 16,
+    "tidb_batch_window_ms": 2,
 }
 
 
@@ -188,6 +210,10 @@ class Session:
         # always-installed per-statement MemTracker (quota 0 = track only)
         self.stmt_running = False
         self._stmt_mem = None
+        # statement-pool admission state (server/pool.py): "queued" while
+        # waiting for a worker, with the pending SQL for processlist
+        self.stmt_state = ""
+        self.pending_sql = ""
         # rendered EXPLAIN rows of the last planned statement — the
         # EXPLAIN FOR CONNECTION <id> payload (set before execution so a
         # live statement's plan is readable from another session)
@@ -354,6 +380,7 @@ class Session:
         t1 = time.perf_counter()
         self._plan_s = 0.0
         err = True
+        parked = False
         n_rows = 0
         try:
             with obs_context.span("execute", kind=type(s).__name__):
@@ -362,6 +389,14 @@ class Session:
                 else self.last_affected
             err = False
             return rs
+        except Exception as e:
+            # a batch-round collect leg parking at the dispatch boundary
+            # (ops/batching.Parked) is control flow, not a statement: it
+            # must stay invisible to statements_summary / slow log /
+            # /metrics — the member's REPLAY execution reports instead
+            from ..ops.batching import Parked
+            parked = isinstance(e, Parked)
+            raise
         finally:
             obs_context.deactivate(tok)
             t_exec = time.perf_counter() - t1
@@ -370,7 +405,8 @@ class Session:
                     "exec_s": t_exec,
                     "total_s": parse_wall + t_exec}
             qobs.info = info
-            self._finish_obs(s, qobs, info, err, n_rows)
+            if not parked:
+                self._finish_obs(s, qobs, info, err, n_rows)
 
     def _finish_obs(self, stmt: ast.StmtNode, qobs, info: Dict[str, float],
                     err: bool, rows_returned: int = 0) -> None:
@@ -456,6 +492,13 @@ class Session:
                     max_stmt_count=max_count)
             if not err:
                 maybe_emit(qobs)
+                # cross-query micro-batching learns family eligibility
+                # here: statements that executed a params-compiled fused
+                # dispatch (the `batchable` marker) make their digest a
+                # coalescing candidate for the statement pool
+                if sql_digest and qobs.device_totals().get("batchable"):
+                    from ..ops.batching import note_family
+                    note_family(sql_digest)
         except Exception:
             logging.getLogger("tinysql_tpu").warning(
                 "observability fan-out failed", exc_info=True)
@@ -519,11 +562,14 @@ class Session:
             self._finish_stmt(ok=True)
             return rs
         except Exception as e:
-            if not isinstance(stmt, ast.ShowStmt):
+            from ..ops.batching import Parked
+            if not isinstance(stmt, ast.ShowStmt) \
+                    and not isinstance(e, Parked):
                 # SHOW ERRORS reports the failed statement (reference:
                 # fetchShowWarnings(errors=true)); typed errors carry
                 # their MySQL code (kill 1317, timeout 3024, OOM 8175),
-                # 1105 = generic server error
+                # 1105 = generic server error.  A batch-round park is
+                # control flow, not a failure — no phantom warning
                 self.last_warnings.append(
                     ("Error", getattr(e, "mysql_code", 1105), str(e)))
             if cp is not None and self._txn is not None:
@@ -849,7 +895,13 @@ class Session:
                      "tidb_auto_prewarm_top_k",
                      "tidb_auto_prewarm_interval",
                      "tidb_auto_prewarm_budget_ms",
-                     "tidb_auto_prewarm_cooldown")
+                     "tidb_auto_prewarm_cooldown",
+                     "tidb_max_server_connections",
+                     "tidb_stmt_pool_size",
+                     "tidb_stmt_pool_queue_depth",
+                     "tidb_admission_mem_limit",
+                     "tidb_batch_max_size",
+                     "tidb_batch_window_ms")
 
     @staticmethod
     def _validate_uint_sysvar(name: str, v: Datum) -> int:
