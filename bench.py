@@ -334,6 +334,14 @@ def main():
               f" sqlite={ent['sqlite_rows_per_s']:,}"
               f" match={ent['match']}", file=sys.stderr)
 
+    # observability self-cost (ISSUE 8 satellite): the fraction of one
+    # core the background sampler would consume in steady state — ONE
+    # shared definition with bench_serve.py (tsring.measure_overhead)
+    from tinysql_tpu.obs import tsring
+    obs_overhead_frac = tsring.measure_overhead()["obs_overhead_frac"]
+    print(f"[bench] obs_overhead_frac={obs_overhead_frac}",
+          file=sys.stderr)
+
     q1_dev, q1_cpu, q1_lite, q1_ok = results["Q1"]
     # the metric NAME carries the tier that actually ran: an XLA:CPU run
     # must never publish under a "tpu" label (VERDICT r3 weak-1)
@@ -353,6 +361,7 @@ def main():
         },
         "operators": op_results,
         "param_reuse": param_reuse,
+        "obs_overhead_frac": obs_overhead_frac,
         "link": link,
         "correct": all(ok for _, _, _, ok in results.values())
                    and all(e["match"] for e in op_results.values()),

@@ -19,6 +19,13 @@ bench.py's contract):
 
     {"metric": "serve_qps",    "value": ..., "unit": "qps", "detail": {...}}
     {"metric": "serve_p99_ms", "value": ..., "unit": "ms"}
+    {"metric": "obs_overhead_frac", "value": ..., "unit": "frac"}
+    {"metric": "serve_queue_wait_p99_share", "value": ..., "unit": "frac"}
+
+obs_overhead_frac is the time-series sampler's steady-state cost (one
+sample's wall over the default interval, measured against the live
+process — hard gate < 3%); the queue-wait share splits the published
+p99 into wait vs execution from the "queue" phase histogram.
 
 Hard assertions (the serve-smoke CI gate): zero statement errors, at
 least one coalesced batch with occupancy > 1 in the storm, zero
@@ -45,6 +52,31 @@ def _pct(xs, p):
         return 0.0
     xs = sorted(xs)
     return xs[min(len(xs) - 1, int(round(p / 100.0 * (len(xs) - 1))))]
+
+
+def _hist_delta(h0, h1):
+    """Per-bucket difference of two cumulative-process histogram
+    snapshots — the measurements that landed BETWEEN them."""
+    before = dict(h0.get("buckets", []))
+    return {"buckets": [(le, c - before.get(le, 0))
+                        for le, c in h1["buckets"]],
+            "count": h1["count"] - h0["count"]}
+
+
+def _hist_p99_ms(h):
+    """Approximate p99 (ms) from one phase of the statement-summary
+    latency histogram (upper bucket bound; overflow reports the last
+    bound as a floor)."""
+    total = h.get("count", 0)
+    if not total:
+        return 0.0
+    target = 0.99 * total
+    cum = 0
+    for le_s, count in h["buckets"]:
+        cum += count
+        if cum >= target:
+            return le_s * 1e3
+    return h["buckets"][-1][0] * 1e3
 
 
 def main():
@@ -152,6 +184,11 @@ def main():
 
     print(f"[serve] mixed phase: {n_clients} clients x "
           f"{n_requests} requests ...", file=sys.stderr)
+    # queue-wait share is computed over the MIXED phase only: snapshot
+    # the (process-cumulative) "queue" histogram here and diff after
+    # the joins, so the storm's floods don't contaminate the split
+    from tinysql_tpu.obs.stmtsummary import histogram_snapshot
+    queue_h0 = histogram_snapshot()["queue"]
     t0 = time.time()
     threads = [threading.Thread(target=client_loop, args=(i,), daemon=True)
                for i in range(n_clients)]
@@ -165,6 +202,7 @@ def main():
         # without this the gate would pass vacuously on a wedged pool
         errors.append(f"{hung} client thread(s) still running after join")
     mixed_wall = time.time() - t0
+    queue_hist = _hist_delta(queue_h0, histogram_snapshot()["queue"])
     qps = len(lat_ms) / max(mixed_wall, 1e-9)
     p50, p99 = _pct(lat_ms, 50), _pct(lat_ms, 99)
     print(f"[serve] mixed: {len(lat_ms)} ok in {mixed_wall:.1f}s "
@@ -248,6 +286,19 @@ def main():
               f"batch yet ({bd}), retrying", file=sys.stderr)
     print(f"[serve] storm: {storm}", file=sys.stderr)
 
+    # observability-of-the-observability (ISSUE 8 satellite): the
+    # sampler's own cost (shared definition: tsring.measure_overhead,
+    # probed against the LIVE process on a private ring), and the share
+    # of the mixed-phase client p99 that was queue wait — histogram p99
+    # rides a bucket UPPER bound, so the ratio is clamped to 1.0
+    from tinysql_tpu.obs.tsring import measure_overhead
+    obs_cost = measure_overhead()
+    queue_p99_ms = _hist_p99_ms(queue_hist)
+    queue_share = min(round(queue_p99_ms / p99, 4), 1.0) \
+        if p99 > 0 else 0.0
+    print(f"[serve] obs overhead {obs_cost} queue-wait p99 "
+          f"{queue_p99_ms:.1f}ms (share {queue_share})", file=sys.stderr)
+
     srv.close()
     adm = adm_stats()
     detail = {
@@ -257,12 +308,20 @@ def main():
         "wall_s": round(mixed_wall, 2),
         "admission": adm, "batching": batching.stats_snapshot(),
         "storm": storm,
+        "obs_overhead": obs_cost,
+        "queue_wait_p99_ms": round(queue_p99_ms, 2),
+        "queue_wait_stmts": queue_hist["count"],
         "total_bench_seconds": round(time.time() - t_start, 1),
     }
     print(json.dumps({"metric": "serve_qps", "value": round(qps, 2),
                       "unit": "qps", "detail": detail}))
     print(json.dumps({"metric": "serve_p99_ms", "value": round(p99, 2),
                       "unit": "ms"}))
+    print(json.dumps({"metric": "obs_overhead_frac",
+                      "value": obs_cost["obs_overhead_frac"],
+                      "unit": "frac"}))
+    print(json.dumps({"metric": "serve_queue_wait_p99_share",
+                      "value": queue_share, "unit": "frac"}))
 
     # ---- the serve-smoke gate -------------------------------------------
     assert not errors, errors[:5]
@@ -275,6 +334,13 @@ def main():
     assert storm["progcache_misses"] == 0, storm
     assert storm["batches"] >= 1 and storm["occupancy_sum"] \
         > storm["batches"], f"no coalesced batch with occupancy > 1: {storm}"
+    # the observability cost gate (ISSUE 8 acceptance): sampling the
+    # whole counter surface must stay under 3% of one core at the
+    # default interval
+    assert obs_cost["obs_overhead_frac"] < 0.03, obs_cost
+    # the pool fed per-statement wait attribution for this run (clients
+    # outnumber workers, so SOME statements queued)
+    assert queue_hist["count"] > 0, "no queue-wait measurements recorded"
     print("[serve] OK", file=sys.stderr)
 
 

@@ -1,0 +1,38 @@
+"""OB404 fixture: metric names invented outside the central registry
+(obs/metrics.METRICS) in a module that feeds the time-series ring.
+
+Every line marked OB404 below must fire the rule; the clean patterns at
+the bottom must stay silent.  Never imported — parsed by test_lint.py.
+"""
+from tinysql_tpu.obs import tsring
+
+
+def sneak_source():
+    # a source emitting a name no other surface knows: the ring would
+    # drop it at sample time, and /metrics / metrics_summary would
+    # never render it
+    tsring.register_source(
+        "sneaky",
+        lambda: {"tinysql_not_registered_total": 1})       # OB404
+
+
+def sneak_typo_source():
+    def src():
+        return {"tinysql_progcache_hitz_total": 0,         # OB404 (typo)
+                "tinysql_progcache_hits_total": 0}         # clean
+    tsring.register_source("typo", src)
+
+
+def sneak_series_read():
+    # reads drift too: a typo'd series() lookup silently returns nothing
+    return tsring.RING.series("tinysql_pool_qeued")        # OB404 (typo)
+
+
+def clean_patterns():
+    # registered names are fine anywhere; dotted logger names and the
+    # package name are not metric names
+    import logging
+    log = logging.getLogger("tinysql_tpu.sneaky")
+    pts = tsring.RING.series("tinysql_pool_queued")
+    rows = tsring.summary_rows()
+    return log, pts, rows

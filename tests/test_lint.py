@@ -319,6 +319,46 @@ def test_ob403_ignores_unrelated_ingest_and_store(tmp_path):
     assert lint_obs_discipline(SourceFile(str(p))) == []
 
 
+def test_metric_fixture_fires_ob404():
+    sf = SourceFile(os.path.join(FIXDIR, "bad_metric.py"))
+    diags = lint_obs_discipline(sf)
+    got = [d for d in diags if d.rule == "OB404"]
+    # the unregistered source name, the typo'd source key, the typo'd
+    # series() read — the registered key and logger names stay silent
+    assert len(got) == 3, [d.format() for d in got]
+    assert all("tinysql_" in d.message for d in got)
+
+
+def test_ob404_registered_names_and_fstrings_clean(tmp_path):
+    p = tmp_path / "sampler_user.py"
+    p.write_text(
+        "from tinysql_tpu.obs import tsring\n"
+        "def src():\n"
+        "    return {'tinysql_pool_queued': 0,\n"
+        "            'tinysql_progcache_misses_total': 0}\n"
+        "tsring.register_source('ok', src)\n"
+        "for k in ('cycles',):\n"
+        "    name = f'tinysql_prewarm_worker_{k}_total'\n")
+    assert lint_obs_discipline(SourceFile(str(p))) == []
+
+
+def test_ob404_out_of_scope_module_silent(tmp_path):
+    # a module that never touches the ring may spell anything — OB404
+    # polices the sampling surface, not every string in the tree
+    p = tmp_path / "unrelated.py"
+    p.write_text("NAME = 'tinysql_totally_made_up_total'\n")
+    assert lint_obs_discipline(SourceFile(str(p))) == []
+
+
+def test_ob404_registry_module_exempt(tmp_path):
+    # obs/metrics.py IS the registry: declaring a new name there is the
+    # sanctioned act the rule points everyone else at
+    p = tmp_path / "metrics.py"
+    p.write_text("from tinysql_tpu.obs import tsring\n"
+                 "METRICS = {'tinysql_brand_new_total': ('counter', '')}\n")
+    assert lint_obs_discipline(SourceFile(str(p))) == []
+
+
 def test_obs_reads_not_flagged(tmp_path):
     p = tmp_path / "reader.py"
     p.write_text("from tinysql_tpu.ops import kernels\n"
@@ -369,6 +409,7 @@ def test_corpus_plans_clean():
     ("trace", "bad_literal.py"),
     ("obs", "bad_stats.py"),
     ("obs", "bad_summary.py"),
+    ("obs", "bad_metric.py"),
 ])
 def test_cli_exits_nonzero_on_fixture(passname, fixture):
     r = subprocess.run(

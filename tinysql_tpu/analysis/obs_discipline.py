@@ -24,6 +24,16 @@ Rules:
   bypasses the window-rotation/eviction accounting behind
   ``information_schema.statements_summary`` and the /metrics latency
   histograms.
+- **OB404**: metric-name drift.  In any module that touches the
+  time-series ring (imports ``obs/tsring.py``, or IS it), every
+  ``tinysql_*`` metric-name string literal must be declared in the
+  central registry (``obs/metrics.METRICS``).  The registry is the one
+  definition /metrics, the ring, ``metrics_history`` and
+  ``metrics_summary`` all share — a name invented at a sample site
+  would produce a time series no other surface knows, and a typo would
+  silently sample nothing (the ring also drops unregistered names at
+  runtime; this rule catches them at lint time).  ``obs/metrics.py``
+  itself is exempt: it IS the registry.
 
 Reads (``STATS["dispatches"]``, ``dict(STATS)``, ``stmtsummary.rows()``,
 ``snapshot()``, ``histogram_snapshot()``) are fine anywhere — that is
@@ -33,7 +43,8 @@ from __future__ import annotations
 
 import ast
 import os
-from typing import List
+import re
+from typing import List, Optional, Set
 
 from .diag import Diagnostic, SourceFile, register_rules
 
@@ -44,6 +55,9 @@ register_rules({
              "outside the owning module",
     "OB403": "statement-summary store write outside the designated "
              "session statement-close hook",
+    "OB404": "metric name not declared in the central registry "
+             "(obs/metrics.METRICS) — /metrics, the time-series ring, "
+             "and metrics_summary must share one name set",
 })
 
 #: modules that own a STATS dict and its accessors (the serving layer's
@@ -139,6 +153,77 @@ def _lint_summary_writes(sf: SourceFile) -> List[Diagnostic]:
     return diags
 
 
+# ---- OB404: metric-name registry discipline -------------------------------
+
+#: matches the exported metric naming convention; deliberately excludes
+#: dotted logger names ("tinysql_tpu.pool") by construction and the bare
+#: package name explicitly
+_METRIC_NAME_RE = re.compile(r"^tinysql_[a-z0-9_]+$")
+_NON_METRIC_NAMES = {"tinysql_tpu"}
+
+#: the registry module itself — where names are DECLARED — is exempt
+_REGISTRY_MODULE = "metrics.py"
+
+
+def _metric_registry() -> Optional[Set[str]]:
+    """The live central registry, or None when it cannot be imported
+    (lint must degrade to silence, not crash, in a stripped checkout)."""
+    try:
+        from ..obs.metrics import METRICS
+        return set(METRICS)
+    except Exception:
+        return None
+
+
+def _imports_tsring(sf: SourceFile) -> bool:
+    """Provable tsring import under any form: ``import …obs.tsring [as
+    x]``, ``from …obs.tsring import RING``, ``from …obs import tsring
+    [as t]``."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.rsplit(".", 1)[-1] == "tsring":
+                    return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.rsplit(".", 1)[-1] == "tsring":
+                return True
+            for alias in node.names:
+                if alias.name == "tsring":
+                    return True
+    return False
+
+
+def _lint_metric_names(sf: SourceFile) -> List[Diagnostic]:
+    if os.path.basename(sf.path) != "tsring.py" \
+            and not _imports_tsring(sf):
+        return []
+    registry = _metric_registry()
+    if registry is None:
+        return []
+    # f-string fragments are PARTIAL names (f"tinysql_x_{k}_total") —
+    # judging them against the registry would be judging half a name
+    in_fstring = {id(c) for n in ast.walk(sf.tree)
+                  if isinstance(n, ast.JoinedStr) for c in n.values}
+    diags: List[Diagnostic] = []
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)) \
+                or id(node) in in_fstring:
+            continue
+        name = node.value
+        if name in _NON_METRIC_NAMES or name in registry \
+                or not _METRIC_NAME_RE.match(name):
+            continue
+        diags.append(Diagnostic(
+            "OB404",
+            f"metric name `{name}` is not declared in the central "
+            "registry (obs/metrics.METRICS) — the ring drops it at "
+            "sample time and no other surface (/metrics, "
+            "metrics_summary) will ever know it; declare it there "
+            "first", sf.path, node.lineno))
+    return diags
+
+
 def lint_obs_discipline(sf: SourceFile) -> List[Diagnostic]:
     base = os.path.basename(sf.path)
     diags: List[Diagnostic] = []
@@ -147,6 +232,8 @@ def lint_obs_discipline(sf: SourceFile) -> List[Diagnostic]:
     # the OB401/OB402 ownership exemption must not cover them here
     if base not in SUMMARY_WRITER_MODULES:
         diags.extend(_lint_summary_writes(sf))
+    if base != _REGISTRY_MODULE:
+        diags.extend(_lint_metric_names(sf))
     if base in OWNING_MODULES:
         return sf.filter(diags)
     for node in ast.walk(sf.tree):

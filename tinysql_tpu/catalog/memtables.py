@@ -10,7 +10,12 @@ filter like any other source:
 - ``processlist``: live sessions from the interruption registry
   (utils/interrupt.py) joined with their MemTracker bytes and elapsed
   statement time;
-- ``slow_query``: the structured slow-log ring (obs/slowlog.py).
+- ``slow_query``: the structured slow-log ring (obs/slowlog.py);
+- ``metrics_history`` / ``metrics_summary``: the time-series metrics
+  ring (obs/tsring.py) — raw samples, and windowed delta/rate/avg/max
+  per metric ("what changed in the last N minutes");
+- ``inspection_result``: the automated inspection engine's findings
+  (obs/inspect.py), evaluated over the ring at scan time.
 
 Rows are produced from the live InfoSchema / obs stores at query time.
 The catalog lists ITSELF: ``information_schema`` appears in SCHEMATA,
@@ -36,6 +41,21 @@ def _summary_cols():
     return [(name, kind) for name, kind in COLUMNS]
 
 
+def _metrics_history_cols():
+    from ..obs.tsring import HISTORY_COLUMNS
+    return list(HISTORY_COLUMNS)
+
+
+def _metrics_summary_cols():
+    from ..obs.tsring import SUMMARY_COLUMNS
+    return list(SUMMARY_COLUMNS)
+
+
+def _inspection_cols():
+    from ..obs.inspect import COLUMNS
+    return list(COLUMNS)
+
+
 # table name -> [(column name, kind)];  statements_summary's layout is
 # owned by obs/stmtsummary.COLUMNS (one definition for store + catalog)
 _TABLES = {
@@ -59,6 +79,9 @@ _TABLES = {
                    ("column_name", "str")],
     "statements_summary": _summary_cols,
     "statements_summary_history": _summary_cols,
+    "metrics_history": _metrics_history_cols,
+    "metrics_summary": _metrics_summary_cols,
+    "inspection_result": _inspection_cols,
     "processlist": [("id", "int"),
                     ("user", "str"),
                     ("db", "str"),
@@ -76,6 +99,8 @@ _TABLES = {
                    ("parse_ms", "real"),
                    ("plan_ms", "real"),
                    ("exec_ms", "real"),
+                   ("queue_wait_ms", "real"),
+                   ("batch_wait_ms", "real"),
                    ("plan_digest", "str"),
                    ("sql_digest", "str"),
                    ("query", "str")],
@@ -107,6 +132,15 @@ def memtable_rows(infoschema, table: str) -> List[list]:
         return _processlist_rows()
     if t == "slow_query":
         return _slow_query_rows()
+    if t == "metrics_history":
+        from ..obs import tsring
+        return tsring.history_rows()
+    if t == "metrics_summary":
+        from ..obs import tsring
+        return tsring.summary_rows()
+    if t == "inspection_result":
+        from ..obs import inspect as obs_inspect
+        return obs_inspect.rows()
     out: List[list] = []
     if t == "schemata":
         out.append(["def", DB_NAME])
@@ -145,7 +179,17 @@ def _processlist_rows() -> List[list]:
     """Live sessions (reference: infoschema PROCESSLIST fed from the
     server's ShowProcessList): one row per registered session; running
     statements carry their SQL, elapsed wall, and the statement
-    MemTracker's live byte count."""
+    MemTracker's live byte count.
+
+    TIME semantics by state (documented contract, tested in
+    tests/test_tsring.py): ``state='executing'`` reports elapsed wall
+    since the statement began executing; ``state='queued'`` reports the
+    statement's WAIT-SO-FAR in the admission queue (since pool submit) —
+    not elapsed-since-statement-start, because a queued statement has
+    not started.  Once a queued statement is claimed by a worker its row
+    flips to 'executing' and TIME restarts from execution start; the
+    full wait it accumulated is attributed separately as
+    ``queue_wait_s`` (statements_summary / slow_query / span trace)."""
     from ..utils import interrupt
     now = time.time()
     out: List[list] = []
@@ -191,6 +235,8 @@ def _slow_query_rows() -> List[list]:
                     float(r.get("parse_ms", 0.0)),
                     float(r.get("plan_ms", 0.0)),
                     float(r.get("exec_ms", 0.0)),
+                    float(r.get("queue_wait_ms", 0.0)),
+                    float(r.get("batch_wait_ms", 0.0)),
                     r.get("plan_digest", "") or "",
                     r.get("sql_digest", "") or "",
                     r.get("sql", "")])

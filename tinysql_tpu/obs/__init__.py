@@ -34,6 +34,16 @@ Three cooperating pieces:
   ``slow_query`` (catalog/memtables.py), ``EXPLAIN FOR CONNECTION``,
   and the ``/metrics`` per-phase latency histograms.  Written ONLY from
   the session statement-close hook (qlint OB403).
+- **time series + self-diagnosis** (`tsring.py` + `inspect.py`): a
+  background sampler snapshots every registered counter source into a
+  bounded ring (``metrics_history`` / ``metrics_summary`` mem-tables,
+  ``tidb_metrics_interval`` / ``tidb_metrics_retention``), metric
+  names pinned to the central registry in `metrics.py` (qlint OB404);
+  an inspection rule catalogue evaluates the ring into
+  ``inspection_result`` / ``/debug/inspection`` findings with severity
+  and the metric evidence window.  The serving path attributes each
+  statement's queue/batch wait (server/pool.py measurement → spans,
+  summary columns, slow-log fields, the ``queue`` phase histogram).
 
 See docs/OBSERVABILITY.md.
 """

@@ -87,6 +87,11 @@ class QueryObs:
         #: rendered EXPLAIN rows of the placed plan (set by the session
         #: select/explain paths; statements_summary samples them)
         self.plan_rows = None
+        #: serving-path wait attribution (set by the session from the
+        #: statement pool's measurement): "admitted" ran immediately,
+        #: "queued" waited for a worker first, "" never went through the
+        #: pool (control statements, embedded execution, pooling off)
+        self.admission_verdict = ""
         self.info: Dict[str, float] = {}
         self._mu = threading.Lock()
         self._counters: Dict[str, float] = {}

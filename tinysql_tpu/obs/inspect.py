@@ -1,0 +1,320 @@
+"""Automated inspection engine: the system diagnosing itself (reference
+lineage: TiDB's ``information_schema.inspection_result`` — a registered
+rule catalogue evaluated over the metrics store, each finding carrying
+severity, details, and the metric evidence that triggered it).
+
+Rules evaluate over the time-series ring (obs/tsring.py) and the
+statement-summary store: each one is a plain function registered in the
+RULE catalogue via :func:`rule`, receiving an :class:`InspectionContext`
+(windowed metric deltas/series + summary records) and yielding
+:class:`Finding`\\ s.  ``run()`` — the ``inspection_result`` mem-table
+payload and the ``/debug/inspection`` endpoint — evaluates every rule
+and never raises: a broken rule reports ITSELF as a finding instead of
+taking the surface down.
+
+The registered catalogue (each has an induced-condition test in
+tests/test_tsring.py):
+
+- **compile-storm**: program-build (progcache miss) burst within the
+  window — literal parameterization or prewarm regressed, or an
+  unparameterized workload arrived;
+- **progcache-hit-rate**: registry hit rate collapsed under real lookup
+  traffic;
+- **pool-saturation**: admission shed statements (1041) in the window,
+  or the queue gauge stayed deep — the serving tier is saturated;
+- **cooldown-flapping**: repeated device losses within one window keep
+  re-pinning planning to CPU (a flapping accelerator, not a blip);
+- **memory-pressure**: statements aborted on tidb_mem_quota_query;
+- **prewarm-starvation**: the auto-prewarm worker left candidates
+  unwarmed (budget exhausted / errors) while cold-run-shaped latency
+  exists — the cold-start killer is starved.
+
+Thresholds are module-level constants, deliberately conservative: an
+inspection finding is a diagnosis, so false positives cost trust.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from . import tsring
+
+# ---- thresholds -----------------------------------------------------------
+
+#: default evidence window for the serving surfaces (inspection_result,
+#: /debug/inspection): a finding is a diagnosis of what is wrong NOW,
+#: so the mem-table judges the last 5 minutes — not the whole retained
+#: ring, where one transient 1041 spike would read as a live critical
+#: finding until it aged past tidb_metrics_retention (15 min default)
+DEFAULT_WINDOW_S = 300
+
+#: progcache misses within the window that count as a compile storm
+COMPILE_STORM_MISSES = 8
+#: minimum registry lookups before the hit-rate rule may judge
+HIT_RATE_MIN_LOOKUPS = 20
+HIT_RATE_FLOOR = 0.5
+#: sustained queue depth (max over window) that flags saturation even
+#: without sheds
+POOL_QUEUED_WARN = 8
+#: device losses within one window = flapping (one loss is a blip the
+#: cooldown already absorbs)
+COOLDOWN_FLAP_LOSSES = 2
+
+
+class Finding:
+    """One diagnosis: rule, severity, the metric evidence window."""
+
+    __slots__ = ("rule", "item", "severity", "details", "metric",
+                 "start_ts", "end_ts", "first_value", "last_value",
+                 "max_value")
+
+    def __init__(self, rule: str, item: str, severity: str, details: str,
+                 metric: str = "", start_ts: float = 0.0,
+                 end_ts: float = 0.0, first_value: float = 0.0,
+                 last_value: float = 0.0, max_value: float = 0.0):
+        self.rule = rule
+        self.item = item
+        self.severity = severity      # "warning" | "critical"
+        self.details = details
+        self.metric = metric
+        self.start_ts = start_ts
+        self.end_ts = end_ts
+        self.first_value = first_value
+        self.last_value = last_value
+        self.max_value = max_value
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def row(self) -> list:
+        stamp = tsring._ts(time.time())
+        return [stamp, self.rule, self.item, self.severity, self.details,
+                self.metric,
+                tsring._ts(self.start_ts) if self.start_ts else "",
+                tsring._ts(self.end_ts) if self.end_ts else "",
+                float(self.first_value), float(self.last_value),
+                float(self.max_value)]
+
+
+#: information_schema.inspection_result column order — MUST match
+#: Finding.row
+COLUMNS = [
+    ("time", "str"), ("rule", "str"), ("item", "str"),
+    ("severity", "str"), ("details", "str"), ("metric", "str"),
+    ("evidence_start", "str"), ("evidence_end", "str"),
+    ("first_value", "real"), ("last_value", "real"),
+    ("max_value", "real"),
+]
+
+
+class InspectionContext:
+    """What a rule sees: windowed reads over the ring + the statement
+    summary.  ``window_s`` bounds the evidence span (None = everything
+    retained)."""
+
+    def __init__(self, ring: tsring.MetricsRing,
+                 now: Optional[float] = None,
+                 window_s: Optional[float] = None):
+        self.now = time.time() if now is None else now
+        self.window_s = window_s
+        # ONE consistent copy for the whole evaluation: every rule's
+        # delta/max/evidence reads see the same samples, so a finding's
+        # evidence can never disagree with the delta that triggered it
+        # (and a full run takes one ring lock, not ~15)
+        self._samples = ring.snapshot_samples()
+
+    def series(self, metric: str) -> List[tuple]:
+        since = self.now - self.window_s if self.window_s else None
+        out: List[tuple] = []
+        for ts, vals in self._samples:
+            if (since is not None and ts < since) or ts > self.now:
+                continue
+            if metric in vals:
+                out.append((ts, float(vals[metric])))
+        return out
+
+    def delta(self, metric: str) -> float:
+        """last - first over the window (0 with < 2 points)."""
+        pts = self.series(metric)
+        return pts[-1][1] - pts[0][1] if len(pts) >= 2 else 0.0
+
+    def max_value(self, metric: str) -> float:
+        pts = self.series(metric)
+        return max(v for _, v in pts) if pts else 0.0
+
+    def last(self, metric: str) -> float:
+        pts = self.series(metric)
+        return pts[-1][1] if pts else 0.0
+
+    def evidence(self, rule: str, item: str, severity: str, details: str,
+                 metric: str) -> Finding:
+        """Build a finding whose evidence window is the metric's sampled
+        span."""
+        pts = self.series(metric)
+        return Finding(
+            rule, item, severity, details, metric,
+            start_ts=pts[0][0] if pts else 0.0,
+            end_ts=pts[-1][0] if pts else 0.0,
+            first_value=pts[0][1] if pts else 0.0,
+            last_value=pts[-1][1] if pts else 0.0,
+            max_value=max((v for _, v in pts), default=0.0))
+
+    def summary_records(self) -> List[dict]:
+        from . import stmtsummary
+        return stmtsummary.snapshot()
+
+
+# ---- the rule catalogue ---------------------------------------------------
+
+RULES: Dict[str, Callable[[InspectionContext], List[Finding]]] = {}
+
+
+def rule(name: str):
+    """Register one inspection rule (decorator)."""
+    def deco(fn):
+        RULES[name] = fn
+        return fn
+    return deco
+
+
+@rule("compile-storm")
+def _rule_compile_storm(ctx: InspectionContext) -> List[Finding]:
+    metric = "tinysql_progcache_misses_total"
+    d = ctx.delta(metric)
+    if d < COMPILE_STORM_MISSES:
+        return []
+    sev = "critical" if d >= 2 * COMPILE_STORM_MISSES else "warning"
+    return [ctx.evidence(
+        "compile-storm", "progcache", sev,
+        f"{d:.0f} program builds within the window (threshold "
+        f"{COMPILE_STORM_MISSES}): literal parameterization or prewarm "
+        "is missing this workload's digest families", metric)]
+
+
+@rule("progcache-hit-rate")
+def _rule_hit_rate(ctx: InspectionContext) -> List[Finding]:
+    hits = ctx.delta("tinysql_progcache_hits_total")
+    misses = ctx.delta("tinysql_progcache_misses_total")
+    lookups = hits + misses
+    if lookups < HIT_RATE_MIN_LOOKUPS:
+        return []
+    rate = hits / lookups
+    if rate >= HIT_RATE_FLOOR:
+        return []
+    return [ctx.evidence(
+        "progcache-hit-rate", "progcache", "warning",
+        f"registry hit rate {rate:.2f} over {lookups:.0f} lookups "
+        f"(floor {HIT_RATE_FLOOR}): the program cache stopped covering "
+        "the live workload", "tinysql_progcache_hits_total")]
+
+
+@rule("pool-saturation")
+def _rule_pool_saturation(ctx: InspectionContext) -> List[Finding]:
+    out: List[Finding] = []
+    shed = ctx.delta("tinysql_admission_rejected_total")
+    if shed > 0:
+        out.append(ctx.evidence(
+            "pool-saturation", "admission", "critical",
+            f"{shed:.0f} statement(s) shed with MySQL 1041 within the "
+            "window: the admission queue hit its cap — raise "
+            "tidb_stmt_pool_size / queue_depth or reduce load",
+            "tinysql_admission_rejected_total"))
+    deep = ctx.max_value("tinysql_pool_queued")
+    if not out and deep >= POOL_QUEUED_WARN:
+        out.append(ctx.evidence(
+            "pool-saturation", "pool", "warning",
+            f"statement queue reached depth {deep:.0f} (threshold "
+            f"{POOL_QUEUED_WARN}) without shedding: latency is queue "
+            "wait, not execution", "tinysql_pool_queued"))
+    return out
+
+
+@rule("cooldown-flapping")
+def _rule_cooldown_flapping(ctx: InspectionContext) -> List[Finding]:
+    metric = "tinysql_device_loss_total"
+    d = ctx.delta(metric)
+    if d < COOLDOWN_FLAP_LOSSES:
+        return []
+    return [ctx.evidence(
+        "cooldown-flapping", "device", "critical",
+        f"{d:.0f} device losses within the window: the accelerator is "
+        "flapping, planning keeps re-pinning to CPU "
+        "(tidb_device_cooldown) — investigate the backend, not the "
+        "queries", metric)]
+
+
+@rule("memory-pressure")
+def _rule_memory_pressure(ctx: InspectionContext) -> List[Finding]:
+    metric = "tinysql_mem_quota_exceeded_total"
+    d = ctx.delta(metric)
+    if d <= 0:
+        return []
+    return [ctx.evidence(
+        "memory-pressure", "quota", "warning",
+        f"{d:.0f} statement(s) aborted on tidb_mem_quota_query within "
+        "the window (error 8175): quotas are actively shedding memory "
+        "pressure", metric)]
+
+
+@rule("prewarm-starvation")
+def _rule_prewarm_starvation(ctx: InspectionContext) -> List[Finding]:
+    out: List[Finding] = []
+    budget = ctx.delta("tinysql_prewarm_worker_skipped_budget_total")
+    if budget > 0:
+        # size the blast radius from statements_summary: every SELECT
+        # family currently aggregating is a potential cold-start victim
+        # of a starved warmer
+        try:
+            fams = sum(1 for r in ctx.summary_records()
+                       if (r.get("stmt_type") or "").lower() == "select")
+        except Exception:
+            fams = 0
+        out.append(ctx.evidence(
+            "prewarm-starvation", "budget", "warning",
+            f"{budget:.0f} prewarm candidate(s) deferred by "
+            "tidb_auto_prewarm_budget_ms within the window "
+            f"({fams} SELECT families live in statements_summary): "
+            "cold-start compiles will land on real queries — raise the "
+            "budget or top_k",
+            "tinysql_prewarm_worker_skipped_budget_total"))
+    errs = ctx.delta("tinysql_prewarm_worker_errors_total")
+    if errs > 0:
+        out.append(ctx.evidence(
+            "prewarm-starvation", "errors", "warning",
+            f"{errs:.0f} prewarm warm attempt(s) failed within the "
+            "window: their families stay cold for the cooldown",
+            "tinysql_prewarm_worker_errors_total"))
+    return out
+
+
+# ---- evaluation -----------------------------------------------------------
+
+def run(now: Optional[float] = None, window_s: Optional[float] = None,
+        ring: Optional[tsring.MetricsRing] = None) -> List[Finding]:
+    """Evaluate every registered rule; never raises (a broken rule
+    becomes its own finding)."""
+    ctx = InspectionContext(ring if ring is not None else tsring.RING,
+                            now=now, window_s=window_s)
+    findings: List[Finding] = []
+    for name, fn in RULES.items():
+        try:
+            findings.extend(fn(ctx) or [])
+        except Exception as e:
+            findings.append(Finding(
+                name, "rule", "warning",
+                f"inspection rule raised: {e!r}"))
+    return findings
+
+
+def rows(now: Optional[float] = None,
+         window_s: Optional[float] = DEFAULT_WINDOW_S) -> List[list]:
+    """The ``inspection_result`` mem-table payload.  Bounded to the
+    recent window by default (``None`` = the whole retained ring)."""
+    return [f.row() for f in run(now=now, window_s=window_s)]
+
+
+def snapshot(now: Optional[float] = None,
+             window_s: Optional[float] = DEFAULT_WINDOW_S) -> List[dict]:
+    """The ``/debug/inspection`` payload.  Bounded to the recent window
+    by default (``None`` = the whole retained ring)."""
+    return [f.to_dict() for f in run(now=now, window_s=window_s)]

@@ -54,6 +54,133 @@ _DEVICE_METRICS = {
 }
 
 
+#: THE central metric-name registry: every name exported on /metrics OR
+#: sampled into the time-series ring (obs/tsring.py) is declared here —
+#: name -> (kind, help).  The ring validates names against this table at
+#: sample time (unregistered names are dropped + counted) and qlint
+#: OB404 checks it statically, so /metrics, metrics_history, and
+#: metrics_summary can never drift apart on what a metric is called.
+METRICS: Dict[str, Tuple[str, str]] = {
+    # query lifecycle (owned here)
+    "tinysql_queries_total": ("counter", "Statements executed"),
+    "tinysql_query_seconds_sum":
+        ("counter", "Summed statement execution wall seconds "
+                    "(parse excluded)"),
+    "tinysql_slow_queries_total":
+        ("counter", "Statements whose exec wall exceeded "
+                    "tidb_slow_log_threshold"),
+    "tinysql_query_errors_total": ("counter", "Statements that raised"),
+    # progcache / prewarm provenance (ops/progcache.py)
+    "tinysql_progcache_hits_total":
+        ("counter", "In-process program-registry hits"),
+    "tinysql_progcache_misses_total":
+        ("counter", "In-process program-registry misses (program builds)"),
+    "tinysql_prewarm_seeded_total":
+        ("counter", "Programs compiled inside a prewarm scope "
+                    "(auto-prewarm worker / tools/warm.py)"),
+    "tinysql_prewarm_hits_total":
+        ("counter", "Query-path registry hits on prewarm-seeded programs "
+                    "(compiles the prewarmer saved real queries)"),
+    "tinysql_progcache_programs": ("gauge", "Registered compiled programs"),
+    # resilience (fail/, ops/degrade.py, utils/memory.py)
+    "tinysql_failpoint_hits_total":
+        ("counter", "Failpoint fires by name"),
+    "tinysql_device_loss_total":
+        ("counter", "Mid-statement accelerator losses observed"),
+    "tinysql_degraded_statements_total":
+        ("counter", "Statements transparently re-executed on CPU after a "
+                    "device loss"),
+    "tinysql_cpu_pinned":
+        ("gauge", "1 while planning is pinned to CPU (device-loss "
+                  "cooldown)"),
+    "tinysql_mem_quota_exceeded_total":
+        ("counter", "Statements aborted by tidb_mem_quota_query"),
+    # serving layer (server/admission.py, server/pool.py, ops/batching.py)
+    "tinysql_admission_admitted_total":
+        ("counter", "Statements that began executing on the statement "
+                    "pool"),
+    "tinysql_admission_queued_total":
+        ("counter", "Statements that waited in the admission queue first"),
+    "tinysql_admission_rejected_total":
+        ("counter", "Statements shed by admission control (MySQL 1041)"),
+    "tinysql_admission_queue_wait_seconds_total":
+        ("counter", "Summed seconds pooled statements spent waiting for "
+                    "a worker (the pool-side half of the per-statement "
+                    "queue_wait attribution)"),
+    "tinysql_pool_queued":
+        ("gauge", "Statements waiting in the admission queue (live "
+                  "pools)"),
+    "tinysql_pool_running":
+        ("gauge", "Statements executing on pool workers (live pools)"),
+    "tinysql_batch_rounds_total":
+        ("counter", "Coalesced same-digest batch rounds dispatched"),
+    "tinysql_batch_statements_total":
+        ("counter", "Statements served through a batch round dispatch"),
+    "tinysql_batch_occupancy_sum":
+        ("counter", "Summed batch occupancy (divide by rounds for the "
+                    "average)"),
+    "tinysql_batch_fallbacks_total":
+        ("counter", "Replay consume misses that fell back to solo "
+                    "dispatch"),
+    "tinysql_batch_dispatch_seconds_total":
+        ("counter", "Wall seconds spent inside batch-round device "
+                    "dispatch legs"),
+    "tinysql_stmt_mem_inflight_bytes":
+        ("gauge", "Aggregate live MemTracker bytes held by RUNNING "
+                  "statements (the admission gate's pressure signal)"),
+    # histograms / debug surfaces
+    "tinysql_stmt_phase_seconds":
+        ("histogram", "Statement latency by phase (statement summary "
+                      "store)"),
+    "tinysql_trace_ring_entries":
+        ("gauge", "Query traces buffered for /debug/trace"),
+    # time-series sampler self-accounting (obs/tsring.py)
+    "tinysql_metrics_samples_total":
+        ("counter", "Time-series ring samples taken"),
+    "tinysql_metrics_sample_seconds_total":
+        ("counter", "Wall seconds spent collecting ring samples (the "
+                    "sampler's own overhead)"),
+    "tinysql_metrics_dropped_unregistered_total":
+        ("counter", "Sampled values dropped because their metric name "
+                    "was not in the central registry"),
+    "tinysql_metrics_ring_entries":
+        ("gauge", "Samples currently retained in the time-series ring"),
+}
+
+#: STATS keys that are high-water marks (gauges), not accumulators —
+#: THE definition; kernels imports it (as ``_HWM_KEYS``) so the
+#: /metrics render and this registry can never disagree on
+#: gauge-vs-counter, and declaring it here keeps this module
+#: importable without jax
+HWM_STATS_KEYS = ("pipe_depth_hwm",)
+
+# device-economics names come from the _DEVICE_METRICS map above (one
+# definition of the STATS-key -> prometheus-name mapping)
+for _k, (_name, _help) in _DEVICE_METRICS.items():
+    METRICS[_name] = ("gauge" if _k in HWM_STATS_KEYS else "counter",
+                      _help)
+# auto-prewarm worker counters (session/prewarm.py PREWARM_STATS keys)
+for _k in ("cycles", "families_warmed", "bucket_programs", "errors",
+           "skipped_cooldown", "skipped_budget", "skipped_satisfied"):
+    METRICS[f"tinysql_prewarm_worker_{_k}_total"] = (
+        "counter", f"Auto-prewarm worker {_k.replace('_', ' ')}")
+
+
+def registered(name: str) -> bool:
+    """Is ``name`` a declared metric?  (The tsring sample-time check.)"""
+    return name in METRICS
+
+
+def query_counter_totals() -> Dict[str, float]:
+    """The query-lifecycle counters summed across their ``kind`` labels —
+    the flat (label-free) form the time-series ring samples."""
+    with _mu:
+        out: Dict[str, float] = {}
+        for (metric, _labels), v in _QUERY_COUNTERS.items():
+            out[metric] = out.get(metric, 0) + v
+    return out
+
+
 def _bump(metric: str, labels: tuple, n: float) -> None:
     with _mu:
         key = (metric, labels)
@@ -109,16 +236,9 @@ def render_prometheus() -> str:
         grouped: Dict[str, List[Tuple[tuple, float]]] = {}
         for (metric, labels), v in sorted(_QUERY_COUNTERS.items()):
             grouped.setdefault(metric, []).append((labels, v))
-    helps = {
-        "tinysql_queries_total": "Statements executed",
-        "tinysql_query_seconds_sum":
-            "Summed statement execution wall seconds (parse excluded)",
-        "tinysql_slow_queries_total":
-            "Statements whose exec wall exceeded tidb_slow_log_threshold",
-        "tinysql_query_errors_total": "Statements that raised",
-    }
     for metric in sorted(grouped):
-        emit(metric, helps.get(metric, metric), "counter", grouped[metric])
+        emit(metric, METRICS.get(metric, ("counter", metric))[1],
+             "counter", grouped[metric])
 
     # device-economics counters (kernels.STATS); the HWM-key set is
     # owned by kernels — one definition, so a new high-water counter
@@ -211,15 +331,20 @@ def render_prometheus() -> str:
     except Exception:
         adm = {}
     if adm:
-        for key, help_text in (
-                ("admitted", "Statements that began executing on the "
-                             "statement pool"),
-                ("queued", "Statements that waited in the admission "
-                           "queue first"),
-                ("rejected", "Statements shed by admission control "
-                             "(MySQL 1041)")):
-            emit(f"tinysql_admission_{key}_total", help_text, "counter",
+        for key in ("admitted", "queued", "rejected"):
+            name = f"tinysql_admission_{key}_total"
+            emit(name, METRICS[name][1], "counter",
                  [((), adm.get(key, 0))])
+        emit("tinysql_admission_queue_wait_seconds_total",
+             METRICS["tinysql_admission_queue_wait_seconds_total"][1],
+             "counter", [((), adm.get("queue_wait_s_sum", 0.0))])
+        try:
+            from ..server.admission import aggregate_stmt_mem
+            emit("tinysql_stmt_mem_inflight_bytes",
+                 METRICS["tinysql_stmt_mem_inflight_bytes"][1], "gauge",
+                 [((), aggregate_stmt_mem())])
+        except Exception:
+            pass
     try:
         from ..server.pool import gauges as pool_gauges
         pg = pool_gauges()
@@ -248,6 +373,32 @@ def render_prometheus() -> str:
         emit("tinysql_batch_fallbacks_total",
              "Replay consume misses that fell back to solo dispatch",
              "counter", [((), bst.get("fallbacks", 0))])
+        emit("tinysql_batch_dispatch_seconds_total",
+             METRICS["tinysql_batch_dispatch_seconds_total"][1],
+             "counter", [((), bst.get("dispatch_s_sum", 0.0))])
+
+    # time-series sampler self-accounting (obs/tsring.py): the cost of
+    # observing is itself observable (bench obs_overhead_frac reads it)
+    try:
+        from .tsring import stats_snapshot as tsring_stats, RING
+        ts = tsring_stats()
+        ring_len = RING.size()
+    except Exception:
+        ts, ring_len = {}, None
+    if ts.get("samples"):
+        emit("tinysql_metrics_samples_total",
+             METRICS["tinysql_metrics_samples_total"][1], "counter",
+             [((), ts.get("samples", 0))])
+        emit("tinysql_metrics_sample_seconds_total",
+             METRICS["tinysql_metrics_sample_seconds_total"][1],
+             "counter", [((), ts.get("sample_wall_s", 0.0))])
+        emit("tinysql_metrics_dropped_unregistered_total",
+             METRICS["tinysql_metrics_dropped_unregistered_total"][1],
+             "counter", [((), ts.get("dropped_unregistered", 0))])
+    if ring_len is not None:
+        emit("tinysql_metrics_ring_entries",
+             METRICS["tinysql_metrics_ring_entries"][1], "gauge",
+             [((), ring_len)])
 
     # per-phase statement latency histograms, fed from the statement
     # summary store's ingest path (obs/stmtsummary.py) — the SQL-visible

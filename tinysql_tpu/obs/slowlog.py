@@ -80,6 +80,11 @@ def build_record(sql: str, info: dict, qobs=None, *, conn_id: int = 0,
         "parse_ms": round(info.get("parse_s", 0.0) * 1e3, 3),
         "plan_ms": round(info.get("plan_s", 0.0) * 1e3, 3),
         "exec_ms": round(info.get("exec_s", 0.0) * 1e3, 3),
+        # serving-path wait attribution (server/pool.py measurement):
+        # whether this slow statement was slow because it RAN slow or
+        # because it WAITED — queue wait is outside total_ms
+        "queue_wait_ms": round(info.get("queue_s", 0.0) * 1e3, 3),
+        "batch_wait_ms": round(info.get("batch_s", 0.0) * 1e3, 3),
     }
     if sql_digest:
         rec["sql_digest"] = sql_digest
@@ -87,6 +92,8 @@ def build_record(sql: str, info: dict, qobs=None, *, conn_id: int = 0,
         rec["plan_digest"] = qobs.plan_digest
         rec["device"] = qobs.device_totals()
         rec["operators"] = qobs.operators()
+        if qobs.admission_verdict:
+            rec["admission_verdict"] = qobs.admission_verdict
     return rec
 
 

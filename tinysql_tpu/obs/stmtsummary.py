@@ -45,8 +45,16 @@ from typing import Dict, List, Optional, Tuple
 DEFAULT_REFRESH_INTERVAL_S = 1800
 DEFAULT_MAX_STMT_COUNT = 200
 
-#: phases the ingest path buckets into the /metrics histograms
-HIST_PHASES = ("parse", "plan", "exec")
+#: phases the ingest path buckets into the /metrics histograms; "queue"
+#: is the serving-path wait a pooled statement spent waiting for a
+#: worker (info key queue_s, measured by server/pool.py) — so a p99
+#: regression can be split into queue wait vs execution straight from
+#: the histogram
+HIST_PHASES = ("parse", "plan", "exec", "queue")
+
+#: phase keys folded into per-record sum/max aggregates ("total" is the
+#: statement wall; "queue"/"batch" are serving-path waits OUTSIDE it)
+_FOLD_PHASES = ("parse", "plan", "exec", "total", "queue", "batch")
 
 #: upper bounds (seconds) of the latency histogram buckets; +Inf implied
 LATENCY_BUCKETS_S = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
@@ -105,7 +113,7 @@ class StmtRecord:
     __slots__ = ("sql_digest", "digest_text", "plan_digest", "stmt_type",
                  "schema_name", "exec_count", "sum_errors", "sum_ms",
                  "max_ms", "device", "max_mem", "sum_rows", "first_seen",
-                 "last_seen", "sample_sql", "sample_plan")
+                 "last_seen", "sample_sql", "sample_plan", "queued_count")
 
     def __init__(self, sql_digest: str, digest_text: str,
                  plan_digest: str):
@@ -125,17 +133,20 @@ class StmtRecord:
         self.last_seen = 0.0
         self.sample_sql = ""
         self.sample_plan = ""
+        self.queued_count = 0
 
     def fold(self, *, stmt_type: str, schema_name: str,
              info: Dict[str, float], device: Dict[str, float],
              rows_returned: int, error: bool, max_mem: int, sql: str,
-             plan: str, now: float) -> None:
+             plan: str, now: float, queued: bool = False) -> None:
         self.exec_count += 1
         if error:
             self.sum_errors += 1
+        if queued:
+            self.queued_count += 1
         self.stmt_type = stmt_type or self.stmt_type
         self.schema_name = schema_name or self.schema_name
-        for phase in ("parse", "plan", "exec", "total"):
+        for phase in _FOLD_PHASES:
             ms = float(info.get(f"{phase}_s", 0.0)) * 1e3
             self.sum_ms[phase] = self.sum_ms.get(phase, 0.0) + ms
             if ms > self.max_ms.get(phase, 0.0):
@@ -157,6 +168,7 @@ class StmtRecord:
         """Fold ``other`` into this record (tombstone accounting)."""
         self.exec_count += other.exec_count
         self.sum_errors += other.sum_errors
+        self.queued_count += other.queued_count
         for p, v in other.sum_ms.items():
             self.sum_ms[p] = self.sum_ms.get(p, 0.0) + v
         for p, v in other.max_ms.items():
@@ -194,6 +206,10 @@ class StmtRecord:
             round(self.max_ms.get("plan", 0.0), 3),
             round(self.sum_ms.get("exec", 0.0), 3),
             round(self.max_ms.get("exec", 0.0), 3),
+            round(self.sum_ms.get("queue", 0.0), 3),
+            round(self.max_ms.get("queue", 0.0), 3),
+            round(self.sum_ms.get("batch", 0.0), 3),
+            self.queued_count,
             int(d.get("dispatches", 0)), int(d.get("d2h_transfers", 0)),
             int(d.get("d2h_bytes", 0)), int(d.get("progcache_hits", 0)),
             int(d.get("progcache_misses", 0)),
@@ -210,6 +226,7 @@ class StmtRecord:
                 "plan_digest": self.plan_digest,
                 "stmt_type": self.stmt_type, "schema": self.schema_name,
                 "exec_count": self.exec_count, "errors": self.sum_errors,
+                "queued_count": self.queued_count,
                 "sum_ms": dict(self.sum_ms), "max_ms": dict(self.max_ms),
                 "device": dict(self.device), "max_mem": self.max_mem,
                 "rows": self.sum_rows, "sample_sql": self.sample_sql}
@@ -225,6 +242,8 @@ COLUMNS = [
     ("sum_parse_ms", "real"), ("max_parse_ms", "real"),
     ("sum_plan_ms", "real"), ("max_plan_ms", "real"),
     ("sum_exec_ms", "real"), ("max_exec_ms", "real"),
+    ("sum_queue_wait_ms", "real"), ("max_queue_wait_ms", "real"),
+    ("sum_batch_wait_ms", "real"), ("queued_count", "int"),
     ("dispatches", "int"), ("d2h_transfers", "int"), ("d2h_bytes", "int"),
     ("compile_cache_hits", "int"), ("compile_cache_misses", "int"),
     ("pipe_blocks", "int"), ("pipe_overlap_frac", "real"),
@@ -268,6 +287,7 @@ class SummaryStore:
                plan_text: str = "", plan_rows=None,
                sql_digest: str = "",
                digest_text: str = "",
+               queued: bool = False,
                refresh_interval_s: Optional[float] = None,
                max_stmt_count: Optional[int] = None,
                now: Optional[float] = None) -> str:
@@ -310,7 +330,8 @@ class SummaryStore:
             rec.fold(stmt_type=stmt_type, schema_name=schema_name,
                      info=info, device=device,
                      rows_returned=rows_returned, error=error,
-                     max_mem=max_mem, sql=sql, plan=plan_text, now=now)
+                     max_mem=max_mem, sql=sql, plan=plan_text, now=now,
+                     queued=queued)
             for phase in HIST_PHASES:
                 v = float(info.get(f"{phase}_s", 0.0))
                 # 0.0 means "no measurement for this phase" (wire

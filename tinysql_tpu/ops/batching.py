@@ -55,8 +55,11 @@ from typing import Dict, List, Optional
 #: batched_statements = members served from a round dispatch,
 #: occupancy_sum / batches = average batch occupancy, parks / replays
 #: the protocol legs, fallbacks = replay consume misses (solo re-dispatch)
+#: dispatch_s_sum accumulates wall seconds inside round dispatch legs
+#: (exported as tinysql_batch_dispatch_seconds_total: the device-side
+#: half of a batched member's wait attribution)
 STATS = {"batches": 0, "batched_statements": 0, "occupancy_sum": 0,
-         "parks": 0, "replays": 0, "fallbacks": 0}
+         "parks": 0, "replays": 0, "fallbacks": 0, "dispatch_s_sum": 0.0}
 _stats_mu = threading.Lock()
 
 
@@ -161,7 +164,9 @@ class BatchRound:
         result: its replay consume misses and the solo re-dispatch
         surfaces the error through the statement's own degradation
         path."""
+        import time as _time
         from . import kernels
+        t0 = _time.perf_counter()
         occ = 0
         for p in self._parked:
             try:
@@ -175,6 +180,7 @@ class BatchRound:
             _stat_add("batches")
             _stat_add("batched_statements", occ)
             _stat_add("occupancy_sum", occ)
+            _stat_add("dispatch_s_sum", _time.perf_counter() - t0)
         return occ
 
     # ---- replay ----------------------------------------------------------

@@ -255,8 +255,10 @@ STATS = {"dispatches": 0, "d2h_transfers": 0, "d2h_bytes": 0,
          "pipe_blocks": 0, "pipe_stage_s": 0.0, "pipe_dispatch_s": 0.0,
          "pipe_drain_s": 0.0, "pipe_wall_s": 0.0, "pipe_depth_hwm": 0}
 
-#: STATS keys that are high-water marks, not accumulators
-_HWM_KEYS = ("pipe_depth_hwm",)
+#: STATS keys that are high-water marks, not accumulators — declared in
+#: the central metric registry so the registry's gauge-vs-counter kinds
+#: and the /metrics render share one definition
+from ..obs.metrics import HWM_STATS_KEYS as _HWM_KEYS  # noqa: E402
 
 #: guards the global STATS read-modify-writes — sessions and devpipe
 #: producer threads increment concurrently

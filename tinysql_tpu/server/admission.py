@@ -36,8 +36,14 @@ from typing import Dict
 from .. import fail
 
 #: process-total admission verdicts: admitted = began executing,
-#: queued = waited in the pool queue first, rejected = shed with 1041
-STATS = {"admitted": 0, "queued": 0, "rejected": 0}
+#: queued = waited in the pool queue first, rejected = shed with 1041.
+#: queue_wait_s_sum accumulates every pooled statement's measured wait
+#: for a worker (pool claim time minus submit time) — the pool-side
+#: half of the per-statement queue_wait attribution, so
+#: statements_summary's sum_queue_wait_ms can be reconciled against the
+#: serving tier's own accounting over any metrics_history window
+STATS = {"admitted": 0, "queued": 0, "rejected": 0,
+         "queue_wait_s_sum": 0.0}
 _mu = threading.Lock()
 
 
@@ -125,3 +131,10 @@ def count_admitted() -> None:
 
 def count_queued() -> None:
     _count("queued")
+
+
+def record_queue_wait(seconds: float) -> None:
+    """One claimed entry's measured wait for a worker (called by the
+    pool at claim time, queued and immediately-admitted entries both —
+    an 'admitted' wait is just very small)."""
+    _count("queue_wait_s_sum", float(seconds))

@@ -57,6 +57,26 @@ def _make_handler(server_ref):
                 self._send(200, json.dumps(snapshot(),
                                            default=str).encode())
                 return
+            if parsed.path == "/debug/inspection":
+                from ..obs import inspect as oinspect
+                qs = parse_qs(parsed.query)
+                # absent -> the bounded default window; window=0 -> the
+                # whole retained ring
+                try:
+                    window = float(
+                        qs.get("window", [oinspect.DEFAULT_WINDOW_S])[0]
+                    ) or None
+                except ValueError:
+                    window = oinspect.DEFAULT_WINDOW_S
+                self._send(200, json.dumps(
+                    oinspect.snapshot(window_s=window),
+                    default=str).encode())
+                return
+            if parsed.path == "/debug/metrics/summary":
+                from ..obs.tsring import RING
+                self._send(200, json.dumps(
+                    RING.summary_rows(), default=str).encode())
+                return
             if parsed.path == "/debug/prewarm":
                 from ..session.prewarm import stats_snapshot
                 worker = getattr(srv, "prewarm", None) if srv else None
@@ -95,6 +115,9 @@ def _make_handler(server_ref):
                            b'<a href="/debug/slowlog">slowlog</a> '
                            b'<a href="/debug/stmtsummary">stmtsummary</a> '
                            b'<a href="/debug/prewarm">prewarm</a> '
+                           b'<a href="/debug/inspection">inspection</a> '
+                           b'<a href="/debug/metrics/summary">'
+                           b'metrics-summary</a> '
                            b'<a href="/debug/threads">threads</a>',
                            "text/html")
             else:

@@ -10,9 +10,21 @@ connection thread — deliberately, so KILL and introspection always work
 even when every worker is wedged (the ``admissionDelay`` chaos drill).
 
 Queued statements are first-class citizens: ``processlist`` shows them
-with state ``queued`` (session.stmt_state), KILL while queued cancels
-without ever occupying a worker, and a plain KILL / server shutdown
-wakes the waiting connection thread with a typed error.
+with state ``queued`` (session.stmt_state, TIME = wait-so-far), KILL
+while queued cancels without ever occupying a worker, and a plain KILL
+/ server shutdown wakes the waiting connection thread with a typed
+error.
+
+Wait attribution: the pool measures each entry's queue wait (submit →
+worker claim) and batch wait (claim → the leg that produced its
+result), feeds the pool-side sum to ``server/admission.py``
+(``queue_wait_s_sum`` → /metrics + the time-series ring), and deposits
+the per-statement measurement on the session (``pending_wait``) right
+before invoking it — the statement scope turns it into ``queue_wait``
+/ ``batch_wait`` trace spans, ``statements_summary`` columns, and
+``slow_query`` fields.  Workers run each statement inside a
+``contextvars`` copy of the submitting thread's context, so the span
+chain parents across the thread hop (the PR 3 devpipe idiom).
 
 Coalescing: when a worker dequeues a SELECT whose normalized-SQL digest
 belongs to a learned batchable family (ops/batching.py — statements
@@ -28,6 +40,7 @@ transparent.
 """
 from __future__ import annotations
 
+import contextvars
 import logging
 import threading
 import time
@@ -86,7 +99,8 @@ class PoolClosed(Exception):
 
 class _Entry:
     __slots__ = ("session", "stmt", "label", "digest", "done", "result",
-                 "error", "state", "queued_at", "batchable")
+                 "error", "state", "queued_at", "batchable", "ctx",
+                 "queued_mono", "claimed_at", "queue_wait_s", "verdict")
 
     def __init__(self, session, stmt, label: str, digest: str,
                  batchable: bool):
@@ -100,6 +114,34 @@ class _Entry:
         self.error: Optional[BaseException] = None
         self.state = "queued"
         self.queued_at = time.time()
+        # the submitting thread's context, captured NOW: workers run the
+        # statement inside a copy of it, so spans recorded during
+        # execution parent to whatever span was live at submit time (the
+        # PR 3 devpipe cross-thread idiom) instead of silently starting
+        # a fresh chain on the worker thread
+        self.ctx = contextvars.copy_context()
+        # wait attribution (monotonic clock): queue_wait_s is filled at
+        # claim time; verdict is "queued" when the entry had to wait
+        # behind the pool, "admitted" when a worker was free
+        self.queued_mono = time.monotonic()
+        self.claimed_at = self.queued_mono
+        self.queue_wait_s = 0.0
+        self.verdict = "admitted"
+
+    def claim(self) -> None:
+        """A worker took this entry off the queue: freeze its measured
+        queue wait.  The pool-side accumulator is fed later, at
+        execution start (past the kill pre-checks) — an entry killed
+        while queued never executes, never ingests its wait into
+        statements_summary, and so must not count on the pool side
+        either, or the two surfaces drift apart under KILL traffic."""
+        self.claimed_at = time.monotonic()
+        self.queue_wait_s = max(0.0, self.claimed_at - self.queued_mono)
+
+    def wait_info(self, batch_wait_s: float = 0.0) -> dict:
+        return {"queue_wait_s": self.queue_wait_s,
+                "batch_wait_s": max(0.0, batch_wait_s),
+                "admission_verdict": self.verdict}
 
     def complete(self, result=None, error: Optional[BaseException] = None):
         self.result = result
@@ -159,6 +201,7 @@ class StatementPool:
             session.guard.killed = False
             if self._running >= size or self._queue:
                 admission.count_queued()
+                entry.verdict = "queued"
             self._queue.append(entry)
             session.stmt_state = "queued"
             session.pending_sql = label
@@ -234,6 +277,7 @@ class StatementPool:
                                          PoolClosed())
                     return
                 entry = self._queue.popleft()
+                entry.claim()
                 self._running += 1
             try:
                 self._serve(entry)
@@ -284,6 +328,7 @@ class StatementPool:
                         break
                     if e.batchable and e.digest == leader.digest:
                         self._queue.remove(e)
+                        e.claim()
                         e.state = "batched"
                         members.append(e)
                 remaining = deadline - time.monotonic()
@@ -291,6 +336,30 @@ class StatementPool:
                     break
                 self._cv.wait(timeout=remaining)
         return members
+
+    @staticmethod
+    def _exec_entry(entry: _Entry, rnd=None):
+        """Run the entry's statement INSIDE the context captured at
+        submit time (cross-thread span parenting, the PR 3 devpipe
+        idiom): the statement's parse→plan→execute span chain parents
+        to whatever span was live on the submitting thread instead of
+        starting an orphan chain on the worker.  The batch round (when
+        given) is activated inside that copied context — activating it
+        on the worker's own context would be invisible there."""
+        entry.session.pending_wait = entry.wait_info(
+            batch_wait_s=(time.monotonic() - entry.claimed_at)
+            if rnd is not None else 0.0)
+
+        def _invoke():
+            if rnd is None:
+                return entry.session.execute_stmt(entry.stmt, entry.label)
+            from ..ops import batching
+            tok = batching.activate(rnd)
+            try:
+                return entry.session.execute_stmt(entry.stmt, entry.label)
+            finally:
+                batching.deactivate(tok)
+        return entry.ctx.run(_invoke)
 
     def _run_one(self, entry: _Entry) -> None:
         sess = entry.session
@@ -300,9 +369,9 @@ class StatementPool:
             entry.complete(error=QueryKilled())
             return
         admission.count_admitted()
+        admission.record_queue_wait(entry.queue_wait_s)
         try:
-            entry.complete(result=sess.execute_stmt(entry.stmt,
-                                                    entry.label))
+            entry.complete(result=self._exec_entry(entry))
         except BaseException as e:
             entry.complete(error=e)
 
@@ -321,15 +390,21 @@ class StatementPool:
                 continue
             admission.count_admitted()
             rnd.collecting = True
-            tok = batching.activate(rnd)
             try:
-                e.complete(result=sess.execute_stmt(e.stmt, e.label))
+                result = self._exec_entry(e, rnd)
             except batching.Parked:
+                # wait accounting deferred to the replay leg: a parked
+                # member can still be killed before it ever executes,
+                # and a killed member must not count on the pool side
+                # (the claim() contract)
                 pending.append(e)
             except BaseException as ex:
+                admission.record_queue_wait(e.queue_wait_s)
                 e.complete(error=ex)
+            else:
+                admission.record_queue_wait(e.queue_wait_s)
+                e.complete(result=result)
             finally:
-                batching.deactivate(tok)
                 rnd.collecting = False
         if not pending:
             return
@@ -343,14 +418,18 @@ class StatementPool:
             if e.session.guard.killed or e.session.killed:
                 e.complete(error=QueryKilled())
                 continue
+            admission.record_queue_wait(e.queue_wait_s)
             rnd.replaying = True
-            tok = batching.activate(rnd)
             try:
-                e.complete(result=e.session.execute_stmt(e.stmt, e.label))
+                # the replay leg re-deposits wait info (the parked
+                # collect leg consumed the first deposit but is
+                # invisible to observability): batch_wait now spans
+                # claim -> replay, i.e. the time spent waiting on the
+                # round's other members + the shared dispatch
+                e.complete(result=self._exec_entry(e, rnd))
             except BaseException as ex:
                 e.complete(error=ex)
             finally:
-                batching.deactivate(tok)
                 rnd.replaying = False
 
     # ---- introspection / lifecycle --------------------------------------

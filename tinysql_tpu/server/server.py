@@ -356,6 +356,12 @@ class Server:
         # high-throughput serving path (ROADMAP open item 2)
         from .pool import StatementPool
         self.pool = StatementPool(storage)
+        # time-series metrics sampler (obs/tsring.py): snapshots every
+        # registered counter/gauge source into the bounded ring behind
+        # information_schema.metrics_history / metrics_summary and the
+        # inspection engine, paced by the GLOBAL tidb_metrics_interval
+        from ..obs.tsring import Sampler
+        self.metrics_sampler = Sampler(storage)
         self.host = host
         self.port = port
         self.sock: Optional[socket.socket] = None
@@ -376,6 +382,7 @@ class Server:
                              name="mysql-accept")
         t.start()
         self.prewarm.start()
+        self.metrics_sampler.start()
         log.info("listening on %s:%d", self.host, self.port)
         return self.port
 
@@ -422,6 +429,7 @@ class Server:
         self._closed.set()
         self.pool.close()
         self.prewarm.close()
+        self.metrics_sampler.close()
         self.domain.close()
         if self.sock is not None:
             try:

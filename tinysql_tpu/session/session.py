@@ -137,6 +137,14 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # long a worker tops up a forming batch from the queue
     "tidb_batch_max_size": 16,
     "tidb_batch_window_ms": 2,
+    # ---- time-series metrics ring (obs/tsring.py; GLOBAL scope — the
+    # server's background sampler re-reads both every tick) -------------
+    # seconds between ring samples (0 pauses the sampler without
+    # stopping it)
+    "tidb_metrics_interval": 5,
+    # seconds of sample history information_schema.metrics_history /
+    # metrics_summary retain; shrinking it trims the ring immediately
+    "tidb_metrics_retention": 900,
 }
 
 
@@ -214,6 +222,11 @@ class Session:
         # waiting for a worker, with the pending SQL for processlist
         self.stmt_state = ""
         self.pending_sql = ""
+        # serving-path wait attribution handoff: the pool measures this
+        # statement's queue/batch wait + admission verdict and deposits
+        # it here right before invoking execute_stmt on a worker; the
+        # statement scope consumes (and clears) it in _execute_one
+        self.pending_wait = None
         # rendered EXPLAIN rows of the last planned statement — the
         # EXPLAIN FOR CONNECTION <id> payload (set before execution so a
         # live statement's plan is readable from another session)
@@ -378,6 +391,24 @@ class Session:
         tok = obs_context.activate(qobs)
         self.last_query_stats = qobs
         t1 = time.perf_counter()
+        # serving-path wait attribution: consume the pool's measurement
+        # (one statement each — cleared so a later non-pooled statement
+        # on this session can't inherit it).  Waits predate this scope,
+        # so they enter the trace as already-measured complete spans
+        # ending where execution begins.
+        wait, self.pending_wait = self.pending_wait, None
+        queue_s = float(wait.get("queue_wait_s", 0.0)) if wait else 0.0
+        batch_s = float(wait.get("batch_wait_s", 0.0)) if wait else 0.0
+        if wait:
+            qobs.admission_verdict = wait.get("admission_verdict", "")
+            if queue_s > 0:
+                qobs.tracer.add_complete(
+                    "queue_wait", t1 - queue_s - batch_s, queue_s,
+                    cat="serving",
+                    args={"verdict": qobs.admission_verdict})
+            if batch_s > 0:
+                qobs.tracer.add_complete("batch_wait", t1 - batch_s,
+                                         batch_s, cat="serving")
         self._plan_s = 0.0
         err = True
         parked = False
@@ -404,6 +435,12 @@ class Session:
                     "plan_s": self._plan_s,
                     "exec_s": t_exec,
                     "total_s": parse_wall + t_exec}
+            if wait:
+                # waits stay OUTSIDE total_s (they are not execution);
+                # statements_summary / slow_query / the "queue" phase
+                # histogram attribute them separately
+                info["queue_s"] = queue_s
+                info["batch_s"] = batch_s
             qobs.info = info
             if not parked:
                 self._finish_obs(s, qobs, info, err, n_rows)
@@ -488,6 +525,7 @@ class Session:
                     device=qobs.device_totals(),
                     rows_returned=rows_returned, error=err, max_mem=mem,
                     plan_rows=qobs.plan_rows,
+                    queued=qobs.admission_verdict == "queued",
                     refresh_interval_s=interval,
                     max_stmt_count=max_count)
             if not err:
@@ -901,7 +939,9 @@ class Session:
                      "tidb_stmt_pool_queue_depth",
                      "tidb_admission_mem_limit",
                      "tidb_batch_max_size",
-                     "tidb_batch_window_ms")
+                     "tidb_batch_window_ms",
+                     "tidb_metrics_interval",
+                     "tidb_metrics_retention")
 
     @staticmethod
     def _validate_uint_sysvar(name: str, v: Datum) -> int:
