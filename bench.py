@@ -193,6 +193,10 @@ def main():
                 stats.setdefault("host_dispatches", 0)
                 stats.setdefault("progcache_hits", 0)
                 stats.setdefault("progcache_misses", 0)
+                # memory-adaptive execution (ops/spill.py): 0 on an
+                # unconstrained run — the quota-squeezed section below
+                # proves the nonzero path
+                stats.setdefault("spill_bytes", 0)
         if tier != "cpu":
             print(f"[bench] phases parse={phases.get('parse_s', 0)*1e3:.1f}ms"
                   f" plan={phases.get('plan_s', 0)*1e3:.1f}ms"
@@ -320,6 +324,69 @@ def main():
                   f"non-compile regression", file=sys.stderr)
         param_reuse[name] = ent
 
+    # ---- memory-adaptive spill proof (ISSUE 9 acceptance): each query
+    # re-runs with tidb_mem_quota_query at HALF its own unconstrained
+    # working-set peak (live-set MemTracker) and the soft watermark at
+    # 0.8.  HARD-ASSERTED: the quota-constrained join (Q3) completes
+    # with zero errors and rows byte-identical to the unconstrained run
+    # — graceful degradation, not statement death.  spill_bytes /
+    # spilled_queries are published per query.
+    from tinysql_tpu.ops import spill as spill_ops
+    s.execute("set @@tidb_use_tpu = 1")
+    s.execute("set @@tidb_mem_quota_spill_ratio = 0.8")
+    spill_detail = {}
+    spilled_queries = 0
+    for name, sql in tpch.QUERIES.items():
+        want_rows = s.query(sql).rows   # warm + measure the working set
+        peak = s._stmt_mem.peak
+        quota = max(peak // 2, 64 << 10)
+        snap = spill_ops.stats_snapshot()
+        s.execute(f"set @@tidb_mem_quota_query = {quota}")
+        err = None
+        t0 = time.time()
+        try:
+            got_rows = s.query(sql).rows
+        except Exception as e:   # published, and hard-failed below
+            err, got_rows = str(e), None
+        dt = time.time() - t0
+        s.execute("set @@tidb_mem_quota_query = 0")
+        st = spill_ops.stats_snapshot()
+        ent = {"quota_bytes": quota, "unconstrained_peak_bytes": peak,
+               "constrained_s": round(dt, 4),
+               "spill_bytes": int(st["spill_bytes"]
+                                  - snap["spill_bytes"]),
+               "spill_partitions": int(st["spill_partitions"]
+                                       - snap["spill_partitions"]),
+               "spill_repartitions": int(st["spill_repartitions"]
+                                         - snap["spill_repartitions"]),
+               "spill_stream_runs": int(st["spill_stream_runs"]
+                                        - snap["spill_stream_runs"]),
+               "errors": 0 if err is None else 1,
+               # streamed partial-agg merges may differ in the last ulp
+               # (documented); published match uses the bench's float
+               # tolerance — Q3's byte-exactness is asserted below
+               "match": got_rows is not None
+               and _rows_match(got_rows, want_rows)}
+        if err is not None:
+            ent["error"] = err[:200]
+        if ent["spill_bytes"] > 0:
+            spilled_queries += 1
+        print(f"[bench] {name} half-quota: {dt:.3f}s "
+              f"spill={ent['spill_bytes']}B match={ent['match']} "
+              f"errors={ent['errors']}", file=sys.stderr)
+        spill_detail[name] = ent
+        # graceful degradation is not negotiable: every quota-squeezed
+        # query completes with zero errors and matching rows
+        assert err is None and ent["match"], (name, ent)
+        # the acceptance join: byte-identical, via real spilling
+        if name == "Q3":
+            assert got_rows == want_rows, (name, ent)
+            assert ent["spill_bytes"] > 0, (name, ent)
+        # leak gauge must return to rest after every statement
+        assert st["open_slots"] == 0, (name, st)
+    spill_summary = {"spilled_queries": spilled_queries,
+                     "queries": spill_detail}
+
     # operator micro-benchmarks (BASELINE.json configs 1-4): rows/sec
     # through HashAgg / HashJoin / Projection+Filter / top-k Sort per
     # tier, so operator regressions are visible independent of the
@@ -361,6 +428,7 @@ def main():
         },
         "operators": op_results,
         "param_reuse": param_reuse,
+        "spill": spill_summary,
         "obs_overhead_frac": obs_overhead_frac,
         "link": link,
         "correct": all(ok for _, _, _, ok in results.values())

@@ -400,6 +400,69 @@ def _prewarm_compile_error(tk):
         s.storage._global_vars.pop("tidb_auto_prewarm_cooldown", None)
 
 
+def _spill_session(s):
+    """Put the chaos session on the device path (the spill routes live
+    in the TPU executors) with no row-count gate."""
+    s.execute("set @@tidb_use_tpu = 1")
+    s.execute("set @@tidb_tpu_min_rows = 1")
+
+
+@chaos("spillForceAll")
+def _spill_force_all(tk):
+    """Armed, every spill-capable operator runs partitioned: results
+    identical to the in-memory path, real spill traffic recorded, zero
+    partitions left open afterwards."""
+    from tinysql_tpu.ops import spill
+    s, _ = tk
+    want = s.query("select b, count(*), sum(a) from t "
+                   "group by b order by b").rows
+    _spill_session(s)
+    spill.reset_stats()
+    with fail.armed("spillForceAll", value=1):
+        got = s.query("select b, count(*), sum(a) from t "
+                      "group by b order by b").rows
+    assert got == want
+    st = spill.stats_snapshot()
+    assert st["spill_partitions"] > 0 and st["spill_bytes"] > 0
+    assert st["open_slots"] == 0
+
+
+@chaos("spillPartitionError")
+def _spill_partition_error(tk):
+    """A failed partition WRITE surfaces as a typed statement error; no
+    spill files or resident tracker bytes leak, and the session stays
+    healthy once disarmed."""
+    from tinysql_tpu.ops import spill
+    s, _ = tk
+    _spill_session(s)
+    with fail.armed("spillForceAll", value=1), \
+            fail.armed("spillPartitionError",
+                       exc=spill.SpillError("injected write failure"),
+                       times=1):
+        with pytest.raises(spill.SpillError):
+            s.query("select b, count(*), sum(a) from t group by b")
+    assert spill.stats_snapshot()["open_slots"] == 0
+    _read_ok(s)  # disarmed: the same statement shape runs clean
+
+
+@chaos("spillReloadError")
+def _spill_reload_error(tk):
+    """A failed partition RELOAD mid-drain drops every remaining
+    partition cleanly — typed error, no leaked slots, session healthy
+    after."""
+    from tinysql_tpu.ops import spill
+    s, _ = tk
+    _spill_session(s)
+    with fail.armed("spillForceAll", value=1), \
+            fail.armed("spillReloadError",
+                       exc=spill.SpillError("injected reload failure"),
+                       times=1):
+        with pytest.raises(spill.SpillError):
+            s.query("select b, count(*), sum(a) from t group by b")
+    assert spill.stats_snapshot()["open_slots"] == 0
+    _read_ok(s)
+
+
 @chaos("admissionQueueFull")
 def _admission_queue_full(tk):
     """Forced queue-full verdict: every pooled statement sheds with the

@@ -435,7 +435,8 @@ def test_slow_query_carries_wait_fields(storage):
 
 EXPECTED_RULES = {"compile-storm", "progcache-hit-rate",
                   "pool-saturation", "cooldown-flapping",
-                  "memory-pressure", "prewarm-starvation"}
+                  "memory-pressure", "spill-pressure",
+                  "prewarm-starvation"}
 
 
 def test_rule_catalogue_fully_covered():
@@ -515,6 +516,28 @@ def test_rule_memory_pressure():
     f = _findings(ring, "memory-pressure")
     assert len(f) == 1 and f[0].severity == "warning"
     assert "8175" in f[0].details
+
+
+def test_rule_spill_pressure():
+    # a window's worth of spilled bytes: the quota is actively bounding
+    # working sets — warning
+    ring = _ring_with({"tinysql_spill_bytes_total":
+                       oinspect.SPILL_PRESSURE_BYTES,
+                       "tinysql_spilled_statements_total": 2})
+    f = _findings(ring, "spill-pressure")
+    assert len(f) == 1 and f[0].severity == "warning"
+    assert f[0].metric == "tinysql_spill_bytes_total"
+    # recursive repartitioning escalates to critical (one
+    # depth-exhaustion from 8175) and supersedes the byte warning
+    ring = _ring_with({"tinysql_spill_bytes_total":
+                       oinspect.SPILL_PRESSURE_BYTES,
+                       "tinysql_spill_repartitions_total": 1})
+    f = _findings(ring, "spill-pressure")
+    assert len(f) == 1 and f[0].severity == "critical"
+    assert "8175" in f[0].details
+    # a sub-threshold trickle is the feature working as designed
+    ring = _ring_with({"tinysql_spill_bytes_total": 1024})
+    assert not _findings(ring, "spill-pressure")
 
 
 def test_rule_prewarm_starvation():

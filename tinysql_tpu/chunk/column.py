@@ -29,9 +29,16 @@ def _np_dtype(et: EvalType):
 
 
 class Column:
-    """A growable typed vector with a null mask."""
+    """A growable typed vector with a null mask.
 
-    __slots__ = ("ft", "_data", "_null", "_len")
+    Memory accounting is PAIRED: every buffer charge remembers the
+    tracker it hit (utils/memory.py), and the charge is released when
+    the buffers are freed (``__del__`` / :meth:`free` / ``truncate(0)``)
+    — so a statement's tracker reports its LIVE working set, long-lived
+    sessions don't monotonically over-report, and spill reloads net out
+    instead of double-counting."""
+
+    __slots__ = ("ft", "_data", "_null", "_len", "_tracker", "_charged")
 
     def __init__(self, ft: FieldType, cap: int = _INIT_CAP):
         self.ft = ft
@@ -40,8 +47,55 @@ class Column:
         self._null = np.zeros(max(cap, 1), dtype=bool)
         self._len = 0
         # per-query memory quota (utils/memory.py): charge the buffer
-        # capacity; no-op without an active tidb_mem_quota_query tracker
-        _memory.consume(self._data.nbytes + self._null.nbytes)
+        # capacity; no-op without an active tracker
+        self._tracker = None
+        self._charged = 0
+        self._charge(self._data.nbytes + self._null.nbytes)
+
+    # ---- quota pairing ------------------------------------------------
+    def _charge(self, n: int) -> None:
+        if n <= 0:
+            return
+        if self._tracker is None:
+            self._tracker = _memory.consume_tracked(n)
+            if self._tracker is not None:
+                self._charged = n
+        else:
+            # later growth charges the column's OWN tracker (the one it
+            # was born under), keeping the charge/release pair balanced
+            # even if the column outlives its statement
+            self._tracker.consume(n)
+            self._charged += n
+
+    def _release_all(self) -> None:
+        if self._tracker is not None and self._charged > 0:
+            self._tracker.release(self._charged)
+        self._charged = 0
+
+    def _adopt_charge(self, other: "Column") -> None:
+        """Take over ``other``'s charge (its buffers became ours): the
+        lazily-materializing subclasses steal the freshly built column's
+        arrays, so the release must move with them."""
+        self._release_all()
+        self._tracker = other._tracker
+        self._charged = other._charged
+        other._tracker = None
+        other._charged = 0
+
+    def free(self) -> None:
+        """Drop the buffers and release their charge now (spill paths
+        call this the moment a partition is written out, instead of
+        waiting for GC)."""
+        self._release_all()
+        self._data = np.zeros(1, dtype=_np_dtype(self.ft.eval_type))
+        self._null = np.zeros(1, dtype=bool)
+        self._len = 0
+
+    def __del__(self):
+        try:
+            self._release_all()
+        except Exception:  # interpreter teardown: modules half-gone
+            pass
 
     # ---- constructors -------------------------------------------------
     @classmethod
@@ -54,7 +108,11 @@ class Column:
         c._null = (np.zeros(n, dtype=bool) if null is None
                    else np.asarray(null, dtype=bool).copy())
         c._len = n
-        _memory.consume(c._data.nbytes + c._null.nbytes)
+        # the cap-1 seed buffers were just replaced: re-pair the charge
+        # against the real materialization
+        c._release_all()
+        c._tracker = None
+        c._charge(c._data.nbytes + c._null.nbytes)
         return c
 
     @classmethod
@@ -92,8 +150,8 @@ class Column:
         if self._len + need <= cap:
             return
         new_cap = max(cap * 2, self._len + need)
-        _memory.consume((new_cap - cap)
-                        * (self._data.itemsize + self._null.itemsize))
+        self._charge((new_cap - cap)
+                     * (self._data.itemsize + self._null.itemsize))
         self._data = np.resize(self._data, new_cap)
         self._null = np.resize(self._null, new_cap)
 
@@ -186,6 +244,11 @@ class Column:
 
     def truncate(self, n: int) -> None:
         self._len = min(self._len, n)
+        if n == 0 and self._data is not None and len(self._data) > _INIT_CAP:
+            # a full reset frees the (possibly large) buffers and returns
+            # their charge — a truncated-then-idle column must not pin a
+            # statement-sized allocation on the session's books
+            self.free()
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"Column({self.ft.type_name()}, {self.datums()[:8]}{'...' if self._len > 8 else ''})"
@@ -212,6 +275,8 @@ class DeviceColumn(Column):
         self._data = None         # host buffers: absent until demanded
         self._null = None
         self._len = n
+        self._tracker = None
+        self._charged = 0
         self._dev_v = dev_v
         self._dev_n = dev_n
         self.sorted_live = False
@@ -230,6 +295,7 @@ class DeviceColumn(Column):
             dt = _np_dtype(self.ft.eval_type)
             self._data = np.ascontiguousarray(v, dtype=dt)
             self._null = np.asarray(m, dtype=bool).copy()
+            self._charge(self._data.nbytes + self._null.nbytes)
 
     def take(self, idx: np.ndarray) -> "Column":
         """Gather on device, land only the gathered rows on host — the
@@ -264,6 +330,8 @@ class LazyTakeColumn(Column):
         self.ft = src.ft
         self._data = None
         self._null = None
+        self._tracker = None
+        self._charged = 0
         self._idx = np.asarray(idx, dtype=np.int64)
         self._len = len(self._idx)
         self._src = src
@@ -274,6 +342,9 @@ class LazyTakeColumn(Column):
             mat._ensure_host()
             self._data = mat._data
             self._null = mat._null
+            # the materialized column's buffers are now OURS: move its
+            # charge here so `mat`'s __del__ doesn't release live bytes
+            self._adopt_charge(mat)
 
     def take(self, idx: np.ndarray) -> "Column":
         if self._data is not None:

@@ -2384,6 +2384,15 @@ class DevPipeExec:
             self._node = None
             self._open_fallback(ctx)
             return
+        if self._spill_pressure(ctx):
+            # memory-adaptive execution (ops/spill.py): the fused device
+            # pipeline holds whole tables resident and has no spill
+            # path — under quota pressure (or spillForceAll) the
+            # statement routes to the per-operator executors, whose
+            # join/agg/sort/topn spill routes bound the working set
+            self._node = None
+            self._open_fallback(ctx)
+            return
         if not _contains_join(self.plan) \
                 and _contains_grouped_agg(self.plan) \
                 and mesh_if_enabled(ctx.session_vars) is not None:
@@ -2403,6 +2412,36 @@ class DevPipeExec:
             self._node = None
         if self._node is None:
             self._open_fallback(ctx)
+
+    def _spill_pressure(self, ctx) -> bool:
+        """Should this statement spill?  Same decision the per-operator
+        tier makes (ops/spill.would_spill — the side-effect-free probe:
+        no spillForceAll fire consumed, no throwaway SpillContext),
+        priced per node with the SAME per-row costs the per-operator
+        gates use (join: both sides × _JOIN_ROW_BYTES; everything else:
+        the nominal pre-drain price) — if any operator under here would
+        run partitioned, the whole pipeline steps aside."""
+        from ..ops import spill
+        from ..utils import memory as _memory
+        from .tpu_executors import _JOIN_ROW_BYTES, _NOMINAL_ROW_BYTES
+
+        def est_of(p) -> float:
+            return float(getattr(p, "stats_row_count", 0.0) or 0.0)
+
+        def max_bytes(p) -> float:
+            if isinstance(p, PhysicalHashJoin) \
+                    and not isinstance(p, PhysicalMergeJoin):
+                # the join gate prices BOTH sides (it materializes both)
+                b = sum(est_of(c) for c in p.children) * _JOIN_ROW_BYTES
+            else:
+                b = est_of(p) * _NOMINAL_ROW_BYTES
+            for c in getattr(p, "children", ()):
+                b = max(b, max_bytes(c))
+            return b
+
+        # would_spill prices est_rows × row_bytes; pass the maximum
+        # node cost as bytes directly
+        return spill.would_spill(_memory.current(), max_bytes(self.plan), 1)
 
     @staticmethod
     def _forced(ctx) -> bool:

@@ -29,25 +29,37 @@ from ..planner.physical import (PhysicalHashAgg, PhysicalHashJoin,
 from .executors import Executor, build_executor
 
 
-def _drain_chunk(ex: Executor, fields) -> Chunk:
-    first = ex.next()
-    if first is None:
-        return Chunk(fields, cap=MAX_CHUNK_SIZE)
-    nxt = ex.next()
-    if nxt is None:
-        # single-chunk children (every device-tier operator) hand their
-        # output over without a copy — this also keeps DeviceColumn
-        # (late-materialization) chunks resident on device
-        return first.compact()
-    out = Chunk(fields, cap=MAX_CHUNK_SIZE)
-    out.append_chunk(first)
-    out.append_chunk(nxt)
-    while True:
-        chk = ex.next()
-        if chk is None:
-            break
-        out.append_chunk(chk)
-    return out
+def _drain_chunk(ex: Executor, fields, soft: bool = False) -> Chunk:
+    """``soft=True`` (spill-mode callers): the whole drain — child
+    per-chunk allocations AND the accumulator growth — charges through
+    the tracker's soft path (utils/memory.soft_scope).  A spill-mode
+    operator's input materialization inherently overshoots the quota on
+    a cold scan (no replica to serve zero-copy views); the partitioner
+    takes the accumulated chunk over and releases it immediately after,
+    and any nested operator inside the drained subtree sees the same
+    watermark-crossed tracker, so its own spill gate fires.  Hard
+    enforcement resumes at the first charge outside the scope."""
+    from ..utils import memory as _memory
+    from contextlib import nullcontext
+    with (_memory.soft_scope() if soft else nullcontext()):
+        first = ex.next()
+        if first is None:
+            return Chunk(fields, cap=MAX_CHUNK_SIZE)
+        nxt = ex.next()
+        if nxt is None:
+            # single-chunk children (every device-tier operator) hand
+            # their output over without a copy — this also keeps
+            # DeviceColumn (late-materialization) chunks on device
+            return first.compact()
+        out = Chunk(fields, cap=MAX_CHUNK_SIZE)
+        out.append_chunk(first)
+        out.append_chunk(nxt)
+        while True:
+            chk = ex.next()
+            if chk is None:
+                break
+            out.append_chunk(chk)
+        return out
 
 
 def _block_budget(session_vars) -> int:
@@ -57,6 +69,65 @@ def _block_budget(session_vars) -> int:
         return int(session_vars.get("tidb_device_block_rows", 0) or 0)
     except Exception:
         return 0
+
+
+def _spill_run_rows(sctx, n: int, row_bytes: int) -> int:
+    """Run length for the external sort/top-k: what the resident budget
+    holds, floored (tiny budgets must not devolve into per-row runs) and
+    — under spillForceAll with no real quota — capped so small inputs
+    still produce multiple runs for the store to prove itself on."""
+    rows = int(sctx.budget // max(row_bytes, 1))
+    if sctx.spill_all:
+        rows = min(rows, max(n // 4, 256))
+    return max(min(rows, n), 256)
+
+
+def _est_rows_of(plan_child) -> float:
+    return float(getattr(plan_child, "stats_row_count", 0.0) or 0.0)
+
+
+def _maybe_spill_ctx(ctx, est_rows: float, actual_rows: int,
+                     row_bytes: int, label: str):
+    """Memory-adaptive execution gate shared by join/agg/sort/topn: a
+    live ops/spill.SpillContext when this operator should run its
+    partitioned spill path (spillForceAll, watermark crossed, or the
+    planner's estRows pricing the operator's materialization over the
+    watermark headroom), else None.  Partition-count choice rides the
+    PLANNER estimate — the statement decides its fan-out before
+    materializing — with the actual row count as the no-stats
+    fallback."""
+    from ..ops import spill
+    from ..utils import memory as _memory
+    if est_rows <= 0:
+        est_rows = float(actual_rows)
+    return spill.maybe_context(ctx.session_vars, _memory.current(),
+                               max(est_rows, float(actual_rows)),
+                               row_bytes, label)
+
+
+#: per-row pricing for the join's proactive estRows trigger: what a row
+#: of the charged working set costs (a compacted side is ~4 numeric
+#: columns + null masks); agg and sort price their own rows from the
+#: actual argument/key layout
+_JOIN_ROW_BYTES = 36
+
+#: nominal per-row pricing for the sort/topn PRE-drain softness check —
+#: the exact key layout isn't known until after materialization, so the
+#: would-this-spill probe prices one 8-byte key + null + rowid
+_NOMINAL_ROW_BYTES = 17
+
+
+def _would_spill_here(ctx, plan) -> bool:
+    """Side-effect-free pre-drain probe for sort/topn: the real spill
+    gate runs after materialization (it needs the actual key layout), but
+    the drain's accumulator copies must already charge soft when the gate
+    is going to say yes — otherwise a cold scan bigger than the quota
+    dies before the external sort can spill a single run."""
+    from ..ops import spill
+    from ..utils import memory as _memory
+    return spill.would_spill(_memory.current(),
+                             _est_rows_of(plan.children[0]),
+                             _NOMINAL_ROW_BYTES)
 
 
 def _mask_compact_threshold() -> float:
@@ -287,17 +358,24 @@ def _compact_if_selective(chk: Chunk, mask):
     return chk, mask
 
 
-def _child_input(ex: Executor) -> Chunk:
+def _child_input(ex: Executor, soft: bool = False) -> Chunk:
     """Materialize a child's full output: TableReaders on the columnar
     replica hand over zero-copy column views (filters applied by selection
-    compaction) instead of slicing + re-appending chunk by chunk."""
+    compaction) instead of slicing + re-appending chunk by chunk.
+    ``soft=True``: spill-mode caller — the accumulation/compaction copies
+    are soft-charged (see :func:`_drain_chunk`)."""
     chk, mask, _rep = _take_replica_masked(ex)
     if chk is not None:
         if mask is not None:
             chk.set_sel(np.nonzero(mask)[0])
             chk = chk.compact()
         return chk
-    return _drain_chunk(ex, ex.field_types()).compact()
+    out = _drain_chunk(ex, ex.field_types(), soft=soft)
+    if soft:
+        from ..utils import memory as _memory
+        with _memory.soft_scope():
+            return out.compact()
+    return out.compact()
 
 
 def _count_mask_program(slot: int):
@@ -360,18 +438,21 @@ class TPUHashAggExec(Executor):
         super().open(ctx)
         self._done = False
 
-    def _raw_replica_input(self):
+    def _raw_replica_input(self, compact: bool = True):
         """Fused fast path: the child is a TableReader serving from the
         columnar replica — take the FULL table as a zero-copy chunk view
         and turn the scan filters into a device-side valid mask, skipping
         chunk slicing, host compaction, and append copies entirely (the
-        filter+aggregate fusion XLA is built for)."""
+        filter+aggregate fusion XLA is built for).  ``compact=False``
+        (spill mode) keeps even selective filters as masks: the charged
+        compaction copy is exactly the working set the quota is trying
+        to bound, and the partitioned path selects live rows itself."""
         chk, mask, _rep = _take_replica_masked(self.children[0])
         if chk is None:
             return None, None
         # low-selectivity GROUPED aggregates sort faster over a compacted
         # input; scalar aggregates never sort, so they keep the fused mask
-        if self.plan.group_by:
+        if self.plan.group_by and compact:
             chk, mask = _compact_if_selective(chk, mask)
         return chk, mask
 
@@ -922,14 +1003,33 @@ class TPUHashAggExec(Executor):
             return None
         self._done = True
         plan = self.plan
-        fused = self._try_fused_device()
-        if fused is not None:
-            return fused
-        chk, filter_mask = self._raw_replica_input()
+        # memory-adaptive aggregation: under spill pressure the fused
+        # whole-table paths step aside and the generic path below runs
+        # its partitioned spill route (grouped aggregates only — scalar
+        # aggregate state is O(1) and never worth spilling)
+        sctx = None
+        if plan.group_by:
+            # per-row partition payload: gid + rid + each arg's
+            # (value, null) pair
+            row_bytes = 16 + sum(9 for _ in plan.aggs) * 2
+            sctx = _maybe_spill_ctx(self.ctx,
+                                    _est_rows_of(plan.children[0]), 0,
+                                    row_bytes, "agg")
+        if sctx is None:
+            fused = self._try_fused_device()
+            if fused is not None:
+                return fused
+        chk, filter_mask = self._raw_replica_input(compact=sctx is None)
         if chk is None:
+            soft = sctx is not None
             chk = _drain_chunk(self.children[0],
-                               self.children[0].field_types())
-            chk = chk.compact()
+                               self.children[0].field_types(), soft=soft)
+            if soft:
+                from ..utils import memory as _memory
+                with _memory.soft_scope():
+                    chk = chk.compact()
+            else:
+                chk = chk.compact()
         n = chk.full_rows()
 
         # ---- keys (dictionary-encode strings) -------------------------
@@ -1015,7 +1115,8 @@ class TPUHashAggExec(Executor):
                 raise ValueError(d.name)
 
         if not plan.group_by:
-            # global aggregate: sort-free masked reductions
+            # global aggregate: sort-free masked reductions (sctx is
+            # only ever opened under plan.group_by)
             out_keys = []
             out_aggs, first_orig = kernels.scalar_aggregate(
                 specs, arg_cols, n, filter_mask=filter_mask)
@@ -1024,10 +1125,30 @@ class TPUHashAggExec(Executor):
             if seg is not None:
                 # known small cardinality: sort-free segment reductions
                 gid, cards, bases, n_segments = seg
-                present, out_aggs, first_orig = \
-                    kernels.segment_group_aggregate(
-                        gid, n_segments, specs, arg_cols, n,
-                        filter_mask=filter_mask)
+                if sctx is None:
+                    # reactive re-check: materializing the input above
+                    # may have crossed the watermark after the early
+                    # (pre-materialization) decision said no
+                    sctx = _maybe_spill_ctx(
+                        self.ctx, _est_rows_of(plan.children[0]), n,
+                        16 + 18 * len(arg_cols), "agg")
+                if sctx is not None:
+                    # partitioned partial aggregation: groups hash to
+                    # partitions whole, partials merge at drain —
+                    # per-group accumulation order (and float sums) are
+                    # exactly the unpartitioned kernel's
+                    from ..ops import spill
+                    with sctx:
+                        present, out_aggs, first_orig = \
+                            spill.partitioned_segment_aggregate(
+                                sctx, gid, n_segments, specs, arg_cols,
+                                n, filter_mask=filter_mask)
+                    sctx = None
+                else:
+                    present, out_aggs, first_orig = \
+                        kernels.segment_group_aggregate(
+                            gid, n_segments, specs, arg_cols, n,
+                            filter_mask=filter_mask)
                 out_keys = []
                 strides = []
                 s = 1
@@ -1041,6 +1162,10 @@ class TPUHashAggExec(Executor):
                     vals = np.where(is_null, 0, code + base)
                     out_keys.append((vals.astype(np.int64), is_null))
             else:
+                # sort-based grouping (float keys / huge cardinality):
+                # no partitioned route — release the unused spill scope
+                if sctx is not None:
+                    sctx.close()
                 out_keys, out_aggs, first_orig = kernels.group_aggregate(
                     key_cols, specs, arg_cols, n, filter_mask=filter_mask)
         return self._assemble_output(chk, plan, slots, out_keys, out_aggs,
@@ -1306,20 +1431,33 @@ class TPUHashJoinExec(Executor):
         super().open(ctx)
         self._done = False
 
-    def _side_input(self, i: int, side_conds):
+    def _side_input(self, i: int, side_conds, compact: bool = True):
         """(chunk, mask, replica): replica-backed readers keep RAW rows
         with scan and side filters folded into a mask; other children
-        materialize compacted with side conds applied."""
+        materialize compacted with side conds applied.  ``compact=False``
+        (spill mode) keeps selective filters as masks — the partitioned
+        match takes validity masks directly, and the compaction copy is
+        charged working set the quota is trying to bound."""
         ex = self.children[i]
         chk, mask, rep = _take_replica_masked(ex, side_conds)
         if chk is not None:
-            chk, mask = _compact_if_selective(chk, mask)
+            if compact:
+                chk, mask = _compact_if_selective(chk, mask)
             return chk, mask, (rep if mask is not None else None)
-        chk = _child_input(ex)
+        # compact=False == spill mode: this materialization is the very
+        # transient the partitioner is about to take over, so its copies
+        # charge soft (a cold scan larger than the quota must not die
+        # before the spill layer sees a single row)
+        chk = _child_input(ex, soft=not compact)
         if side_conds:
             m = vectorized_filter(side_conds, chk)
             chk.set_sel(np.nonzero(m)[0])
-            chk = chk.compact()
+            if compact:
+                chk = chk.compact()
+            else:
+                from ..utils import memory as _memory
+                with _memory.soft_scope():
+                    chk = chk.compact()
         return chk, None, None
 
     def next(self) -> Optional[Chunk]:
@@ -1334,20 +1472,38 @@ class TPUHashJoinExec(Executor):
         # key matches nothing, and the outer path emits unmatched valid
         # rows once with right index -1.
         on_left = plan.left_conditions if outer else []
-        lchk, lmask, lrep = self._side_input(
-            0, [] if on_left else plan.left_conditions)
-        rchk, rmask, rrep = self._side_input(1, plan.right_conditions)
         right_unique = getattr(plan, "right_unique", False)
         left_unique = getattr(plan, "left_unique", False)
+        probe_side = 1 if (left_unique and plan.tp == "inner"
+                           and not right_unique) else 0
+        # memory-adaptive spill decision BEFORE materializing the sides:
+        # in spill mode selective filters stay masks over zero-copy
+        # replica views instead of charged compaction copies.  The
+        # estimate prices BOTH sides (the join materializes both)
+        est = _est_rows_of(plan.children[0]) + _est_rows_of(
+            plan.children[1])
+        sctx = _maybe_spill_ctx(self.ctx, est, 0, _JOIN_ROW_BYTES,
+                                "join")
+        lchk, lmask, lrep = self._side_input(
+            0, [] if on_left else plan.left_conditions,
+            compact=sctx is None)
+        rchk, rmask, rrep = self._side_input(
+            1, plan.right_conditions, compact=sctx is None)
+        if sctx is None:
+            # reactive re-check: materialization may have crossed the
+            # watermark the early (estimate-driven) decision missed
+            sctx = _maybe_spill_ctx(
+                self.ctx, est,
+                lchk.full_rows() + rchk.full_rows(),
+                _JOIN_ROW_BYTES, "join")
         # block-wise probe streaming (SURVEY §5.7; VERDICT r4 next-3):
         # when the PROBE side exceeds tidb_device_block_rows, its key
         # column uploads transiently per block against the resident build
         # structure — the table never becomes fully device-resident
-        probe_side = 1 if (left_unique and plan.tp == "inner"
-                           and not right_unique) else 0
         budget = _block_budget(self.ctx.session_vars)
         probe_chk = lchk if probe_side == 0 else rchk
-        stream = budget > 0 and probe_chk.full_rows() > budget
+        stream = (budget > 0 and probe_chk.full_rows() > budget
+                  and sctx is None)
 
         # every join branch has a numpy twin on the CPU backend
         # (kernels.host_kernels_ok honors TINYSQL_DEVICE_JOIN_ONLY):
@@ -1456,7 +1612,17 @@ class TPUHashJoinExec(Executor):
                 return z, z
             return np.concatenate(pis), np.concatenate(bis)
 
-        if right_unique:
+        # memory-adaptive hybrid hash join (ops/spill.py): under quota
+        # pressure (or spillForceAll) the build side partitions by key
+        # hash with cold partitions in the host spill store; probe rows
+        # route to their partition; overflowing partitions recursively
+        # repartition.  Output order is the unpartitioned kernels' exact
+        # contract, so the branch is transparent to everything above.
+        if sctx is not None:
+            li, ri = self._spill_join(
+                sctx, (lk, lnull), (rk, rnull), lchk, rchk, lmask, rmask,
+                probe_side, right_unique, left_unique, outer)
+        elif right_unique:
             # unique build side: expansion-free probe, no size sync
             bs = self._sorted_build(plan.right_keys[0], rchk)
             if stream:
@@ -1550,6 +1716,39 @@ class TPUHashJoinExec(Executor):
         return keep
 
 
+    def _spill_join(self, sctx, lpair, rpair, lchk, rchk, lmask, rmask,
+                    probe_side: int, right_unique: bool,
+                    left_unique: bool, outer: bool):
+        """Partitioned spill-mode matching: host key arrays (device-
+        resident replica keys land once — np.asarray — instead of
+        living whole on device), per-partition match through the
+        UNCHANGED kernel entry points (the compiled programs and their
+        progcache entries are shared with the unpartitioned path)."""
+        from ..ops import spill
+        lk = np.asarray(lpair[0])
+        lnull = np.asarray(lpair[1], dtype=bool)
+        rk = np.asarray(rpair[0])
+        rnull = np.asarray(rpair[1], dtype=bool)
+        unique_build = right_unique if probe_side == 0 else left_unique
+
+        def match(pp, n_p, bp, n_b):
+            if unique_build:
+                return kernels.unique_join_match(pp, n_p, bp, n_b,
+                                                 outer=False)
+            return kernels.join_match(pp, n_p, bp, n_b, outer=False)
+
+        with sctx:
+            if probe_side == 0:
+                return spill.partitioned_join(
+                    sctx, (lk, lnull), lchk.full_rows(),
+                    (rk, rnull), rchk.full_rows(), match, outer=outer,
+                    probe_valid=lmask, build_valid=rmask)
+            ri, li = spill.partitioned_join(
+                sctx, (rk, rnull), rchk.full_rows(),
+                (lk, lnull), lchk.full_rows(), match, outer=False,
+                probe_valid=rmask, build_valid=lmask)
+            return li, ri
+
     @staticmethod
     def _sorted_build(key_expr, chk) -> bool:
         """True when the build key column provably ascends among live
@@ -1616,7 +1815,8 @@ class TPUSortExec(Executor):
 
     def next(self) -> Optional[Chunk]:
         if self._out is None:
-            chk = _child_input(self.children[0])
+            chk = _child_input(self.children[0],
+                               soft=_would_spill_here(self.ctx, self.plan))
             n = chk.num_rows()
             if n == 0:
                 self._out = iter([])
@@ -1624,8 +1824,27 @@ class TPUSortExec(Executor):
                 keys = [(_encode_key(e, chk)[:2]) for e, _ in self.plan.by]
                 keys = [(v, m) for v, m in keys]
                 descs = [d for _, d in self.plan.by]
+                row_bytes = sum(np.asarray(v).dtype.itemsize + 1
+                                for v, _ in keys) + 8
+                sctx = _maybe_spill_ctx(
+                    self.ctx, _est_rows_of(self.plan.children[0]), n,
+                    row_bytes, "sort")
+                if sctx is not None and \
+                        _spill_run_rows(sctx, n, row_bytes) >= n:
+                    # the whole key set fits one run: an external sort
+                    # would just write-and-reload a single run file
+                    sctx.close()
+                    sctx = None
                 budget = _block_budget(self.ctx.session_vars)
-                if budget > 0 and n > budget:
+                if sctx is not None:
+                    # external sort: spilled sorted runs + k-way merge
+                    # (exact full-lexsort permutation; ops/spill.py)
+                    from ..ops import spill
+                    with sctx:
+                        perm = spill.external_sort_permutation(
+                            sctx, keys, descs, n,
+                            _spill_run_rows(sctx, n, row_bytes))
+                elif budget > 0 and n > budget:
                     # above the device budget a full ORDER BY sorts on
                     # host (same semantics): whole-key residency would
                     # violate tidb_device_block_rows
@@ -1649,7 +1868,8 @@ class TPUTopNExec(Executor):
 
     def next(self) -> Optional[Chunk]:
         if self._out is None:
-            chk = _child_input(self.children[0])
+            chk = _child_input(self.children[0],
+                               soft=_would_spill_here(self.ctx, self.plan))
             n = chk.num_rows()
             if n == 0:
                 self._out = iter([])
@@ -1657,8 +1877,26 @@ class TPUTopNExec(Executor):
                 keys = [(_encode_key(e, chk)[:2]) for e, _ in self.plan.by]
                 descs = [d for _, d in self.plan.by]
                 k = self.plan.offset + self.plan.count
+                row_bytes = sum(np.asarray(v).dtype.itemsize + 1
+                                for v, _ in keys) + 8
+                sctx = _maybe_spill_ctx(
+                    self.ctx, _est_rows_of(self.plan.children[0]), n,
+                    row_bytes, "topn")
+                if sctx is not None and \
+                        _spill_run_rows(sctx, n, row_bytes) >= n:
+                    # single-run input: nothing to carry between runs
+                    sctx.close()
+                    sctx = None
                 budget = _block_budget(self.ctx.session_vars)
-                if budget > 0 and n > budget:
+                if sctx is not None:
+                    # run-file top-k: the candidate carry lives in the
+                    # spill store between runs (ops/spill.py)
+                    from ..ops import spill
+                    with sctx:
+                        perm = spill.external_topk(
+                            sctx, keys, descs, n, k,
+                            _spill_run_rows(sctx, n, row_bytes))
+                elif budget > 0 and n > budget:
                     perm = self._blockwise_topk(keys, descs, n, k, budget)
                 else:
                     perm = kernels.top_k(keys, descs, n, k)

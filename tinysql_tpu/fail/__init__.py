@@ -40,8 +40,8 @@ from typing import Any, Dict, Optional
 
 __all__ = [
     "Injected", "Panic", "register", "catalogue", "arm", "disarm",
-    "disarm_all", "armed", "inject", "eval_point", "hits", "reset_hits",
-    "configure", "parse_spec",
+    "disarm_all", "armed", "inject", "eval_point", "is_armed", "hits",
+    "reset_hits", "configure", "parse_spec",
 ]
 
 
@@ -208,6 +208,20 @@ def eval_point(name: str) -> Any:
         time.sleep(act.value)  # qlint: disable=FP501 -- the sleep ACTION is the injected fault itself, not a retry path
         return True
     return act.value
+
+
+def is_armed(name: str) -> bool:
+    """Side-effect-free probe: is ``name`` currently armed with fires
+    remaining?  Unlike :func:`eval_point` it consumes nothing from a
+    counted (``N*``) arming, bumps no hit counters, and never
+    raises/sleeps — for decision probes that must not perturb the
+    arming they observe."""
+    if not _ACTIVE and _ENV_LOADED:
+        return False
+    _load_env_once()
+    with _mu:
+        act = _ACTIVE.get(name)
+        return act is not None and act.times != 0
 
 
 def _fresh_exc(exc: BaseException) -> BaseException:

@@ -90,6 +90,23 @@ ADMISSION_DELAY = register(
     "entry claimed — the queue builds behind it, queued statements stay "
     "KILLable, the accept loop never hangs (server/pool.py)")
 
+# ---- memory-adaptive spilling (ops/spill.py) -------------------------------
+SPILL_PARTITION_ERROR = register(
+    "spillPartitionError",
+    "spill-store partition write fails — the statement surfaces a typed "
+    "error, no partition files or resident bytes leak (ops/spill.py "
+    "SpillStore.put)")
+SPILL_RELOAD_ERROR = register(
+    "spillReloadError",
+    "spilled-partition reload fails mid-probe/merge — typed error, all "
+    "remaining partitions dropped cleanly (ops/spill.py SpillStore.load)")
+SPILL_FORCE_ALL = register(
+    "spillForceAll",
+    "armed with return(1): every spill-capable operator (hash join, "
+    "hash agg, sort, topn) runs its partitioned spill path regardless "
+    "of tidb_mem_quota_query — the spill==no-spill equivalence and CI "
+    "smoke lever (ops/spill.py maybe_context)")
+
 # ---- executor --------------------------------------------------------------
 EXEC_SLOW_NEXT = register(
     "execSlowNext",

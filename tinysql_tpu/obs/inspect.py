@@ -25,6 +25,10 @@ tests/test_tsring.py):
 - **cooldown-flapping**: repeated device losses within one window keep
   re-pinning planning to CPU (a flapping accelerator, not a blip);
 - **memory-pressure**: statements aborted on tidb_mem_quota_query;
+- **spill-pressure**: statements running memory-adaptive (spilling)
+  execution within the window — the quota is actively constraining the
+  workload (warning), escalating to critical when recursive
+  repartitioning fires (working sets far beyond the budget);
 - **prewarm-starvation**: the auto-prewarm worker left candidates
   unwarmed (budget exhausted / errors) while cold-run-shaped latency
   exists — the cold-start killer is starved.
@@ -59,6 +63,9 @@ POOL_QUEUED_WARN = 8
 #: device losses within one window = flapping (one loss is a blip the
 #: cooldown already absorbs)
 COOLDOWN_FLAP_LOSSES = 2
+#: spilled bytes within the window that make the spill-pressure rule
+#: speak up (a trickle of spilling is the feature working as designed)
+SPILL_PRESSURE_BYTES = 1 << 20
 
 
 class Finding:
@@ -254,6 +261,32 @@ def _rule_memory_pressure(ctx: InspectionContext) -> List[Finding]:
         f"{d:.0f} statement(s) aborted on tidb_mem_quota_query within "
         "the window (error 8175): quotas are actively shedding memory "
         "pressure", metric)]
+
+
+@rule("spill-pressure")
+def _rule_spill_pressure(ctx: InspectionContext) -> List[Finding]:
+    out: List[Finding] = []
+    spilled = ctx.delta("tinysql_spill_bytes_total")
+    stmts = ctx.delta("tinysql_spilled_statements_total")
+    repart = ctx.delta("tinysql_spill_repartitions_total")
+    if repart > 0:
+        out.append(ctx.evidence(
+            "spill-pressure", "repartition", "critical",
+            f"{repart:.0f} recursive repartition event(s) within the "
+            "window: working sets far exceed the spill budget "
+            "(tidb_mem_quota_query x tidb_mem_quota_spill_ratio) — "
+            "statements are one depth-exhaustion away from 8175",
+            "tinysql_spill_repartitions_total"))
+    if not out and spilled >= SPILL_PRESSURE_BYTES:
+        mb = spilled / (1 << 20)
+        out.append(ctx.evidence(
+            "spill-pressure", "spill", "warning",
+            f"{mb:.1f} MiB spilled by {stmts:.0f} statement(s) within "
+            "the window: memory-adaptive execution is actively bounding "
+            "working sets — latency includes spill I/O; raise "
+            "tidb_mem_quota_query if this workload should run resident",
+            "tinysql_spill_bytes_total"))
+    return out
 
 
 @rule("prewarm-starvation")

@@ -95,6 +95,26 @@ METRICS: Dict[str, Tuple[str, str]] = {
                   "cooldown)"),
     "tinysql_mem_quota_exceeded_total":
         ("counter", "Statements aborted by tidb_mem_quota_query"),
+    # memory-adaptive spilling (ops/spill.py)
+    "tinysql_spill_bytes_total":
+        ("counter", "Bytes written to the host spill store (partitions "
+                    "+ sort/top-k run files)"),
+    "tinysql_spill_reload_bytes_total":
+        ("counter", "Spilled bytes read back for probing/merging"),
+    "tinysql_spill_partitions_total":
+        ("counter", "Partitions / run files written to the spill store"),
+    "tinysql_spill_repartitions_total":
+        ("counter", "Recursive repartition events (a partition "
+                    "overflowed its working-set budget)"),
+    "tinysql_spill_stream_runs_total":
+        ("counter", "Streamed partial-aggregation slices (an "
+                    "unsplittable partition merged in budget-sized "
+                    "runs)"),
+    "tinysql_spilled_statements_total":
+        ("counter", "Statements that spilled at least once"),
+    "tinysql_spill_open_slots":
+        ("gauge", "Live spill-store slots (0 between statements — "
+                  "anything else is a leak)"),
     # serving layer (server/admission.py, server/pool.py, ops/batching.py)
     "tinysql_admission_admitted_total":
         ("counter", "Statements that began executing on the statement "
@@ -322,6 +342,29 @@ def render_prometheus() -> str:
              [((), mem.aborts_total())])
     except Exception:
         pass
+    # memory-adaptive spill economics (ops/spill.py STATS)
+    try:
+        from ..ops.spill import stats_snapshot as spill_stats
+        sp = spill_stats()
+    except Exception:
+        sp = {}
+    if sp:
+        for key, name in (("spill_bytes", "tinysql_spill_bytes_total"),
+                          ("spill_reload_bytes",
+                           "tinysql_spill_reload_bytes_total"),
+                          ("spill_partitions",
+                           "tinysql_spill_partitions_total"),
+                          ("spill_repartitions",
+                           "tinysql_spill_repartitions_total"),
+                          ("spill_stream_runs",
+                           "tinysql_spill_stream_runs_total"),
+                          ("spilled_statements",
+                           "tinysql_spilled_statements_total")):
+            emit(name, METRICS[name][1], "counter",
+                 [((), sp.get(key, 0))])
+        emit("tinysql_spill_open_slots",
+             METRICS["tinysql_spill_open_slots"][1], "gauge",
+             [((), sp.get("open_slots", 0))])
 
     # serving-layer counters: admission verdicts (server/admission.py)
     # and cross-query micro-batching (ops/batching.py)

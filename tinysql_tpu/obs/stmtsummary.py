@@ -113,7 +113,8 @@ class StmtRecord:
     __slots__ = ("sql_digest", "digest_text", "plan_digest", "stmt_type",
                  "schema_name", "exec_count", "sum_errors", "sum_ms",
                  "max_ms", "device", "max_mem", "sum_rows", "first_seen",
-                 "last_seen", "sample_sql", "sample_plan", "queued_count")
+                 "last_seen", "sample_sql", "sample_plan", "queued_count",
+                 "max_spill_bytes", "spill_count")
 
     def __init__(self, sql_digest: str, digest_text: str,
                  plan_digest: str):
@@ -134,6 +135,8 @@ class StmtRecord:
         self.sample_sql = ""
         self.sample_plan = ""
         self.queued_count = 0
+        self.max_spill_bytes = 0
+        self.spill_count = 0
 
     def fold(self, *, stmt_type: str, schema_name: str,
              info: Dict[str, float], device: Dict[str, float],
@@ -153,6 +156,13 @@ class StmtRecord:
                 self.max_ms[phase] = ms
         for k, v in device.items():
             self.device[k] = self.device.get(k, 0) + v
+        # memory-adaptive execution: this EXECUTION's spill volume (the
+        # device dict is per-statement, so the max/count fold here)
+        sp = int(device.get("spill_bytes", 0))
+        if sp > 0:
+            self.spill_count += 1
+            if sp > self.max_spill_bytes:
+                self.max_spill_bytes = sp
         if max_mem > self.max_mem:
             self.max_mem = int(max_mem)
         self.sum_rows += int(rows_returned)
@@ -177,6 +187,9 @@ class StmtRecord:
         for k, v in other.device.items():
             self.device[k] = self.device.get(k, 0) + v
         self.max_mem = max(self.max_mem, other.max_mem)
+        self.max_spill_bytes = max(self.max_spill_bytes,
+                                   other.max_spill_bytes)
+        self.spill_count += other.spill_count
         self.sum_rows += other.sum_rows
         if other.first_seen and (not self.first_seen
                                  or other.first_seen < self.first_seen):
@@ -215,6 +228,8 @@ class StmtRecord:
             int(d.get("progcache_misses", 0)),
             int(d.get("pipe_blocks", 0)), self._overlap_frac(),
             int(d.get("coalesced", 0)),
+            int(d.get("spill_bytes", 0)), self.max_spill_bytes,
+            self.spill_count,
             self.max_mem, self.sum_rows,
             _ts(self.first_seen) if self.first_seen else "",
             _ts(self.last_seen) if self.last_seen else "",
@@ -229,6 +244,8 @@ class StmtRecord:
                 "queued_count": self.queued_count,
                 "sum_ms": dict(self.sum_ms), "max_ms": dict(self.max_ms),
                 "device": dict(self.device), "max_mem": self.max_mem,
+                "max_spill_bytes": self.max_spill_bytes,
+                "spill_count": self.spill_count,
                 "rows": self.sum_rows, "sample_sql": self.sample_sql}
 
 
@@ -248,6 +265,8 @@ COLUMNS = [
     ("compile_cache_hits", "int"), ("compile_cache_misses", "int"),
     ("pipe_blocks", "int"), ("pipe_overlap_frac", "real"),
     ("coalesced", "int"),
+    ("sum_spill_bytes", "int"), ("max_spill_bytes", "int"),
+    ("spill_count", "int"),
     ("max_mem_bytes", "int"), ("sum_rows_returned", "int"),
     ("first_seen", "str"), ("last_seen", "str"),
     ("sample_sql", "str"), ("sample_plan", "str"),

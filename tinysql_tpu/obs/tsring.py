@@ -447,6 +447,23 @@ def _src_memory() -> Dict[str, float]:
     return {"tinysql_mem_quota_exceeded_total": mem.aborts_total()}
 
 
+def _src_spill() -> Dict[str, float]:
+    from ..ops.spill import stats_snapshot
+    s = stats_snapshot()
+    return {"tinysql_spill_bytes_total": s.get("spill_bytes", 0),
+            "tinysql_spill_reload_bytes_total":
+                s.get("spill_reload_bytes", 0),
+            "tinysql_spill_partitions_total":
+                s.get("spill_partitions", 0),
+            "tinysql_spill_repartitions_total":
+                s.get("spill_repartitions", 0),
+            "tinysql_spill_stream_runs_total":
+                s.get("spill_stream_runs", 0),
+            "tinysql_spilled_statements_total":
+                s.get("spilled_statements", 0),
+            "tinysql_spill_open_slots": s.get("open_slots", 0)}
+
+
 def _src_degrade() -> Dict[str, float]:
     from ..ops import degrade
     d = degrade.snapshot()
@@ -481,7 +498,7 @@ for _name, _fn in (("queries", _src_queries), ("kernels", _src_kernels),
                    ("progcache", _src_progcache), ("pool", _src_pool),
                    ("admission", _src_admission),
                    ("batching", _src_batching), ("memory", _src_memory),
-                   ("degrade", _src_degrade),
+                   ("spill", _src_spill), ("degrade", _src_degrade),
                    ("failpoints", _src_failpoints),
                    ("prewarm", _src_prewarm), ("tsring", _src_tsring)):
     register_source(_name, _fn)

@@ -1,0 +1,1122 @@
+"""Memory-adaptive spilling execution (PAPERS.md "Design Trade-offs for
+a Robust Dynamic Hybrid Hash Join" / "Partial Partial Aggregates").
+
+The middle ground between "fits in device memory" and "killed with 8175":
+operators that would blow ``tidb_mem_quota_query`` partition their
+working structures by a hash of the key, keep a bounded resident set,
+and write cold partitions to a host-side spill store (disk-backed numpy
+run files), byte-accounted through the statement's MemTracker
+(``consume_soft``/``release`` — the live set *drops* when a partition
+spills).  A partition that still overflows its budget recursively
+repartitions with a fresh hash seed (bounded by ``tidb_spill_max_depth``);
+only exhaustion of that ladder raises the typed 8175.
+
+Four entry points, one skeleton:
+
+- :func:`partitioned_join` — the hybrid hash join: build side hashed
+  into partitions (spilled cold), probe rows routed to their partition,
+  per-partition match through the UNCHANGED kernels (``join_match`` /
+  ``unique_join_match``), results restored to the unpartitioned
+  kernels' exact (li, ri) order;
+- :func:`partitioned_segment_aggregate` — hash agg: rows partitioned by
+  group-id hash (a group lands WHOLLY in one partition, so per-group
+  accumulation order — and thus float sums — is preserved), partial
+  aggregates per partition, disjoint group sets merged at drain;
+- :func:`external_sort_permutation` — sorted run files + a vectorized
+  bounded-fan-in k-way merge tie-broken by original row id,
+  reproducing the full lexsort's exact permutation;
+- :func:`external_topk` — per-run top-k candidates carried THROUGH the
+  store, merged block-by-block (the blockwise-TopN math, run-file
+  edition).
+
+Trigger: :func:`maybe_context` — the ``spillForceAll`` failpoint, the
+tracker's soft watermark (``tidb_mem_quota_spill_ratio`` × quota), or a
+planner-estimate (estRows × row bytes) that already exceeds the
+watermark headroom.
+
+Everything here is observable: module STATS (``tinysql_spill_*`` on
+/metrics and the time-series ring), per-query counters through the obs
+fan-out (statements_summary ``sum/max_spill_bytes``/``spill_count``,
+EXPLAIN ANALYZE device info), and ``spill``-category trace spans for the
+store/reload legs.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import fail
+from ..utils import interrupt
+from ..utils.memory import MemQuotaExceeded, MemTracker
+
+# ---- observable state ------------------------------------------------------
+
+_mu = threading.Lock()
+#: process-cumulative spill economics (rendered on /metrics, sampled into
+#: the time-series ring); ``open_slots`` is a live gauge
+STATS: Dict[str, float] = {
+    "spill_bytes": 0, "spill_reload_bytes": 0, "spill_partitions": 0,
+    "spill_repartitions": 0, "spill_stream_runs": 0,
+    "spilled_statements": 0, "open_slots": 0,
+}
+
+#: default resident budget when spilling is FORCED without a quota
+#: (spillForceAll): small enough that every partition actually spills
+FORCED_BUDGET = 1 << 16
+#: floor for the resident budget derived from a real quota — small: a
+#: tight quota needs the spill layer to hold almost nothing resident
+MIN_BUDGET = 1 << 16
+#: partition-count clamp
+MIN_PARTS, MAX_PARTS = 2, 128
+
+
+def _record(key: str, n: float = 1) -> None:
+    """Bump a STATS key and fan into the per-query obs scope (the same
+    double-entry bookkeeping kernels.stats_add does)."""
+    with _mu:
+        STATS[key] = STATS.get(key, 0) + n
+    try:
+        from ..obs import context as _obs
+        if key == "spill_bytes":
+            q = _obs.current()
+            if q is not None and not q.device_totals().get("spill_bytes"):
+                with _mu:
+                    STATS["spilled_statements"] += 1
+        _obs.record(key, n)
+    except Exception:
+        pass
+
+
+def _gauge(key: str, delta: int) -> None:
+    with _mu:
+        STATS[key] = STATS.get(key, 0) + delta
+
+
+def stats_snapshot() -> Dict[str, float]:
+    with _mu:
+        return dict(STATS)
+
+
+def reset_stats() -> None:
+    """Tests only."""
+    with _mu:
+        for k in STATS:
+            STATS[k] = 0
+
+
+def _span(name: str, **args):
+    from ..obs import context as _obs
+    return _obs.span(name, cat="spill", **args)
+
+
+# ---- hashing ---------------------------------------------------------------
+
+#: per-depth seeds: recursion at depth d rehashes with a different mix,
+#: so a skewed partition redistributes instead of re-colliding
+_SEEDS = (0x9E3779B97F4A7C15, 0xBF58476D1CE4E5B9, 0x94D049BB133111EB,
+          0xD6E8FEB86659FD93, 0xA5A3564A1F871D1F, 0xC2B2AE3D27D4EB4F,
+          0x165667B19E3779F9, 0x27D4EB2F165667C5)
+
+
+def hash_partition(keys: np.ndarray, depth: int, n_parts: int) -> np.ndarray:
+    """Partition ids for ``keys`` (int64 or float64) at recursion level
+    ``depth``: splitmix64-style avalanche over the raw 64-bit pattern.
+    Equal keys always land in the same partition at every depth."""
+    v = np.ascontiguousarray(keys)
+    if v.dtype != np.int64:
+        v = np.ascontiguousarray(v, dtype=np.float64)
+        # -0.0 and 0.0 compare equal but differ bitwise: canonicalize
+        v = np.where(v == 0.0, 0.0, v)
+    u = v.view(np.uint64).copy()
+    with np.errstate(over="ignore"):
+        u += np.uint64(_SEEDS[depth % len(_SEEDS)])
+        u ^= u >> np.uint64(30)
+        u *= np.uint64(0xBF58476D1CE4E5B9)
+        u ^= u >> np.uint64(27)
+        u *= np.uint64(0x94D049BB133111EB)
+        u ^= u >> np.uint64(31)
+    return (u % np.uint64(n_parts)).astype(np.int64)
+
+
+# ---- the spill store -------------------------------------------------------
+
+class SpillError(RuntimeError):
+    """Typed spill-store failure (a failed partition write/reload is an
+    I/O-layer statement error, not an engine bug)."""
+    mysql_code = 1105
+    sqlstate = "HY000"
+
+
+class SpillSlot:
+    """One spilled partition / run: a set of .npy files on disk."""
+
+    __slots__ = ("seq", "paths", "nbytes", "rows")
+
+    def __init__(self, seq: int, paths: Dict[str, str], nbytes: int,
+                 rows: int):
+        self.seq = seq
+        self.paths = paths
+        self.nbytes = nbytes
+        self.rows = rows
+
+
+class SpillStore:
+    """Disk-backed partition store: one temp directory per store, one
+    ``.npy`` file per array (memmap-able for the sort merge).  ``close``
+    removes everything; the module-level ``open_slots`` gauge proves no
+    partition leaks across statements (the chaos suite checks it)."""
+
+    def __init__(self, tag: str = "op"):
+        self._tag = tag
+        self._dir: Optional[str] = None
+        self._seq = 0
+        self._live = 0
+        self._closed = False
+
+    def _ensure_dir(self) -> str:
+        if self._dir is None:
+            base = os.environ.get("TINYSQL_SPILL_DIR") or None
+            self._dir = tempfile.mkdtemp(prefix=f"tinysql-spill-{self._tag}-",
+                                         dir=base)
+        return self._dir
+
+    def put(self, arrays: Dict[str, np.ndarray], rows: int) -> SpillSlot:
+        fail.inject("spillPartitionError")
+        if self._closed:
+            raise SpillError("spill store already closed")
+        d = self._ensure_dir()
+        seq = self._seq
+        self._seq += 1
+        paths = {}
+        nbytes = 0
+        try:
+            for name, arr in arrays.items():
+                p = os.path.join(d, f"s{seq}.{name}.npy")
+                np.save(p, np.ascontiguousarray(arr))
+                paths[name] = p
+                nbytes += arr.nbytes
+        except OSError as e:
+            for p in paths.values():
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            raise SpillError(f"partition write failed: {e}") from e
+        self._live += 1
+        _gauge("open_slots", 1)
+        return SpillSlot(seq, paths, nbytes, rows)
+
+    def load(self, slot: SpillSlot, mmap: bool = False) \
+            -> Dict[str, np.ndarray]:
+        fail.inject("spillReloadError")
+        try:
+            mode = "r" if mmap else None
+            return {name: np.load(p, mmap_mode=mode)
+                    for name, p in slot.paths.items()}
+        except OSError as e:
+            raise SpillError(f"partition reload failed: {e}") from e
+
+    def free(self, slot: SpillSlot) -> None:
+        for p in slot.paths.values():
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        if slot.paths:
+            slot.paths = {}
+            self._live -= 1
+            _gauge("open_slots", -1)
+
+    def live_slots(self) -> int:
+        return self._live
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._live:
+            _gauge("open_slots", -self._live)
+            self._live = 0
+        if self._dir is not None:
+            shutil.rmtree(self._dir, ignore_errors=True)
+            self._dir = None
+
+    def __del__(self):  # backstop; operators close() explicitly
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---- the spill context -----------------------------------------------------
+
+class SpillContext:
+    """Per-operator spill scope: budget, partition fan-out, recursion
+    bound, the store, and the tracker the partition residency charges
+    through.  Create via :func:`maybe_context`; always ``close()``."""
+
+    def __init__(self, tracker: Optional[MemTracker], n_parts: int,
+                 max_depth: int, budget: int, spill_all: bool,
+                 enforce: bool, label: str = "op"):
+        self.tracker = tracker
+        self.n_parts = max(int(n_parts), MIN_PARTS)
+        self.max_depth = max(int(max_depth), 0)
+        #: resident-partition byte budget; with ``enforce``, a single
+        #: partition above this repartitions (or, at depth exhaustion,
+        #: aborts typed)
+        self.budget = max(int(budget), 1)
+        #: spillForceAll: write EVERY partition through the store
+        self.spill_all = spill_all
+        #: True only under a real tidb_mem_quota_query: budget overflow
+        #: recursion/abort applies.  Forced spilling WITHOUT a quota
+        #: must degrade gracefully on any data, never abort.
+        self.enforce = enforce
+        self.label = label
+        self.store = SpillStore(tag=label)
+        #: resident partitions, evictable on demand: the tracker's
+        #: pressure callback (fired when a chunk allocation crosses the
+        #: watermark or would cross the hard quota) spills them, so
+        #: ordinary allocations see the freed bytes instead of 8175
+        self._resident: List["_Partition"] = []
+        self._closed = False
+        if tracker is not None:
+            tracker.on_pressure(self._evict_resident)
+            # while this context lives, the tracker's hard abort defers
+            # to THIS layer (overflow() at repartition exhaustion owns
+            # the typed 8175); mark_used() makes the deferral sticky
+            # once a route actually runs — see MemTracker.spill_enter
+            tracker.spill_enter()
+
+    def _evict_resident(self) -> None:
+        for part in list(self._resident):
+            try:
+                part.spill(self)
+            except Exception:
+                # eviction is best-effort; the hard-quota re-check still
+                # enforces the budget if nothing could move
+                break
+
+    def note_resident(self, part: "_Partition") -> None:
+        self._resident.append(part)
+
+    def note_gone(self, part: "_Partition") -> None:
+        try:
+            self._resident.remove(part)
+        except ValueError:
+            pass
+
+    # -- accounting helpers --------------------------------------------------
+    def charge(self, n: int) -> None:
+        if self.tracker is not None:
+            self.tracker.consume_soft(n)
+
+    def release(self, n: int) -> None:
+        if self.tracker is not None:
+            self.tracker.release(n)
+
+    def spilled(self, nbytes: int) -> None:
+        _record("spill_partitions")
+        _record("spill_bytes", nbytes)
+
+    def reloaded(self, nbytes: int) -> None:
+        _record("spill_reload_bytes", nbytes)
+
+    def repartitioned(self) -> None:
+        _record("spill_repartitions")
+
+    def fits(self, nbytes: int) -> bool:
+        """Can a partition of ``nbytes`` be loaded resident for
+        processing?  The soft budget is the residency TARGET; the
+        tracker's hard-quota headroom is the true bound — a partition
+        that fits in the remaining quota processes in one piece (after
+        evicting the resident set to make room), recursion is for
+        partitions that genuinely cannot.  A one-group aggregation
+        partition can never split by rehashing, but its output state is
+        tiny: as long as its rows fit the quota it must aggregate, not
+        die."""
+        if nbytes <= self.budget:
+            return True
+        t = self.tracker
+        if t is None or t.quota <= 0:
+            return False
+        if nbytes > t.quota - t.consumed:
+            self._evict_resident()
+        return nbytes <= t.quota - t.consumed
+
+    def overflow(self, nbytes: int) -> MemQuotaExceeded:
+        """The true last resort: recursive repartition exhausted and the
+        partition still exceeds the working-set budget."""
+        quota = self.tracker.quota if self.tracker is not None else 0
+        return MemQuotaExceeded(
+            nbytes, quota,
+            detail=f"spill partition of {nbytes} bytes still exceeds the "
+                   f"{self.budget}-byte working-set budget after "
+                   f"{self.max_depth} recursive repartition level(s)")
+
+    def mark_used(self) -> None:
+        """Route entry: output assembly over the route's soft-charged
+        staging outlives this context, so the abort deferral must
+        survive close() — but ONLY when a route really ran (a context
+        opened then closed unused restores hard enforcement)."""
+        if self.tracker is not None:
+            self.tracker.spill_engage()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.tracker is not None:
+            self.tracker.remove_pressure(self._evict_resident)
+            self.tracker.spill_exit()
+        self._resident.clear()
+        self.store.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def force_all_armed() -> bool:
+    """Is the ``spillForceAll`` failpoint armed?  (A ``return`` action:
+    evaluating it consumes one fire.)"""
+    try:
+        return bool(fail.eval_point("spillForceAll"))
+    except Exception:
+        return False
+
+
+def _quota_wants_spill(tracker: Optional[MemTracker],
+                       est_bytes: int) -> bool:
+    """The tracker side of the spill decision: the soft watermark has
+    been crossed (reactive), or the planner's estimate prices the
+    working set above the watermark headroom (proactive)."""
+    if tracker is None or tracker.quota <= 0:
+        return False
+    return tracker.spill_requested() or (
+        tracker.spill_watermark > 0 and est_bytes > tracker.headroom())
+
+
+def would_spill(tracker: Optional[MemTracker], est_rows: float,
+                row_bytes: int) -> bool:
+    """:func:`maybe_context`'s yes/no, side-effect-free: no
+    ``spillForceAll`` fire consumed (``fail.is_armed``, not eval — a
+    counted ``N*`` arming stays intact for the operator gates), no
+    SpillContext or store built.  For probes (the devpipe pipeline's
+    step-aside decision) that only need the answer."""
+    if fail.is_armed("spillForceAll"):
+        return True
+    est_bytes = int(max(est_rows, 0) * max(row_bytes, 1))
+    return _quota_wants_spill(tracker, est_bytes)
+
+
+def _sysvar_int(session_vars, name: str, default: int) -> int:
+    try:
+        v = session_vars.get(name, default)
+        return int(v) if v is not None else default
+    except (TypeError, ValueError):
+        return default
+
+
+def choose_partitions(est_bytes: int, budget: int,
+                      override: int = 0) -> int:
+    """Partition fan-out: enough that an average partition fits well
+    inside the resident budget (×4 headroom for skew), power-of-two,
+    clamped to [MIN_PARTS, MAX_PARTS].  ``override`` pins it
+    (tidb_spill_partitions)."""
+    if override > 0:
+        p = override
+    else:
+        target = max(budget // 4, 1)
+        p = -(-max(est_bytes, 1) // target)  # ceil div
+    np2 = 1
+    while np2 < p:
+        np2 <<= 1
+    return min(max(np2, MIN_PARTS), MAX_PARTS)
+
+
+def maybe_context(session_vars, tracker: Optional[MemTracker],
+                  est_rows: float, row_bytes: int,
+                  label: str) -> Optional[SpillContext]:
+    """The ONE spill-mode decision all operators share.  Returns a live
+    SpillContext (caller must close) when the operator should run its
+    partitioned path, else None:
+
+    - ``spillForceAll`` armed — always (chaos / CI / bench proofs);
+    - the statement's tracker already crossed its soft watermark
+      (``tidb_mem_quota_spill_ratio`` × quota) — reactive;
+    - the planner's row estimate prices the operator's working set above
+      the watermark headroom — proactive (the working structures this
+      layer manages are mostly NOT chunk-tracked, so waiting for the
+      watermark alone would miss them)."""
+    forced = force_all_armed()
+    est_bytes = int(max(est_rows, 0) * max(row_bytes, 1))
+    budget = 0
+    if tracker is not None and tracker.quota > 0:
+        wm = tracker.spill_watermark or tracker.quota
+        # resident budget: watermark headroom, but never more than half
+        # the HARD-quota slack — the spill layer's own residency must
+        # leave room for the operator's unavoidable chunk allocations
+        slack = (tracker.quota - tracker.consumed) // 2
+        budget = max(min(wm - tracker.consumed, slack), MIN_BUDGET)
+    want = forced or _quota_wants_spill(tracker, est_bytes)
+    if not want:
+        return None
+    if budget <= 0:
+        budget = FORCED_BUDGET
+    n_parts = choose_partitions(
+        est_bytes, budget,
+        override=_sysvar_int(session_vars, "tidb_spill_partitions", 0))
+    max_depth = _sysvar_int(session_vars, "tidb_spill_max_depth", 3)
+    return SpillContext(tracker, n_parts, max_depth, budget,
+                        spill_all=forced,
+                        enforce=tracker is not None and tracker.quota > 0,
+                        label=label)
+
+
+# ---- shared partition machinery -------------------------------------------
+
+def _split(part_ids: np.ndarray, n_parts: int) -> List[np.ndarray]:
+    """Row selections per partition, original order preserved within
+    each (a stable grouped argsort, one pass)."""
+    order = np.argsort(part_ids, kind="stable")
+    counts = np.bincount(part_ids, minlength=n_parts)
+    bounds = np.concatenate(([0], np.cumsum(counts)))
+    return [order[bounds[p]:bounds[p + 1]] for p in range(n_parts)]
+
+
+def _arrays_bytes(arrays: Dict[str, np.ndarray]) -> int:
+    return sum(a.nbytes for a in arrays.values())
+
+
+class _Partition:
+    """One build-side partition: resident (charged) or spilled (a
+    slot)."""
+
+    __slots__ = ("arrays", "slot", "nbytes", "rows")
+
+    def __init__(self, arrays: Dict[str, np.ndarray], rows: int):
+        self.arrays: Optional[Dict[str, np.ndarray]] = arrays
+        self.slot: Optional[SpillSlot] = None
+        self.nbytes = _arrays_bytes(arrays)
+        self.rows = rows
+
+    def spill(self, ctx: SpillContext) -> None:
+        if self.arrays is None:
+            return
+        if self.slot is None:
+            with _span("spill_partition", bytes=self.nbytes,
+                       rows=self.rows):
+                self.slot = ctx.store.put(self.arrays, self.rows)
+            ctx.spilled(self.nbytes)
+        # already on disk (an evicted reload): just drop residency
+        self.arrays = None
+        ctx.note_gone(self)
+        ctx.release(self.nbytes)
+
+    def load(self, ctx: SpillContext) -> Dict[str, np.ndarray]:
+        if self.arrays is not None:
+            return self.arrays
+        with _span("spill_reload", bytes=self.nbytes, rows=self.rows):
+            self.arrays = ctx.store.load(self.slot)
+        ctx.charge(self.nbytes)   # resident again; drop() releases
+        ctx.note_resident(self)
+        ctx.reloaded(self.nbytes)
+        return self.arrays
+
+    def peek(self, ctx: SpillContext) -> Dict[str, np.ndarray]:
+        """Memmapped read-only view of a spilled partition (or the
+        resident arrays): NO residency charge — callers slice bounded
+        runs out of it instead of loading the whole thing."""
+        if self.arrays is not None:
+            return self.arrays
+        with _span("spill_reload", bytes=self.nbytes, rows=self.rows):
+            arrays = ctx.store.load(self.slot, mmap=True)
+        ctx.reloaded(self.nbytes)
+        return arrays
+
+    def drop(self, ctx: SpillContext) -> None:
+        """Done with this partition: free disk and/or release bytes."""
+        if self.slot is not None:
+            ctx.store.free(self.slot)
+            self.slot = None
+        if self.arrays is not None:
+            self.arrays = None
+            ctx.note_gone(self)
+            ctx.release(self.nbytes)
+
+
+def _make_partitions(ctx: SpillContext, depth: int,
+                     key: np.ndarray, extras: Dict[str, np.ndarray],
+                     n_parts: int) -> List[Tuple[_Partition, np.ndarray]]:
+    """Hash-partition parallel arrays; spill cold partitions.  Returns
+    [(partition, row_selection)] — the selection indexes the CALLER's
+    arrays (ascending, order-preserving).  Residency policy: partitions
+    stay resident while cumulative bytes fit the budget; everything
+    after spills (forced mode spills all)."""
+    pids = hash_partition(key, depth, n_parts)
+    sels = _split(pids, n_parts)
+    parts: List[Tuple[_Partition, np.ndarray]] = []
+    resident = 0
+    for sel in sels:
+        arrays = {"k": key[sel]}
+        for name, arr in extras.items():
+            arrays[name] = arr[sel]
+        part = _Partition(arrays, len(sel))
+        ctx.charge(part.nbytes)
+        if ctx.spill_all or resident + part.nbytes > ctx.budget:
+            part.spill(ctx)
+        else:
+            resident += part.nbytes
+            ctx.note_resident(part)
+        parts.append((part, sel))
+    return parts
+
+
+# ---- hybrid hash join ------------------------------------------------------
+
+def partitioned_join(ctx: SpillContext,
+                     probe: Tuple[np.ndarray, np.ndarray], n_probe: int,
+                     build: Tuple[np.ndarray, np.ndarray], n_build: int,
+                     match_fn: Callable, outer: bool = False,
+                     probe_valid: Optional[np.ndarray] = None,
+                     build_valid: Optional[np.ndarray] = None):
+    """The memory-adaptive hybrid hash join.  ``match_fn(probe_pair,
+    n_probe, build_pair, n_build)`` is one of the UNCHANGED kernel entry
+    points (``join_match`` / ``unique_join_match``) called in inner mode
+    over pre-compacted live rows — so per-partition matching reuses the
+    exact compiled programs (and their progcache entries) the
+    unpartitioned path uses.
+
+    Output contract and ORDER are identical to the unpartitioned
+    kernels: probe-major (li ascending; a probe row's matches in stable
+    build order), outer mode emitting unmatched valid probe rows once
+    with ri = -1.  A probe row's matches all live in ONE partition and
+    stable selection preserves build order, so the per-row match
+    sequence is reproduced exactly; a final stable sort by li restores
+    the global interleaving."""
+    ctx.mark_used()
+    pk, pn = np.asarray(probe[0]), np.asarray(probe[1], dtype=bool)
+    bk, bn = np.asarray(build[0]), np.asarray(build[1], dtype=bool)
+    pk, pn = pk[:n_probe], pn[:n_probe]
+    bk, bn = bk[:n_build], bn[:n_build]
+    plive = ~pn if probe_valid is None \
+        else (~pn & np.asarray(probe_valid[:n_probe], dtype=bool))
+    blive = ~bn if build_valid is None \
+        else (~bn & np.asarray(build_valid[:n_build], dtype=bool))
+    pidx = np.nonzero(plive)[0]
+    bidx = np.nonzero(blive)[0]
+    li_out: List[np.ndarray] = []
+    ri_out: List[np.ndarray] = []
+    if len(pidx) and len(bidx):
+        _join_level(ctx, pk[pidx], pidx, bk[bidx], bidx, 0,
+                    match_fn, li_out, ri_out)
+    if li_out:
+        li = np.concatenate(li_out)
+        ri = np.concatenate(ri_out)
+    else:
+        li = np.empty(0, dtype=np.int64)
+        ri = np.empty(0, dtype=np.int64)
+    if outer:
+        matched = np.zeros(n_probe, dtype=bool)
+        matched[li] = True
+        pvalid = np.ones(n_probe, dtype=bool) if probe_valid is None \
+            else np.asarray(probe_valid[:n_probe], dtype=bool)
+        un = np.nonzero(pvalid & ~matched)[0]
+        if len(un):
+            li = np.concatenate([li, un])
+            ri = np.concatenate([ri, np.full(len(un), -1,
+                                             dtype=np.int64)])
+    order = np.argsort(li, kind="stable")
+    return li[order].astype(np.int64), ri[order].astype(np.int64)
+
+
+def _join_level(ctx: SpillContext, pk, pids, bk, bids, depth: int,
+                match_fn, li_out, ri_out) -> None:
+    interrupt.check()
+    n_parts = ctx.n_parts
+    bparts = _make_partitions(ctx, depth, bk, {"rid": bids}, n_parts)
+    ppart_ids = hash_partition(pk, depth, n_parts)
+    psels = _split(ppart_ids, n_parts)
+    zeros_cache: Dict[int, np.ndarray] = {}
+
+    def zeros(n: int) -> np.ndarray:
+        z = zeros_cache.get(n)
+        if z is None or len(z) < n:
+            z = zeros_cache[n] = np.zeros(n, dtype=bool)
+        return z[:n]
+
+    try:
+        for p, (part, _bsel) in enumerate(bparts):
+            interrupt.check()
+            psel = psels[p]
+            try:
+                if part.rows == 0 or len(psel) == 0:
+                    continue  # no possible matches in this partition
+                if ctx.enforce and not ctx.fits(part.nbytes):
+                    if depth + 1 > ctx.max_depth:
+                        raise ctx.overflow(part.nbytes)
+                    # recursive repartition: a fresh hash seed splits
+                    # the skew this level's hash collapsed.  peek() —
+                    # the partition is by definition over budget, so it
+                    # must NOT come back fully resident; the next level
+                    # slices its sub-partitions out of the memmap one
+                    # at a time (same discipline as _agg_level)
+                    ctx.repartitioned()
+                    arrays = part.peek(ctx)
+                    sub_pk, sub_pids = pk[psel], pids[psel]
+                    _join_level(ctx, sub_pk, sub_pids,
+                                np.asarray(arrays["k"]),
+                                np.asarray(arrays["rid"]), depth + 1,
+                                match_fn, li_out, ri_out)
+                    continue
+                arrays = part.load(ctx)
+                bkp, brid = arrays["k"], arrays["rid"]
+                pkp = pk[psel]
+                li_loc, ri_loc = match_fn(
+                    (pkp, zeros(len(pkp))), len(pkp),
+                    (bkp, zeros(len(bkp))), len(bkp))
+                if len(li_loc):
+                    li_out.append(pids[psel][li_loc])
+                    ri_out.append(brid[ri_loc])
+            finally:
+                part.drop(ctx)
+    finally:
+        # an error (kill, reload fault, 8175) mid-loop must not leak the
+        # remaining partitions' slots or resident bytes
+        for part, _ in bparts:
+            part.drop(ctx)
+
+
+# ---- hash aggregation ------------------------------------------------------
+
+def partitioned_segment_aggregate(ctx: SpillContext, gid: np.ndarray,
+                                  n_segments: int, specs, arg_cols,
+                                  n_rows: int,
+                                  filter_mask: Optional[np.ndarray] = None):
+    """Memory-adaptive segment aggregation: rows hash-partitioned by
+    group id (each group wholly in one partition — per-group
+    accumulation order, and therefore float sums, match the
+    unpartitioned kernel bit-for-bit on a sequential backend), partial
+    aggregates computed per partition through the UNCHANGED
+    ``kernels.segment_group_aggregate``, and the disjoint per-partition
+    group sets merged at drain.  Returns the same (present, out_aggs,
+    first_orig) contract."""
+    ctx.mark_used()
+    live = np.ones(n_rows, dtype=bool) if filter_mask is None \
+        else np.asarray(filter_mask[:n_rows], dtype=bool)
+    ridx = np.nonzero(live)[0]
+    rows_out = []   # (present_ids, out_aggs, first_orig_global)
+    if len(ridx):
+        extras = {"rid": ridx}
+        for i, (v, m) in enumerate(arg_cols):
+            extras[f"a{i}v"] = np.asarray(v)[:n_rows][ridx]
+            extras[f"a{i}m"] = np.asarray(m)[:n_rows][ridx]
+        _agg_level(ctx, gid[ridx].astype(np.int64), extras, 0,
+                   n_segments, specs, len(arg_cols), rows_out)
+    if not rows_out:
+        z = np.empty(0, dtype=np.int64)
+        return z, [(z.copy(), np.empty(0, dtype=bool))
+                   for _ in specs], z.copy()
+    present = np.concatenate([r[0] for r in rows_out])
+    first = np.concatenate([r[2] for r in rows_out])
+    out_aggs = []
+    for i in range(len(specs)):
+        vs = np.concatenate([r[1][i][0] for r in rows_out])
+        ms = np.concatenate([r[1][i][1] for r in rows_out])
+        out_aggs.append((vs, ms))
+    # partitions hold disjoint group sets: one stable sort restores the
+    # unpartitioned present-ascending order
+    order = np.argsort(present, kind="stable")
+    return (present[order],
+            [(v[order], m[order]) for v, m in out_aggs], first[order])
+
+
+def _agg_level(ctx: SpillContext, gid, extras, depth: int,
+               n_segments: int, specs, n_args: int, rows_out) -> None:
+    from . import kernels
+    interrupt.check()
+    parts = _make_partitions(ctx, depth, gid, extras, ctx.n_parts)
+    try:
+        for part, _sel in parts:
+            interrupt.check()
+            try:
+                if part.rows == 0:
+                    continue
+                if ctx.enforce and not ctx.fits(part.nbytes):
+                    arrays = part.peek(ctx)
+                    g = arrays["k"]
+                    # a one-key partition can never split by rehashing
+                    # (equal keys colocate at every depth): skip the
+                    # futile ladder and stream it directly
+                    splittable = len(g) > 1 and bool(
+                        (np.asarray(g) != g[0]).any())
+                    if depth + 1 <= ctx.max_depth and splittable:
+                        ctx.repartitioned()
+                        _agg_level(ctx, np.asarray(g),
+                                   {k: np.asarray(v)
+                                    for k, v in arrays.items()
+                                    if k != "k"},
+                                   depth + 1, n_segments, specs,
+                                   n_args, rows_out)
+                    else:
+                        _stream_partition_aggregate(
+                            ctx, arrays, part.rows, part.nbytes,
+                            n_segments, specs, n_args, rows_out)
+                    continue
+                arrays = part.load(ctx)
+                g = arrays["k"]
+                rid = arrays["rid"]
+                acols = [(arrays[f"a{i}v"], arrays[f"a{i}m"])
+                         for i in range(n_args)]
+                present, out_aggs, first = kernels.segment_group_aggregate(
+                    g, n_segments, specs, acols, len(g))
+                if len(present):
+                    rows_out.append((present, out_aggs, rid[first]))
+            finally:
+                part.drop(ctx)
+    finally:
+        for part, _ in parts:
+            part.drop(ctx)
+
+
+def _stream_partition_aggregate(ctx: SpillContext, arrays, rows: int,
+                                nbytes: int, n_segments: int, specs,
+                                n_args: int, rows_out) -> None:
+    """Partial Partial Aggregates (PAPERS.md): a partition that exceeds
+    every budget and cannot usefully split (one giant group, or the
+    repartition ladder is exhausted) streams through the UNCHANGED
+    kernel in budget-sized row slices, merging the per-slice PARTIAL
+    aggregate states on host — so aggregation state stays
+    O(n_segments) and the working set stays bounded no matter how
+    skewed the grouping is.  count/count_star/min/max/first merge
+    exactly; float sums merge left-to-right over the slices, which can
+    differ from the one-shot kernel in the last ulp — the documented
+    price of completing at quotas below a single group's row
+    footprint."""
+    from . import kernels
+    bpr = max(nbytes // max(rows, 1), 1)
+    run = max(int(ctx.budget // bpr), 256)
+    acc = None
+    with _span("spill_stream_agg", rows=rows, bytes=nbytes):
+        for s in range(0, rows, run):
+            interrupt.check()
+            e = min(s + run, rows)
+            g = np.asarray(arrays["k"][s:e])
+            rid = np.asarray(arrays["rid"][s:e])
+            acols = [(np.asarray(arrays[f"a{i}v"][s:e]),
+                      np.asarray(arrays[f"a{i}m"][s:e]))
+                     for i in range(n_args)]
+            nb = (g.nbytes + rid.nbytes
+                  + sum(v.nbytes + m.nbytes for v, m in acols))
+            ctx.charge(nb)
+            try:
+                present, out_aggs, first = \
+                    kernels.segment_group_aggregate(
+                        g, n_segments, specs, acols, e - s)
+                partial = (present, out_aggs, rid[first])
+                acc = partial if acc is None else _merge_partials(
+                    acc, partial, specs)
+            finally:
+                ctx.release(nb)
+            _record("spill_stream_runs")
+    if acc is not None and len(acc[0]):
+        rows_out.append(acc)
+
+
+def _merge_partials(a, b, specs):
+    """Merge two partial-aggregate states over the SAME segment-id
+    space: union of present segments, per-spec combination (sums/counts
+    add, min/max fold, first takes the smallest original row id).  NULL
+    semantics match the kernel: a spec's output is NULL only when no
+    live row contributed on EITHER side."""
+    pres_a, aggs_a, first_a = a
+    pres_b, aggs_b, first_b = b
+    allp = np.union1d(pres_a, pres_b).astype(np.int64)
+    n = len(allp)
+
+    def locate(pres):
+        idx = np.searchsorted(pres, allp)
+        safe = np.minimum(idx, max(len(pres) - 1, 0))
+        inm = (np.zeros(n, dtype=bool) if len(pres) == 0
+               else np.asarray(pres)[safe] == allp)
+        return safe, inm
+
+    ia, in_a = locate(pres_a)
+    ib, in_b = locate(pres_b)
+
+    def gather(vals, idx, inm, fill):
+        vals = np.asarray(vals)
+        out = np.full(n, fill, dtype=vals.dtype if len(vals) else None)
+        if len(vals):
+            out[inm] = vals[idx[inm]]
+        return out
+
+    big = np.iinfo(np.int64).max
+    first = np.minimum(gather(first_a, ia, in_a, big),
+                       gather(first_b, ib, in_b, big))
+    out_aggs = []
+    for i, (func, _has_arg) in enumerate(specs):
+        va = gather(aggs_a[i][0], ia, in_a, 0)
+        vb = gather(aggs_b[i][0], ib, in_b, 0)
+        ma = gather(aggs_a[i][1], ia, in_a, True)
+        mb = gather(aggs_b[i][1], ib, in_b, True)
+        if func in ("count", "count_star", "sum0"):
+            # never NULL; an absent side contributed 0
+            out_aggs.append((va + vb, np.zeros(n, dtype=bool)))
+        elif func in ("sum", "sum_int"):
+            # a NULL side's kernel sum is 0: plain add is correct
+            out_aggs.append((va + vb, ma & mb))
+        elif func in ("min", "max"):
+            fold = np.minimum if func == "min" else np.maximum
+            v = np.where(ma, vb, np.where(mb, va, fold(va, vb)))
+            out_aggs.append((v, ma & mb))
+        else:  # pragma: no cover
+            raise ValueError(func)
+    return allp, out_aggs, first
+
+
+# ---- external sort ---------------------------------------------------------
+
+def external_sort_permutation(ctx: SpillContext, key_cols, descs,
+                              n_rows: int, run_rows: int) -> np.ndarray:
+    """Spilled-run external sort: each run of ``run_rows`` rows sorts on
+    host with the device kernel's exact semantics
+    (``kernels._np_lexsort_perm``: stable, NULL first/last per
+    direction, original row id as the implicit final tie-break) and
+    spills (sorted keys + permutation) as a run file; a vectorized
+    k-way merge over the run files — ordering by (transformed keys...,
+    row id) in bounded blocks — reproduces the full lexsort's EXACT
+    permutation."""
+    ctx.mark_used()
+    from . import kernels
+    runs: List[SpillSlot] = []
+    nk = len(key_cols)
+    try:
+        for s in range(0, n_rows, run_rows):
+            interrupt.check()
+            e = min(s + run_rows, n_rows)
+            sub = [(np.asarray(v)[s:e], np.asarray(m)[s:e])
+                   for v, m in key_cols]
+            perm = kernels._np_lexsort_perm(sub, descs) + s
+            arrays = {"perm": perm.astype(np.int64)}
+            for i, (v, m) in enumerate(key_cols):
+                local = perm - s
+                arrays[f"k{i}v"] = np.asarray(v)[s:e][local]
+                arrays[f"k{i}m"] = np.asarray(m)[s:e][local]
+            with _span("spill_run", rows=e - s):
+                slot = ctx.store.put(arrays, e - s)
+            ctx.spilled(slot.nbytes)  # runs count as spilled partitions
+            runs.append(slot)
+        if not runs:
+            return np.empty(0, dtype=np.int64)
+        return _merge_runs(ctx, runs, descs, nk, n_rows)
+    finally:
+        for slot in runs:
+            ctx.store.free(slot)
+
+
+class _RunChain:
+    """A logical sorted run: an ordered chain of spilled chunk slots
+    (one slot for an original run; several for a merge pass's output)."""
+
+    __slots__ = ("slots", "rows")
+
+    def __init__(self, slots: List[SpillSlot]):
+        self.slots = [s for s in slots if s.rows]
+        self.rows = sum(s.rows for s in self.slots)
+
+
+class _ChainCursor:
+    """Block reader over a run chain: memmaps one slot at a time and
+    hands out materialized blocks of bounded rows."""
+
+    __slots__ = ("_ctx", "_slots", "_si", "_off", "_arrs")
+
+    def __init__(self, ctx: SpillContext, chain: _RunChain):
+        self._ctx = ctx
+        self._slots = chain.slots
+        self._si = 0
+        self._off = 0
+        self._arrs: Optional[Dict[str, np.ndarray]] = None
+
+    def exhausted(self) -> bool:
+        return self._si >= len(self._slots)
+
+    def next_block(self, rows: int,
+                   names: List[str]) -> Optional[Dict[str, np.ndarray]]:
+        chunks = []
+        while rows > 0 and self._si < len(self._slots):
+            slot = self._slots[self._si]
+            if self._arrs is None:
+                with _span("spill_reload", bytes=slot.nbytes):
+                    self._arrs = self._ctx.store.load(slot, mmap=True)
+                self._ctx.reloaded(slot.nbytes)
+            s = self._off
+            e = min(s + rows, slot.rows)
+            chunks.append({k: np.asarray(self._arrs[k][s:e])
+                           for k in names})
+            rows -= e - s
+            self._off = e
+            if e >= slot.rows:
+                self._arrs = None
+                self._si += 1
+                self._off = 0
+        if not chunks:
+            return None
+        if len(chunks) == 1:
+            return chunks[0]
+        return {k: np.concatenate([c[k] for c in chunks])
+                for k in names}
+
+
+def _merge_group(ctx: SpillContext, chains: List[_RunChain], descs,
+                 nk: int, block_rows: int, emit) -> None:
+    """Vectorized k-way merge of sorted run chains with bounded
+    residency.  Loop invariant: every non-exhausted chain has rows in
+    the buffer.  Each round sorts the buffer through the UNCHANGED
+    ``kernels._np_lexsort_perm`` with the original row id appended as
+    the least-significant ascending key — exactly the stable global
+    lexsort's implicit tie-break, so emitted order is bit-identical to
+    the full sort — then emits the prefix up to the smallest
+    still-feeding chain's largest buffered element (everything unseen
+    from any chain is strictly greater, keys being unique by row id)
+    and refills only the chains that drained."""
+    from . import kernels
+    names = ["perm"] + [f"k{i}{t}" for i in range(nk) for t in "vm"]
+    cursors = [_ChainCursor(ctx, c) for c in chains]
+    buf: Optional[Dict[str, np.ndarray]] = None
+    need = list(range(len(cursors)))
+    while True:
+        interrupt.check()
+        for r in need:
+            blk = cursors[r].next_block(block_rows, names)
+            if blk is None:
+                continue
+            blk["src"] = np.full(len(blk["perm"]), r, dtype=np.int64)
+            buf = blk if buf is None else \
+                {k: np.concatenate([buf[k], blk[k]]) for k in buf}
+        if buf is None or not len(buf["perm"]):
+            return
+        keys = [(buf[f"k{i}v"], buf[f"k{i}m"]) for i in range(nk)]
+        keys.append((buf["perm"],
+                     np.zeros(len(buf["perm"]), dtype=bool)))
+        order = kernels._np_lexsort_perm(keys, list(descs) + [False])
+        buf = {k: v[order] for k, v in buf.items()}
+        src = buf["src"]
+        cut = len(src)
+        for r, cur in enumerate(cursors):
+            if not cur.exhausted():
+                pos = np.nonzero(src == r)[0]
+                cut = min(cut, int(pos[-1]) + 1)
+        emit({k: v[:cut] for k, v in buf.items() if k != "src"})
+        buf = None if cut >= len(src) \
+            else {k: v[cut:] for k, v in buf.items()}
+        need = [r for r, cur in enumerate(cursors)
+                if not cur.exhausted()
+                and (buf is None or not (buf["src"] == r).any())]
+        if not need and buf is None:
+            return
+
+
+def _merge_runs(ctx: SpillContext, runs, descs, nk: int,
+                n_rows: int) -> np.ndarray:
+    """External merge of the sorted run files, vectorized end to end
+    (no per-row Python): runs merge in budget-bounded fan-in groups —
+    more runs than the fan-in holds cascade through intermediate merge
+    passes whose output chunks go back THROUGH the store as chained
+    run files — and the final pass streams the global permutation out
+    block by block."""
+    row_b = max(sum(s.nbytes for s in runs) // max(n_rows, 1), 1)
+    cap_rows = max(int(ctx.budget // row_b), 512)
+    fan = int(min(len(runs), max(cap_rows // 256, 2)))
+    block = max(cap_rows // fan, 256)
+    chains = [_RunChain([s]) for s in runs]
+    owned: List[SpillSlot] = []
+    try:
+        while len(chains) > fan:
+            interrupt.check()
+            nxt: List[_RunChain] = []
+            for g in range(0, len(chains), fan):
+                group = chains[g:g + fan]
+                if len(group) == 1:
+                    nxt.append(group[0])
+                    continue
+                merged: List[SpillSlot] = []
+
+                def emit_slot(chunk, _m=merged):
+                    slot = ctx.store.put(chunk, len(chunk["perm"]))
+                    ctx.spilled(slot.nbytes)
+                    owned.append(slot)
+                    _m.append(slot)
+
+                with _span("spill_merge_pass", runs=len(group)):
+                    _merge_group(ctx, group, descs, nk, block, emit_slot)
+                for c in group:          # inputs consumed: free early
+                    for s in c.slots:
+                        ctx.store.free(s)
+                nxt.append(_RunChain(merged))
+            chains = nxt
+        out = np.empty(n_rows, dtype=np.int64)
+        w = 0
+
+        def emit_out(chunk):
+            nonlocal w
+            n = len(chunk["perm"])
+            out[w:w + n] = chunk["perm"]
+            w += n
+
+        _merge_group(ctx, chains, descs, nk, block, emit_out)
+        return out[:w]
+    finally:
+        for s in owned:
+            ctx.store.free(s)   # idempotent; covers the error path
+
+
+# ---- external top-k --------------------------------------------------------
+
+def external_topk(ctx: SpillContext, key_cols, descs, n_rows: int,
+                  k: int, run_rows: int) -> np.ndarray:
+    """Blockwise top-k with the candidate carry held IN THE STORE: each
+    run contributes its local top-k, the carried candidate set (≤ k
+    rows, spilled between runs) merges with each run's winners exactly
+    like the in-memory blockwise TopN — same kernels, same tie
+    semantics, bounded residency."""
+    ctx.mark_used()
+    from . import kernels
+    cand = np.empty(0, dtype=np.int64)
+    slot: Optional[SpillSlot] = None
+    try:
+        for s in range(0, n_rows, run_rows):
+            interrupt.check()
+            e = min(s + run_rows, n_rows)
+            bkeys = [(np.asarray(v)[s:e], np.asarray(m)[s:e])
+                     for v, m in key_cols]
+            ids = np.asarray(kernels.top_k(bkeys, descs, e - s, k)) + s
+            if slot is not None:
+                arrays = ctx.store.load(slot)
+                ctx.reloaded(slot.nbytes)
+                cand = arrays["cand"]
+                ctx.store.free(slot)
+                slot = None
+            pool = np.concatenate([cand, ids])
+            pkeys = [(np.asarray(v)[pool], np.asarray(m)[pool])
+                     for v, m in key_cols]
+            order = np.asarray(kernels.top_k(pkeys, descs, len(pool), k))
+            cand = pool[order]
+            if e < n_rows:
+                with _span("spill_run", rows=len(cand)):
+                    slot = ctx.store.put({"cand": cand}, len(cand))
+                ctx.spilled(slot.nbytes)
+                cand = np.empty(0, dtype=np.int64)
+        return cand
+    finally:
+        if slot is not None:
+            ctx.store.free(slot)

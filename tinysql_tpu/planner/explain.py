@@ -191,6 +191,13 @@ def _device_info(st) -> str:
                      f"/stage:{d.get('pipe_stage_s', 0.0) * 1e3:.1f}ms"
                      f"/drain:{d.get('pipe_drain_s', 0.0) * 1e3:.1f}ms"
                      f"/overlap:{overlap:.2f}")
+    if d.get("spill_bytes"):
+        sp = (f"spill:{int(d.get('spill_partitions', 0))}p"
+              f"/{_fmt_bytes(d['spill_bytes'])}"
+              f"/reload:{_fmt_bytes(d.get('spill_reload_bytes', 0))}")
+        if d.get("spill_repartitions"):
+            sp += f"/repart:{int(d['spill_repartitions'])}"
+        parts.append(sp)
     return ", ".join(parts)
 
 

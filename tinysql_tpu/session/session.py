@@ -94,6 +94,14 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # per-query chunk-allocation budget in BYTES (0 = unlimited): blown
     # quota aborts the statement with error 8175 (utils/memory.py)
     "tidb_mem_quota_query": 0,
+    # memory-adaptive execution (ops/spill.py): crossing spill_ratio x
+    # quota flips join/agg/sort/topn into partitioned spill mode instead
+    # of dying at the quota (0 disables the soft watermark); partitions
+    # 0 = auto fan-out from the planner's estRows; max_depth bounds the
+    # recursive-repartition ladder before the typed 8175 last resort
+    "tidb_mem_quota_spill_ratio": 0.8,
+    "tidb_spill_partitions": 0,
+    "tidb_spill_max_depth": 3,
     # seconds the backend stays pinned to CPU after a mid-statement
     # device loss (ops/degrade.py runtime degradation)
     "tidb_device_cooldown": 30,
@@ -515,7 +523,10 @@ class Session:
                         "tidb_stmt_summary_max_stmt_count") or 0)
                 except (TypeError, ValueError):
                     max_count = stmtsummary.DEFAULT_MAX_STMT_COUNT
-                mem = self._stmt_mem.consumed \
+                # the summary's MEM column is the statement's high-water
+                # mark: live-set release accounting (chunk free / spill)
+                # makes `consumed` drop as buffers go away
+                mem = self._stmt_mem.peak \
                     if self._stmt_mem is not None else 0
                 stmtsummary.ingest(
                     sql=src, sql_digest=sql_digest,
@@ -568,10 +579,19 @@ class Session:
             quota = int(self.get_sysvar("tidb_mem_quota_query") or 0)
         except (TypeError, ValueError):
             quota = 0
+        try:
+            ratio = float(
+                self.get_sysvar("tidb_mem_quota_spill_ratio") or 0)
+        except (TypeError, ValueError):
+            ratio = 0.0
         # the tracker is ALWAYS installed (quota 0 = track, never abort):
         # information_schema.processlist reports its live byte count and
-        # statements_summary its per-statement high-water mark
-        self._stmt_mem = memory.MemTracker(quota if quota > 0 else 0)
+        # statements_summary its per-statement high-water mark.  The
+        # soft watermark (ratio x quota) is where spill-capable
+        # operators flip into partitioned mode (ops/spill.py)
+        wm = int(quota * ratio) if quota > 0 and 0 < ratio <= 1 else 0
+        self._stmt_mem = memory.MemTracker(quota if quota > 0 else 0,
+                                           spill_watermark=wm)
         mtok = memory.activate(self._stmt_mem)
         self.stmt_running = True
         try:
@@ -941,7 +961,9 @@ class Session:
                      "tidb_batch_max_size",
                      "tidb_batch_window_ms",
                      "tidb_metrics_interval",
-                     "tidb_metrics_retention")
+                     "tidb_metrics_retention",
+                     "tidb_spill_partitions",
+                     "tidb_spill_max_depth")
 
     @staticmethod
     def _validate_uint_sysvar(name: str, v: Datum) -> int:
@@ -975,6 +997,21 @@ class Session:
                 continue
             if name in self._UINT_SYSVARS:
                 v = self._validate_uint_sysvar(name, v)
+            if name == "tidb_mem_quota_spill_ratio":
+                # a fraction of the quota: validated to [0, 1] at SET
+                # time (0 disables the soft watermark — quota becomes a
+                # hard kill line again)
+                try:
+                    fv = float(v if not isinstance(v, bool) else "x")
+                except (TypeError, ValueError):
+                    raise SessionError(
+                        f"Incorrect argument type to variable '{name}'",
+                        mysql_code=1232, sqlstate="42000")
+                if not 0.0 <= fv <= 1.0:
+                    raise SessionError(
+                        f"Variable '{name}' can't be set to the value "
+                        f"of '{v}'", mysql_code=1231, sqlstate="42000")
+                v = fv
             if name == "tidb_failpoints":
                 # validate + apply atomically BEFORE storing: a bad spec
                 # must fail the SET and leave the armed set unchanged
