@@ -566,6 +566,65 @@ def test_chaos_matrix(name, tk):
 
 
 # =========================================================================
+# layer 2c: UPDATE through the 2PC prewrite/commit fault matrix
+# =========================================================================
+# UPDATE rides the same read-modify-write + 2PC path as INSERT/DELETE
+# (session._exec_update -> UpdateExec -> Table.update_record), so each
+# 2PC failpoint must degrade it the same way: a clean TYPED error with
+# the row either fully old or fully new — never half-assigned, never a
+# stuck lock.
+
+@pytest.mark.parametrize("point,want", [
+    ("prewriteError", IOError),
+    ("commitError", UndeterminedError),
+    ("commitPrimaryError", UndeterminedError),
+])
+def test_update_2pc_fault_leaves_row_unchanged(tk, point, want):
+    s, _ = tk
+    fail.reset_hits()
+    with fail.armed(point, exc=IOError(f"{point} injected"), times=1):
+        with pytest.raises(want):
+            s.execute("update t set b = 999 where a = 9")
+    assert fail.hits().get(point, 0) >= 1
+    time.sleep(0.01)  # let the 1ms chaos lock TTL lapse
+    # the commit never reached MVCC: old value, and the key is
+    # immediately writable again (no stuck lock)
+    assert s.query("select b from t where a = 9").rows == [[2]]
+    s.execute("update t set b = b + 1 where a = 9")
+    assert s.query("select b from t where a = 9").rows == [[3]]
+
+
+def test_update_commit_secondary_fault_is_durable(tk):
+    s, _ = tk
+    # rows 50 and 400 live in different regions (fixture splits at
+    # 125/250/375): the txn carries a real secondary batch
+    with fail.armed("commitSecondaryError", exc=IOError("flaky"),
+                    times=1):
+        s.execute("update t set b = -1 where a = 50 or a = 400")
+    time.sleep(0.01)
+    # durable once the primary committed: the next reader resolves the
+    # leftover secondary lock THROUGH the primary to the NEW value
+    assert s.query("select b from t where a = 50 or a = 400").rows \
+        == [[-1], [-1]]
+
+
+def test_update_before_commit_panic_rolls_back(tk):
+    s, _ = tk
+    with fail.armed("beforeCommit", panic=True, times=1):
+        with pytest.raises(fail.Panic):
+            s.execute("update t set b = 123 where a = 7")
+    time.sleep(0.01)
+    s2 = Session(s.storage, current_db="c")
+    s2.execute("set @@tidb_use_tpu = 0")
+    # crashed committer: never committed, old value survives, key
+    # writable from a fresh session
+    assert s2.query("select b from t where a = 7").rows == [[0]]
+    s2.execute("update t set b = 1 where a = 7")
+    assert s2.query("select b from t where a = 7").rows == [[1]]
+    s2.execute("update t set b = 0 where a = 7")
+
+
+# =========================================================================
 # layer 3a: statement interruption (KILL + max_execution_time)
 # =========================================================================
 

@@ -197,6 +197,36 @@ def test_delete():
     assert s.where is not None
 
 
+def test_update():
+    s = ok("update t set a = 1, b = b + 1 where c > 0")
+    assert isinstance(s, ast.UpdateStmt)
+    assert s.table.source.name == "t"
+    assert [a.column.name for a in s.assignments] == ["a", "b"]
+    assert s.where is not None
+    s = ok("update db.t as x set x.a = null")
+    assert s.table.source.db == "db" and s.table.as_name == "x"
+    assert s.where is None
+
+
+def test_subquery_expressions():
+    s = sel("select a from t where b in (select k from u) "
+            "and exists (select 1 from u where u.k = t.b) "
+            "and c = (select max(k) from u)")
+    conj = []
+
+    def flat(e):
+        if isinstance(e, ast.BinaryOp) and e.op == "and":
+            flat(e.left), flat(e.right)
+        else:
+            conj.append(e)
+    flat(s.where)
+    assert isinstance(conj[0], ast.InExpr) \
+        and isinstance(conj[0].items[0], ast.SubqueryExpr)
+    assert isinstance(conj[1], ast.ExistsExpr)
+    assert isinstance(conj[2], ast.BinaryOp) \
+        and isinstance(conj[2].right, ast.SubqueryExpr)
+
+
 # ---- DDL -------------------------------------------------------------------
 
 def test_create_table_full():
@@ -270,7 +300,8 @@ def test_multi_statement_and_errors():
     assert len(stmts) == 2
     for bad in ["select from t", "insert t values", "select * from",
                 "create table t", "select a from t where", "selec 1",
-                "select 'unterminated", "select ((1)", "update t set a=1"]:
+                "select 'unterminated", "select ((1)", "update t set",
+                "update t where a=1"]:
         with pytest.raises(ParseError):
             parse(bad)
 

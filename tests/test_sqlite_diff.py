@@ -75,10 +75,62 @@ class _Gen:
         glue = self.rng.choice(["and", "or"])
         return f"({self.pred(depth + 1)} {glue} {self.pred(depth + 1)})"
 
+    def subquery_pred(self):
+        """[NOT] IN / [NOT] EXISTS membership conjuncts over the
+        decorrelation surface: unique (u.k) and duplicated (wd.k1)
+        build sides, a NULLable build side (t.b — the null-aware NOT IN
+        ladder), empty subqueries, correlated and uncorrelated
+        EXISTS."""
+        r = self.rng
+        neg = "not " if r.random() < 0.5 else ""
+        kind = r.random()
+        if kind < 0.5:
+            src = r.choice([
+                "select k from u where k > 0",
+                "select k1 from wd where x < 20",
+                f"select b from t where a < {r.randint(1, 60)}",
+                "select k from u where k < -100",  # empty build side
+                "select k1 from wd group by k1 having count(*) > 1",
+            ])
+            col = r.choice(["a", "b"])
+            return f"{col} {neg}in ({src})"
+        if kind < 0.85:  # correlated EXISTS (+ optional local conjunct)
+            cond = r.choice(["u.k = t.b", "u.k = t.a"])
+            extra = r.choice(["", " and u.v < 'v4'", " and u.k > 1"])
+            return f"{neg}exists (select 1 from u where {cond}{extra})"
+        lit = r.choice(["v0", "nope"])
+        return f"{neg}exists (select 1 from u where v = '{lit}')"
+
     def query(self):
         r = self.rng
         shape = r.random()
         where = f" where {self.pred()}" if r.random() < 0.7 else ""
+        if shape < 0.86 and shape >= 0.78:
+            # subquery membership: the decorrelated semi/anti join
+            # surface, alone and composed with a residual conjunct
+            sub = self.subquery_pred()
+            extra = f" and {self.pred()}" if r.random() < 0.4 else ""
+            lim = f" limit {r.randint(1, 30)}" if r.random() < 0.3 else ""
+            return (f"select a, b from t where {sub}{extra} "
+                    f"order by a{lim}")
+        if shape >= 0.86 and shape < 0.93:
+            # 3-table join chains (multi-join pipelines)
+            jt1 = r.choice(["join", "left join"])
+            jt2 = r.choice(["join", "left join"])
+            return (f"select t.a, u.v, w.x from t {jt1} u on t.b = u.k "
+                    f"{jt2} w on t.a = w.k2{where} order by 1, 2, 3")
+        if shape >= 0.93:
+            # GROUP BY + ORDER BY + LIMIT over a join chain (the
+            # Q10/Q18 composition); ORDER BY the full unique group key
+            # so LIMIT ties cannot differ between engines
+            aggs = ", ".join(r.choice(
+                ["count(*)", "sum(w.x)", "min(t.b)", "max(w.x)",
+                 "sum(t.c)"]) for _ in range(r.randint(1, 2)))
+            lim = f" limit {r.randint(1, 8)}" if r.random() < 0.6 else ""
+            return (f"select u.v, {aggs} from t join u on t.b = u.k "
+                    f"join w on t.a = w.k2{where} "
+                    f"group by u.v order by u.v{lim}")
+        shape /= 0.78  # renormalize the legacy shape mix
         if shape < 0.4:  # plain select
             exprs = ", ".join(self.scalar() for _ in range(r.randint(1, 3)))
             keys = ["a"]

@@ -447,6 +447,10 @@ def test_device_join_kernels_sql_parity(monkeypatch):
         "order by f.id limit 25",                          # outer + ON
         "select u.t, x.s from d u join (select k, sum(v) as s from f "
         "group by k) x on u.k = x.k order by x.s desc limit 9",  # sorted
+        "select f.id from f where f.k in (select k from dup "
+        "where w > 4) order by f.id limit 20",             # semi join
+        "select count(*) from f where f.k not in (select k from d "
+        "where t = 3)",                                    # anti join
     ]
     def canon(rows):
         return sorted(tuple(f"{v:.9g}" if isinstance(v, float) else str(v)

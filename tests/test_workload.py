@@ -1,0 +1,488 @@
+"""Workload diversity (ISSUE 10): subquery decorrelation, semi/anti
+joins, UPDATE, and TPC-H Q5/Q10/Q18 end-to-end.
+
+Four layers:
+
+1. decorrelation edge cases on a toy schema — NOT IN three-valued NULL
+   semantics, empty subquery results, correlated vs uncorrelated
+   EXISTS, duplicate keys on the semijoin build side, scalar
+   subqueries;
+2. the planner/device surface — semi/anti admissibility, PD2xx
+   coverage, plan-digest stability so statements_summary joins work on
+   the new operators;
+3. UPDATE read-modify-write semantics over the INSERT/DELETE 2PC path
+   (the chaos drivers live in test_chaos.py);
+4. TPC-H Q5/Q10/Q18 at SF=0.02 against a sqlite3 oracle over the SAME
+   generated data, on both tiers, with the progcache second-run
+   compile-nothing acceptance and EXPLAIN ANALYZE device counters.
+"""
+import pytest
+
+from tinysql_tpu.bench import tpch
+from tinysql_tpu.ops import kernels
+from tinysql_tpu.session.session import Session, SessionError, new_session
+
+
+@pytest.fixture()
+def ts():
+    s = new_session()
+    s.execute("create database w")
+    s.execute("use w")
+    s.execute("set @@tidb_tpu_min_rows = 0")
+    s.execute("create table t (a int primary key, b int)")
+    s.execute("insert into t values (1, 10), (2, 20), (3, null), (4, 40)")
+    s.execute("create table u (k int primary key, v int)")
+    s.execute("insert into u values (10, 1), (20, 2), (99, 3)")
+    # nullable, duplicated membership side
+    s.execute("create table m (k int, tag varchar(4))")
+    s.execute("insert into m values (10, 'x'), (10, 'y'), (40, 'x')")
+    return s
+
+
+def _q(s, sql):
+    return s.query(sql).rows
+
+
+def _both_tiers(s, sql):
+    """Run on CPU and TPU tier; assert identical rows; return them."""
+    s.execute("set @@tidb_use_tpu = 0")
+    cpu = _q(s, sql)
+    s.execute("set @@tidb_use_tpu = 1")
+    tpu = _q(s, sql)
+    assert cpu == tpu, (sql, cpu, tpu)
+    return tpu
+
+
+# =========================================================================
+# layer 1: decorrelation edge cases
+# =========================================================================
+
+def test_in_subquery_semijoin(ts):
+    sql = "select a from t where b in (select k from u) order by a"
+    assert _both_tiers(ts, sql) == [[1], [2]]
+    flat = "\n".join(str(r) for r in _q(ts, "explain " + sql))
+    assert "semi join" in flat
+
+
+def test_in_subquery_duplicate_build_keys(ts):
+    # m.k holds 10 twice: the semijoin must emit each left row ONCE
+    sql = "select a from t where b in (select k from m) order by a"
+    assert _both_tiers(ts, sql) == [[1], [4]]
+
+
+def test_not_in_null_semantics(ts):
+    # build side contains no NULL: NULL probe rows (a=3) drop, the
+    # non-members survive
+    sql = ("select a from t where b not in (select k from u) "
+           "order by a")
+    assert _both_tiers(ts, sql) == [[4]]
+    flat = "\n".join(str(r) for r in _q(ts, "explain " + sql))
+    assert "anti join" in flat and "null-aware" in flat
+
+
+def test_not_in_with_null_build_key_kills_everything(ts):
+    ts.execute("insert into m values (null, 'z')")
+    sql = "select a from t where b not in (select k from m) order by a"
+    assert _both_tiers(ts, sql) == []
+
+
+def test_not_in_empty_subquery_keeps_all_rows(ts):
+    # x NOT IN (empty) is TRUE for every x — NULL probe keys included
+    sql = ("select a from t where b not in "
+           "(select k from u where k < 0) order by a")
+    assert _both_tiers(ts, sql) == [[1], [2], [3], [4]]
+
+
+def test_in_empty_subquery_keeps_nothing(ts):
+    sql = ("select a from t where b in (select k from u where k < 0) "
+           "order by a")
+    assert _both_tiers(ts, sql) == []
+
+
+def test_exists_correlated(ts):
+    sql = ("select a from t where exists "
+           "(select 1 from u where u.k = t.b) order by a")
+    assert _both_tiers(ts, sql) == [[1], [2]]
+
+
+def test_not_exists_correlated_null_probe_survives(ts):
+    # NOT EXISTS is NOT null-aware: a NULL correlated key simply never
+    # matches, so row a=3 SURVIVES (contrast NOT IN above)
+    sql = ("select a from t where not exists "
+           "(select 1 from u where u.k = t.b) order by a")
+    assert _both_tiers(ts, sql) == [[3], [4]]
+
+
+def test_exists_uncorrelated_cartesian(ts):
+    sql = ("select a from t where exists "
+           "(select 1 from u where v > 2) order by a")
+    assert _both_tiers(ts, sql) == [[1], [2], [3], [4]]
+    sql = ("select a from t where exists "
+           "(select 1 from u where v > 99) order by a")
+    assert _both_tiers(ts, sql) == []
+    sql = ("select a from t where not exists "
+           "(select 1 from u where v > 99) order by a")
+    assert _both_tiers(ts, sql) == [[1], [2], [3], [4]]
+
+
+def test_exists_correlated_residual_condition(ts):
+    # the non-equality correlated conjunct becomes an other_condition
+    # evaluated per candidate pair (CPU tier handles residuals)
+    sql = ("select a from t where exists "
+           "(select 1 from u where u.k = t.b and t.a >= u.v) order by a")
+    assert _both_tiers(ts, sql) == [[1], [2]]
+    sql = ("select a from t where exists "
+           "(select 1 from u where u.k = t.b and t.a > u.v) order by a")
+    assert _both_tiers(ts, sql) == []
+
+
+def test_exists_aggregate_shaped_subquery(ts):
+    # GROUP BY/HAVING inside EXISTS: full subquery plan as build side
+    sql = ("select a from t where exists "
+           "(select k from m group by k having count(*) > 1) "
+           "order by a")
+    assert _both_tiers(ts, sql) == [[1], [2], [3], [4]]
+
+
+def test_in_subquery_with_aggregate_having(ts):
+    # the Q18 shape: IN over a grouped + HAVING subquery
+    sql = ("select a from t where b in "
+           "(select k from m group by k having count(*) > 1) "
+           "order by a")
+    assert _both_tiers(ts, sql) == [[1]]
+
+
+def test_in_subquery_composes_with_residual_where(ts):
+    sql = ("select a from t where b in (select k from u) and a > 1 "
+           "order by a")
+    assert _both_tiers(ts, sql) == [[2]]
+
+
+def test_scalar_subquery_in_where_and_select(ts):
+    sql = ("select a from t where b = "
+           "(select max(k) from u where k < 50) order by a")
+    assert _both_tiers(ts, sql) == [[2]]
+    # 0 rows -> NULL (matches nothing, errors nothing)
+    sql = ("select a from t where b = (select k from u where k < 0) "
+           "order by a")
+    assert _both_tiers(ts, sql) == []
+
+
+def test_scalar_subquery_more_than_one_row_errors(ts):
+    with pytest.raises(Exception, match="more than 1 row"):
+        _q(ts, "select a from t where b = (select k from u)")
+
+
+def test_correlated_column_outside_exists_fails_loudly(ts):
+    # correlation is only resolvable inside a decorrelatable EXISTS; a
+    # scalar subquery referencing the outer scope must error, not
+    # silently misbind
+    with pytest.raises(Exception):
+        _q(ts, "select a from t where b = (select k from u "
+               "where u.k = t.b)")
+
+
+# =========================================================================
+# layer 2: planner/device surface — admissibility, PD2xx, digests
+# =========================================================================
+
+def _planned(s, sql):
+    from tinysql_tpu.parser.parser import parse
+    from tinysql_tpu.planner.builder import PlanBuilder
+    stmt = parse(sql)[0]
+    logical = PlanBuilder(s).build_select(stmt)
+    return s._optimize(logical, True)
+
+
+def _find_join(p, tp):
+    from tinysql_tpu.planner.physical import PhysicalHashJoin
+    if isinstance(p, PhysicalHashJoin) and p.tp == tp:
+        return p
+    for c in p.children:
+        got = _find_join(c, tp)
+        if got is not None:
+            return got
+    return None
+
+
+def test_semi_join_admissibility_matrix(ts):
+    from tinysql_tpu.planner.device import tpu_admissibility
+    join = _find_join(
+        _planned(ts, "select a from t where b in (select k from u)"),
+        "semi")
+    assert join is not None
+    assert tpu_admissibility(join) is None
+    # residual conditions are a CPU-only shape
+    rj = _find_join(
+        _planned(ts, "select a from t where exists (select 1 from u "
+                     "where u.k = t.b and u.v < t.a)"), "semi")
+    assert rj is not None
+    assert tpu_admissibility(rj) is not None
+    assert not rj.use_tpu
+
+
+def test_pd2xx_covers_semi_anti_joins(ts):
+    """qlint PD2xx and the device enforcer share tpu_admissibility, so
+    a correctly-placed semi/anti plan is clean and a hand-misplaced one
+    is a PD201."""
+    from tinysql_tpu.analysis.plan_device import check_plan
+    for sql in ("select a from t where b in (select k from u)",
+                "select a from t where b not in (select k from u)"):
+        phys = _planned(ts, sql)
+        assert check_plan(phys, where=sql) == []
+    phys = _planned(
+        ts, "select a from t where exists (select 1 from u "
+            "where u.k = t.b and u.v < t.a)")
+    join = _find_join(phys, "semi")
+    join.use_tpu = True  # misplace: residual conds are inadmissible
+    diags = [d for d in check_plan(phys, where="forced")
+             if d.rule == "PD201"]
+    assert diags, "PD201 must flag an inadmissible semi join placement"
+
+
+def test_semi_join_plan_digest_stable_and_queryable(ts):
+    """statements_summary must aggregate semijoin executions under ONE
+    plan digest whose sample plan shows the operator."""
+    from tinysql_tpu.obs import stmtsummary
+    stmtsummary.STORE.reset()
+    sql = "select a from t where b in (select k from u) order by a"
+    digests = set()
+    for _ in range(2):
+        _q(ts, sql)
+        digests.add(ts.last_query_stats.plan_digest)
+    assert len(digests) == 1
+    rows = _q(ts, "select exec_count, sample_plan from "
+                  "information_schema.statements_summary "
+                  f"where plan_digest = '{digests.pop()}'")
+    assert len(rows) == 1 and rows[0][0] == 2
+    assert "semi join" in rows[0][1]
+
+
+# =========================================================================
+# layer 3: UPDATE semantics (chaos drivers in test_chaos.py)
+# =========================================================================
+
+@pytest.fixture()
+def us():
+    s = new_session()
+    s.execute("create database uw")
+    s.execute("use uw")
+    s.execute("set @@tidb_use_tpu = 0")
+    s.execute("create table t (a int primary key, b int, "
+              "c varchar(8), d int not null default 0, "
+              "unique key ub (b))")
+    s.execute("insert into t values (1, 10, 'x', 0), "
+              "(2, 20, 'y', 0), (3, null, 'z', 0)")
+    return s
+
+
+def test_update_basic_and_affected_rows(us):
+    us.execute("update t set c = 'q' where a <= 2")
+    assert us.last_affected == 2
+    assert _q(us, "select a, c from t order by a") == \
+        [[1, "q"], [2, "q"], [3, "z"]]
+    # no-op assignment writes (and counts) nothing
+    us.execute("update t set c = 'q' where a = 1")
+    assert us.last_affected == 0
+
+
+def test_update_expression_sees_left_to_right_assignments(us):
+    # MySQL: each assignment sees values already assigned to its left
+    us.execute("update t set b = 100, d = b + 1 where a = 1")
+    assert _q(us, "select b, d from t where a = 1") == [[100, 101]]
+
+
+def test_update_where_subquery(us):
+    # the decorrelated read path serves the UPDATE scan too
+    us.execute("create table keys_ (k int)")
+    us.execute("insert into keys_ values (10), (99)")
+    us.execute("update t set d = 7 "
+               "where b in (select k from keys_)")
+    assert us.last_affected == 1
+    assert _q(us, "select a from t where d = 7") == [[1]]
+
+
+def test_update_pk_move_and_duplicate_errors(us):
+    us.execute("update t set a = 9 where a = 3")
+    assert _q(us, "select count(*) from t where a = 9") == [[1]]
+    assert _q(us, "select count(*) from t where a = 3") == [[0]]
+    with pytest.raises(Exception, match="[Dd]uplicate"):
+        us.execute("update t set a = 1 where a = 2")
+    with pytest.raises(Exception, match="[Dd]uplicate"):
+        us.execute("update t set b = 10 where a = 2")  # unique key ub
+    with pytest.raises(Exception, match="null"):
+        us.execute("update t set d = null where a = 1")
+    # failed statements changed nothing
+    assert _q(us, "select a, b from t order by a") == \
+        [[1, 10], [2, 20], [9, None]]
+
+
+def test_update_pk_move_unique_conflict_is_statement_time(us):
+    # moving the PK AND colliding on a unique key must 1062 at
+    # STATEMENT time (statement-level rollback), not at commit prewrite
+    us.execute("begin")
+    with pytest.raises(Exception, match="[Dd]uplicate"):
+        us.execute("update t set a = 9, b = 20 where a = 1")
+    us.execute("commit")  # txn stays healthy: the statement rolled back
+    assert _q(us, "select a, b from t order by a") == \
+        [[1, 10], [2, 20], [3, None]]
+
+
+def test_update_txn_rollback(us):
+    us.execute("begin")
+    us.execute("update t set b = 77 where a = 1")
+    assert _q(us, "select b from t where a = 1") == [[77]]
+    us.execute("rollback")
+    assert _q(us, "select b from t where a = 1") == [[10]]
+
+
+def test_update_parse_errors(us):
+    for bad in ("update t", "update t set", "update t set a",
+                "update t where a=1"):
+        with pytest.raises(Exception):
+            us.execute(bad)
+
+
+# =========================================================================
+# layer 4: TPC-H Q5/Q10/Q18 end-to-end vs sqlite
+# =========================================================================
+
+SF = 0.02
+_canon = tpch.canon_rows
+
+
+@pytest.fixture(scope="module")
+def wq():
+    data = tpch.generate(SF)
+    s = new_session()
+    tpch.load(s, sf=SF, data=data)
+    s.execute("use tpch")
+    s.execute("set @@tidb_tpu_min_rows = 1")
+    lite = tpch.sqlite_mirror(data)
+    want = {q: _canon(lite.execute(sql).fetchall())
+            for q, sql in tpch.WORKLOAD.items()}
+    lite.close()
+    return s, want
+
+
+def test_workload_queries_match_sqlite_both_tiers(wq):
+    s, want = wq
+    for tier in (0, 1):
+        s.execute(f"set @@tidb_use_tpu = {tier}")
+        for q, sql in tpch.WORKLOAD.items():
+            got = _canon(s.query(sql).rows)
+            assert got == want[q], (q, tier, got[:3], want[q][:3])
+
+
+def test_workload_second_run_compiles_nothing(wq):
+    s, _ = wq
+    s.execute("set @@tidb_use_tpu = 1")
+    for q, sql in tpch.WORKLOAD.items():
+        s.query(sql)  # warm the literal-parameterized family
+        snap = kernels.stats_snapshot()
+        s.query(sql)
+        d = kernels.stats_delta(snap)
+        assert d.get("progcache_misses", 0) == 0, (q, d)
+
+
+def test_q5_explain_analyze_semijoin_device_counters(wq):
+    """Acceptance: EXPLAIN ANALYZE on Q5 shows the semijoin/join-chain
+    operators with device counters."""
+    s, _ = wq
+    s.execute("set @@tidb_use_tpu = 1")
+    rows = s.query("explain analyze " + tpch.Q5).rows
+    flat = "\n".join(str(r) for r in rows)
+    assert "semi join" in flat
+    joins = [r for r in rows if "HashJoin" in str(r[0])]
+    assert len(joins) >= 4, flat  # the 5-way chain + the semijoin
+    # at least one operator reports device work (device or host twin)
+    assert "dispatches" in flat, flat
+
+
+def test_q5_semijoin_sinks_to_nation(wq):
+    """The semi-join sink rule lands the region membership next to
+    nation (25 rows), not on top of the 5-way join product."""
+    s, _ = wq
+    s.execute("set @@tidb_use_tpu = 1")
+    rows = s.query("explain " + tpch.Q5).rows
+    semi_at = next(i for i, r in enumerate(rows)
+                   if "semi join" in str(r[3]))
+    below = "\n".join(str(r) for r in rows[semi_at + 1:])
+    assert "table:nation" in below and "table:region" in below
+    # the semijoin's subtree must NOT swallow the fact chain
+    assert "table:lineitem" not in below
+
+
+def test_tpch_loader_pk_predicates(wq):
+    """Regression (PR 9 find): the bulk loader must materialize integer
+    PK values as replica handles, so PK predicates select real rows."""
+    s, _ = wq
+    for tier in (0, 1):
+        s.execute(f"set @@tidb_use_tpu = {tier}")
+        assert s.query("select count(*) from lineitem "
+                       "where l_id <= 10").rows == [[10]]
+        assert s.query("select l_id from lineitem "
+                       "where l_id = 7").rows == [[7]]
+        assert s.query("select count(*) from nation "
+                       "where n_nationkey = 0").rows == [[1]]
+
+
+def test_writes_on_bulk_loaded_table_preserve_other_rows():
+    """Regression: bulk_load writes ONLY the replica; a write statement
+    used to commit through the (empty) row store, invalidate the
+    replica, and silently drop every row it didn't touch.  The write
+    path must materialize the row store first (ensure_row_store), and
+    writes must then compose."""
+    data = tpch.generate(0.002)
+    s = new_session()
+    tpch.load(s, sf=0.002, data=data)
+    s.execute("use tpch")
+    assert s.query("select count(*) from nation").rows == [[25]]
+    s.execute("update nation set n_name = 'NIHON' "
+              "where n_name = 'JAPAN'")
+    assert s.last_affected == 1
+    # THE bug: every other nation used to vanish here
+    assert s.query("select count(*) from nation").rows == [[25]]
+    assert s.query("select n_name from nation "
+                   "where n_nationkey = 12").rows == [["NIHON"]]
+    s.execute("delete from nation where n_name = 'NIHON'")
+    assert s.query("select count(*) from nation").rows == [[24]]
+    s.execute("insert into nation values (25, 'ATLANTIS', 2)")
+    assert s.query("select count(*) from nation").rows == [[25]]
+    # PK-move duplicate detection needs the materialized row store too
+    with pytest.raises(Exception, match="[Dd]uplicate"):
+        s.execute("update nation set n_nationkey = 0 "
+                  "where n_nationkey = 25")
+    # a fresh session (fresh snapshot) agrees
+    s2 = new_session(s.storage, db="tpch")
+    assert s2.query("select count(*) from nation").rows == [[25]]
+
+
+def test_bulk_write_inside_open_transaction():
+    """Materialization backfills at the replica's BUILD timestamp, so a
+    transaction opened BEFORE the first write still reads a consistent
+    snapshot mid-txn."""
+    data = tpch.generate(0.002)
+    s = new_session()
+    tpch.load(s, sf=0.002, data=data)
+    s.execute("use tpch")
+    s.execute("begin")
+    s.execute("update region set r_name = 'ASIA-PAC' "
+              "where r_name = 'ASIA'")
+    assert s.query("select count(*) from region").rows == [[5]]
+    s.execute("rollback")
+    assert s.query("select count(*) from region").rows == [[5]]
+    assert s.query("select count(*) from region "
+                   "where r_name = 'ASIA'").rows == [[1]]
+
+
+def test_update_set_qualifier_must_match_table(us):
+    # MySQL 1054: a SET target qualified with anything but the table's
+    # visible name (the alias, once aliased) is an unknown column —
+    # never a silent write to the lookalike column
+    with pytest.raises(Exception, match="Unknown column"):
+        us.execute("update t set zzz.b = 5 where a = 1")
+    with pytest.raises(Exception, match="Unknown column"):
+        us.execute("update t as x set t.b = 5 where a = 1")
+    us.execute("update t as x set x.b = 55 where a = 1")
+    assert _q(us, "select b from t where a = 1") == [[55]]

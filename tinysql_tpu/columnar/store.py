@@ -156,7 +156,14 @@ def bulk_load(storage, info: TableInfo,
         assert len(v) == n and len(m) == n
         cols[c.id] = (v, m)
     if handles is None:
-        handles = np.arange(1, (n or 0) + 1, dtype=np.int64)
+        # a clustered int PK *is* the handle: the replica's handle array
+        # must carry the PK VALUES, not a synthetic row number —
+        # otherwise PK predicates (handle ranges) select the wrong rows
+        pk = info.get_pk_handle_col()
+        if pk is not None and pk.name in data:
+            handles = np.asarray(data[pk.name], dtype=np.int64)
+        else:
+            handles = np.arange(1, (n or 0) + 1, dtype=np.int64)
     ver = table_data_version(storage, info.id)
     rep = ColumnarTable(info.id, n or 0, storage.current_version(), ver,
                         cols, np.asarray(handles, dtype=np.int64))
@@ -167,6 +174,59 @@ def bulk_load(storage, info: TableInfo,
     from ..statistics.table_stats import set_count
     set_count(storage, info.id, n or 0)
     return n or 0
+
+
+def ensure_row_store(storage, info: TableInfo) -> int:
+    """Materialize a bulk-loaded table into the MVCC row store before
+    its first WRITE statement.  ``bulk_load`` writes ONLY the columnar
+    replica; a write statement commits through the row store and bumps
+    the table version — invalidating the replica and silently dropping
+    every row the write didn't touch.  This backfills the replica's
+    rows (indices included, via the Table write path) directly into
+    MVCC at the replica's BUILD timestamp: the rows logically existed
+    since the bulk load, every snapshot >= built_ts already serves them
+    from the replica, and open transactions (start_ts > built_ts) see
+    values identical to what they were reading — so no version bump and
+    the replica stays valid until the write's own commit.  No-op unless
+    the table is replica-only (valid replica, empty row store); returns
+    the number of rows installed."""
+    from ..catalog.table import Table
+    from ..codec import tablecodec
+    from ..kv.txn import Transaction
+    rep = store_of(storage).get(info.id)
+    if rep is None or rep.n_rows == 0:
+        return 0
+    if rep.data_version != table_data_version(storage, info.id):
+        return 0  # stale replica: the row store is already the truth
+    lo, hi = tablecodec.record_range(info.id)
+    from ..kv.errors import KeyIsLocked
+    try:
+        if storage.mvcc.scan(lo, hi, storage.current_version(), limit=1):
+            return 0  # row store already populated
+    except KeyIsLocked:
+        # an in-flight writer holds a record lock — every writer passes
+        # through this gate first, so materialization already ran
+        return 0
+    tbl = Table(info)
+    scratch = Transaction(storage, rep.built_ts)
+    n_cols = len(info.columns)
+    pub = [(c, rep.columns.get(c.id)) for c in info.public_columns()]
+    handles = rep.handles
+    for i in range(rep.n_rows):
+        row = [None] * n_cols
+        for c, pair in pub:
+            if pair is None:
+                continue
+            v, m = pair
+            if not m[i]:
+                x = v[i]
+                row[c.offset] = str(x) if v.dtype.kind == "U" \
+                    else x.item()
+        tbl.add_record(scratch, row, handle=int(handles[i]))
+    # the scratch buffer holds only puts (add_record never deletes), so
+    # every entry backfills verbatim — row records and index entries
+    return storage.mvcc.backfill(list(scratch.us.buffer._m.items()),
+                                 rep.built_ts)
 
 
 def hydrate_from_scan(storage, txn, info: TableInfo,

@@ -987,7 +987,7 @@ class _JoinNode:
     def compile(plan: PhysicalHashJoin, ctx: _Ctx):
         if isinstance(plan, PhysicalMergeJoin):
             return None
-        if plan.tp not in ("inner", "left"):
+        if plan.tp not in ("inner", "left", "semi", "anti"):
             return None
         if not plan.left_keys or plan.other_conditions \
                 or len(plan.left_keys) != len(plan.right_keys):
@@ -1003,6 +1003,30 @@ class _JoinNode:
         nk = len(plan.left_keys)
         lk, rk = plan.left_keys[0], plan.right_keys[0]
         mult = False
+        if plan.tp in ("semi", "anti"):
+            # semi/anti = a VALIDITY filter on the probe view (no shape
+            # change, no gather): the build side's dense pos-table
+            # answers membership.  One row per key in that table means
+            # the build must be planner-proven unique; the NOT IN
+            # null-aware ladder needs build-shape scalars the fused
+            # program doesn't carry — both fall to the per-op executor.
+            if nk != 1 or getattr(plan, "null_aware", False) \
+                    or not getattr(plan, "right_unique", False):
+                return None
+            build = _compile_node(plan.children[1], ctx)
+            if build is None:
+                return None
+            if not _has_build_key_info(build, rk):
+                _close_node(build)
+                return None
+            probe = _compile_node(plan.children[0], ctx)
+            if probe is None:
+                _close_node(build)
+                return None
+            return _JoinNode(probe, build, [lk], [rk], plan.tp, True,
+                             plan, mesh=ctx.mesh,
+                             session_vars=getattr(ctx.exec_ctx,
+                                                  "session_vars", None))
         if nk > 1:
             # multi-key: composite lane over a dense range, leaf/sel
             # build sides only; non-unique key sets ride the same CSR
@@ -1049,11 +1073,52 @@ class _JoinNode:
         ptv = self.probe.prepare(pb)
         if ptv is None:
             return None
+        if self.tp in ("semi", "anti"):
+            return self._prepare_semi(pb, btv, ptv)
         if self.mult:
             return self._prepare_mult(pb, btv, ptv)
         if self.nk > 1:
             return self._prepare_unique_multi(pb, btv, ptv)
         return self._prepare_unique(pb, btv, ptv)
+
+    # ---- semi / anti: membership folds into probe validity -------------
+
+    def _prepare_semi(self, pb, btv, ptv) -> Optional[_TView]:
+        """Semi/anti join as a validity AND over the probe view: probe
+        key -> build pos-table -> live?  The probe's pairs pass through
+        untouched, so an entire Q5-style join chain with an interleaved
+        semijoin stays ONE traced program."""
+        info = _prepare_build_key_info(self.build, self.build_key, pb)
+        if info is None:
+            return None
+        lo, hi, it, tbl_len = info
+        jn = _jn()
+        nb = ptv.nb
+        nbb = btv.nb
+        pk_slot = self.probe_key.index
+        anti = self.tp == "anti"
+        pt = ParamTable()
+        pt.add_int(lo)
+        pt.add_int(hi)
+        ip, fp = pb.params(pt)
+        pb.key(("semijoin", anti, nb, nbb, tbl_len, pk_slot,
+                len(ptv.meta), len(btv.meta)))
+
+        def emit(args):
+            bvalid, _bpairs = btv.emit(args)
+            pvalid, ppairs = ptv.emit(args)
+            kp, knull = ppairs[pk_slot]
+            pr = (args[ip], args[fp])
+            lo_p, hi_p = pr[0][0], pr[0][1]
+            inr = (kp >= lo_p) & (kp <= hi_p) & ~knull
+            pos0 = jn.clip(kp - lo_p, 0, tbl_len - 1)
+            pos = jn.where(inr, args[it][pos0].astype(jn.int64), -1)
+            match = (pos >= 0) & bvalid[jn.clip(pos, 0, nbb - 1)]
+            # anti (NOT EXISTS shape, never null-aware here): a NULL
+            # probe key matches nothing and therefore SURVIVES
+            valid_out = pvalid & (~match if anti else match)
+            return valid_out, list(ppairs)
+        return _TView(emit, nb, ptv.meta)
 
     # ---- multi-key unique build: composite lane + dense table ----------
 
@@ -1896,6 +1961,12 @@ def _has_build_key_info(node, build_key) -> bool:
         return True  # bounds checked at prepare time
     if isinstance(node, (_SelNode,)):
         return _has_build_key_info(node.child, build_key)
+    if isinstance(node, _ProjNode):
+        # identity output: row space unchanged, key lives at the child
+        # slot the projection reads (a subquery's final projection)
+        e = node.exprs[build_key.index]
+        return isinstance(e, ExprColumn) \
+            and _has_build_key_info(node.child, e)
     return False
 
 
@@ -1914,6 +1985,11 @@ def _prepare_build_key_info(node, build_key, pb: _PipeBuilder):
         return lo, hi, pb.add(d), int(tbl.shape[0])
     if isinstance(node, _SelNode):
         return _prepare_build_key_info(node.child, build_key, pb)
+    if isinstance(node, _ProjNode):
+        e = node.exprs[build_key.index]
+        if not isinstance(e, ExprColumn):
+            return None
+        return _prepare_build_key_info(node.child, e, pb)
     if isinstance(node, _ReplicaLeaf):
         rep = node.replica()
         if rep is None:

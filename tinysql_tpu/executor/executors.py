@@ -118,6 +118,7 @@ class TableReaderExec(Executor):
         self._hydrate = None
         dirty = (ctx.txn is not None and ctx.storage is not None
                  and self._txn_dirty(ctx.txn, info.id))
+        self._range_sel = None
         # columnar replica fast path (columnar/store.py) — full scans only;
         # ranged scans seek the row store directly
         if ctx.storage is not None and self.scan.ranges is None:
@@ -128,6 +129,24 @@ class TableReaderExec(Executor):
                 self._replica = rep
                 if self.scan.pushed_agg is not None:
                     self._local_agg = True  # partial agg over replica chunks
+                return
+        # ranged (pk-predicate) scans over a replica-backed table: the
+        # bulk loader writes ONLY the replica, so seeking the row store
+        # would return nothing (the PR 9 "l_id predicates return 0 rows"
+        # bug) — serve the handle ranges from the replica instead.
+        # Pushed aggregates ride the local partial-agg pass over the
+        # gathered rows; pushed topn/limit are pre-cut hints the root
+        # operators reapply, so serving them uncut stays correct.
+        if ctx.storage is not None and self.scan.ranges is not None \
+                and not dirty:
+            from ..columnar.store import replica_for_read
+            rep = replica_for_read(ctx.storage, ctx.txn, info.id)
+            if rep is not None and all(ci.id in rep.columns
+                                       for ci in self._real_cols):
+                self._replica = rep
+                self._range_sel = self._handle_range_positions(rep)
+                if self.scan.pushed_agg is not None:
+                    self._local_agg = True
                 return
         if self.scan.pushed_agg is not None:
             # partial-agg reads: coprocessor path; a dirty txn falls back to
@@ -297,6 +316,28 @@ class TableReaderExec(Executor):
                 continue
             return out
 
+    def _handle_range_positions(self, rep) -> np.ndarray:
+        """Replica row positions whose handle falls in the scan's
+        [lo, hi] handle ranges (inclusive, like _iter_ranges).  Sorted
+        handle arrays (the bulk-load/hydrate norm) binary-search; the
+        general case falls back to boolean masking."""
+        handles = rep.handles
+        sorted_ = rep.memo(("handles_sorted",),
+                           lambda: bool(len(handles) < 2
+                                        or np.all(np.diff(handles) > 0)))
+        parts = []
+        for lo, hi in self.scan.ranges:
+            if sorted_:
+                a = int(np.searchsorted(handles, lo, side="left"))
+                b = int(np.searchsorted(handles, hi, side="right"))
+                parts.append(np.arange(a, b, dtype=np.int64))
+            else:
+                parts.append(np.nonzero((handles >= lo)
+                                        & (handles <= hi))[0])
+        if not parts:
+            return np.empty(0, dtype=np.int64)
+        return np.concatenate(parts)
+
     def take_raw_replica(self):
         """Hand the WHOLE replica to the caller as a zero-copy chunk view
         plus this scan's filters and the replica object (for device-side
@@ -304,7 +345,8 @@ class TableReaderExec(Executor):
         replica contract through this single method).
         Returns (chunk, filters, replica) or (None, None, None)."""
         rep = self._replica
-        if rep is None or self.scan.pushed_agg is not None:
+        if rep is None or self.scan.pushed_agg is not None \
+                or self._range_sel is not None:
             return None, None, None
         from ..chunk import Column as CCol
         cols = []
@@ -324,15 +366,31 @@ class TableReaderExec(Executor):
         observes progress, so one monolithic slice would make a large
         scan uninterruptible and invisible."""
         rep = self._replica
-        if self._pos >= rep.n_rows:
+        sel = self._range_sel
+        n_total = len(sel) if sel is not None else rep.n_rows
+        if self._pos >= n_total:
             self._slice_range = None
             return None
         step = min(self.FAST_CHUNK, max(self.ctx.max_chunk_size, 1))
-        lo, hi = self._pos, min(self._pos + step, rep.n_rows)
+        lo, hi = self._pos, min(self._pos + step, n_total)
         self._pos = hi
-        self._slice_range = (lo, hi)
         from ..chunk import Column as CCol
         cols = []
+        if sel is not None:
+            # ranged serve: gather the in-range rows (fancy-index copy —
+            # pk ranges are small); string-code fast filters don't apply
+            # (_slice_range stays None -> vectorized_filter)
+            self._slice_range = None
+            idx = sel[lo:hi]
+            for c, ci in zip(self.scan.schema.columns, self._decode_cols):
+                if ci is None:
+                    cols.append(CCol.wrap_raw(c.ret_type,
+                                              rep.handles[idx]))
+                else:
+                    v, m = rep.columns[ci.id]
+                    cols.append(CCol.wrap_raw(c.ret_type, v[idx], m[idx]))
+            return Chunk.from_columns(cols)
+        self._slice_range = (lo, hi)
         for c, ci in zip(self.scan.schema.columns, self._decode_cols):
             if ci is None:
                 cols.append(CCol.wrap_raw(c.ret_type, rep.handles[lo:hi]))
@@ -965,10 +1023,16 @@ class HashJoinExec(Executor):
         use_native = self._native_fast_ok() and native.lib() is not None
         # fully-columnar path: native table + no per-row residual conds
         # means build AND probe stay vectorized end to end
-        self._vec_ok = use_native and not plan.other_conditions
+        self._vec_ok = use_native and not plan.other_conditions \
+            and plan.tp not in ("semi", "anti")
         if self._vec_ok:
             self._build_chunk = Chunk(
                 [c.ret_type for c in self.children[1].schema.columns])
+        # NOT IN null semantics need the build side's shape beyond the
+        # hash table: total live rows (NULL keys included) and whether
+        # any live row carried a NULL key
+        self._build_n_live = 0
+        self._build_has_null_key = False
         nat_keys: List[np.ndarray] = []
         while True:
             interrupt.check()
@@ -980,14 +1044,19 @@ class HashJoinExec(Executor):
                 mask = vectorized_filter(plan.right_conditions, chk)
                 chk.set_sel(np.nonzero(mask)[0])
                 chk = chk.compact()
+            self._build_n_live += chk.num_rows()
             if use_native:
                 v, null = plan.right_keys[0].vec_eval(chk)
+                self._build_has_null_key |= bool(np.asarray(null).any())
                 keep = np.nonzero(~null)[0]  # NULL keys never equi-match
                 nat_keys.append(np.asarray(v, dtype=np.int64)[keep])
                 if self._vec_ok:
                     for dst, src in zip(self._build_chunk.columns,
                                         chk.columns):
                         dst.extend_take(src, keep)
+                elif plan.tp in ("semi", "anti") \
+                        and not plan.other_conditions:
+                    pass  # membership probe reads only the hash table
                 else:
                     for i in keep:
                         self._build_rows.append(chk.get_row(int(i)))
@@ -997,6 +1066,7 @@ class HashJoinExec(Executor):
                 row = chk.get_row(i)
                 key = tuple(_semantic(v, null, i, u) for v, null, u in keys)
                 if any(k is None for k in key):
+                    self._build_has_null_key = True
                     continue  # NULL never equi-matches
                 idx = len(self._build_rows)
                 self._build_rows.append(row)
@@ -1013,6 +1083,8 @@ class HashJoinExec(Executor):
             self._build()
         plan = self.plan
         left = self.children[0]
+        if plan.tp in ("semi", "anti"):
+            return self._next_semi(left, plan)
         if self._ht is not None and self._vec_ok:
             return self._next_vec(left, plan)
         out_limit = self.ctx.max_chunk_size
@@ -1061,6 +1133,94 @@ class HashJoinExec(Executor):
                     out.append_row(joined)
                 if not matched and plan.tp == "left":
                     out.append_row(lrow + [None] * self._n_right)
+            if out.num_rows() >= out_limit:
+                return out
+        return out if out.num_rows() else None
+
+    def _next_semi(self, left, plan) -> Optional[Chunk]:
+        """Semi / anti join probe: emit LEFT rows only.  Covers keyed
+        membership (IN / correlated EXISTS), the cartesian degenerate
+        (uncorrelated EXISTS: any live build row matches every probe
+        row), residual other_conditions (correlated non-equi), and the
+        NULL-aware NOT IN ladder:
+
+        - empty build side  -> anti keeps EVERY probe row (NULL too)
+        - any NULL build key (null_aware) -> anti keeps NOTHING
+        - NULL probe key (null_aware) -> dropped; plain anti keeps it
+        """
+        anti = plan.tp == "anti"
+        na = anti and getattr(plan, "null_aware", False)
+        out_limit = self.ctx.max_chunk_size
+        out = Chunk(self.field_types(), cap=out_limit)
+        while True:
+            interrupt.check()
+            chk = left.next()
+            if chk is None:
+                break
+            chk = chk.compact()
+            if plan.left_conditions:
+                mask = vectorized_filter(plan.left_conditions, chk)
+                chk.set_sel(np.nonzero(mask)[0])
+                chk = chk.compact()
+            n = chk.num_rows()
+            if n == 0:
+                continue
+            if self._build_n_live == 0:
+                if anti:  # NOT IN () / NOT EXISTS over empty: all pass
+                    return chk
+                continue
+            if na and self._build_has_null_key:
+                continue  # x NOT IN (..., NULL, ...) is never TRUE
+            if self._ht is not None and not plan.other_conditions:
+                # fully-columnar membership: probe counts -> boolean
+                # keep -> one selection compact, no per-row marshalling
+                v, null = plan.left_keys[0].vec_eval(chk)
+                null = np.asarray(null)
+                _ids, counts = self._ht.probe(
+                    np.asarray(v, dtype=np.int64), ~null)
+                matched = np.asarray(counts) > 0
+                if anti:
+                    keep = ~matched & (~null if na else
+                                       np.ones(n, dtype=bool))
+                else:
+                    keep = matched
+                sel = np.nonzero(keep)[0]
+                if len(sel) == 0:
+                    continue
+                chk.set_sel(sel)
+                return chk.compact()
+            else:
+                if self._ht is not None:
+                    v, null = plan.left_keys[0].vec_eval(chk)
+                    ids, counts = self._ht.probe(
+                        np.asarray(v, dtype=np.int64), ~null)
+                    offsets = np.concatenate(([0], np.cumsum(counts)))
+                    nulls = np.asarray(null)
+                else:
+                    keys = [(*e.vec_eval(chk), _uns_of(e))
+                            for e in plan.left_keys]
+                for i in range(n):
+                    lrow = chk.get_row(i)
+                    if self._ht is not None:
+                        probe_null = bool(nulls[i])
+                        matches = ids[offsets[i]:offsets[i + 1]]
+                    else:
+                        key = tuple(_semantic(v, null, i, u)
+                                    for v, null, u in keys)
+                        probe_null = any(k is None for k in key)
+                        matches = [] if probe_null \
+                            else self._table.get(key, [])
+                    hit = False
+                    for bi in matches:
+                        if plan.other_conditions and not self._others_ok(
+                                lrow + self._build_rows[bi]):
+                            continue
+                        hit = True
+                        break
+                    if na and probe_null:
+                        continue  # NULL NOT IN (non-empty) is NULL
+                    if hit != anti:
+                        out.append_row(lrow)
             if out.num_rows() >= out_limit:
                 return out
         return out if out.num_rows() else None

@@ -409,6 +409,30 @@ def _lower_agg_args(arg_exprs, pt):
     return progs, tuple(pk_parts)
 
 
+def _composite_key_lanes(lkeys, lchk, rkeys, rchk):
+    """Multi-key equi-join keys -> ONE int64 lane per side via JOINT
+    factorization (np.unique over both sides' stacked key tuples):
+    equal tuples get equal codes, distinct tuples distinct codes —
+    collision-free for any value range, unlike stride composites.  A
+    tuple with ANY NULL component never equi-matches (null mask OR).
+    Returns ((lk, lnull), (rk, rnull)) host arrays for the single-key
+    kernels."""
+    def stack(keys, chk):
+        pairs = [e.vec_eval(chk) for e in keys]
+        vals = np.stack([np.asarray(v).astype(np.int64, copy=False)
+                         for v, _ in pairs], axis=1)
+        null = np.zeros(len(vals), dtype=bool)
+        for _, m in pairs:
+            null |= np.asarray(m)
+        return vals, null
+    lv, lnull = stack(lkeys, lchk)
+    rv, rnull = stack(rkeys, rchk)
+    both = np.concatenate([lv, rv], axis=0)
+    _, inv = np.unique(both, axis=0, return_inverse=True)
+    inv = np.asarray(inv, dtype=np.int64).ravel()
+    return (inv[:len(lv)], lnull), (inv[len(lv):], rnull)
+
+
 def _encode_key(e, chk: Chunk) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
     """Evaluate a group/sort key over the chunk -> (codes, null, decode).
     Strings become order-preserving dictionary codes; decode maps code ->
@@ -1465,6 +1489,8 @@ class TPUHashJoinExec(Executor):
             return None
         self._done = True
         plan = self.plan
+        if plan.tp in ("semi", "anti"):
+            return self._semi_next()
         outer = plan.tp == "left"
         # Outer join: ON-clause left conds decide MATCHING (failing outer
         # rows null-extend), so they must NOT fold into lvalid (the kernel
@@ -1510,6 +1536,13 @@ class TPUHashJoinExec(Executor):
         # route keys to host there; device-resident/memoized otherwise
         host_keys = kernels.host_kernels_ok()
 
+        # multi-key equi-joins ride ONE composite int64 lane (joint
+        # factorization over both sides — collision-free by
+        # construction), then the single-key kernels apply unchanged
+        composite = len(plan.left_keys) > 1
+        if composite:
+            stream = False
+
         from .devpipe import BlockPipeline, pipeline_depth
         depth = pipeline_depth(self.ctx.session_vars)
 
@@ -1524,7 +1557,10 @@ class TPUHashJoinExec(Executor):
         side_chks = (lchk, rchk)
         side_reps = (lrep, rrep)
         build_side = 1 - probe_side
-        if stream and depth > 0:
+        if composite:
+            (lk, lnull), (rk, rnull) = _composite_key_lanes(
+                plan.left_keys, lchk, plan.right_keys, rchk)
+        elif stream and depth > 0:
             # build-side ingestion overlaps probe staging (the
             # reference's build/probe worker split, join.go:149/:244
             # completed for real): the build keys' replica-memoized
@@ -1624,7 +1660,8 @@ class TPUHashJoinExec(Executor):
                 probe_side, right_unique, left_unique, outer)
         elif right_unique:
             # unique build side: expansion-free probe, no size sync
-            bs = self._sorted_build(plan.right_keys[0], rchk)
+            bs = (not composite
+                  and self._sorted_build(plan.right_keys[0], rchk))
             if stream:
                 li, ri = stream_match(
                     kernels.unique_join_match, lk, lnull,
@@ -1637,7 +1674,8 @@ class TPUHashJoinExec(Executor):
                     rchk.full_rows(), outer=(plan.tp == "left"),
                     lvalid=lmask, rvalid=rmask, build_sorted=bs)
         elif left_unique and plan.tp == "inner":
-            bs = self._sorted_build(plan.left_keys[0], lchk)
+            bs = (not composite
+                  and self._sorted_build(plan.left_keys[0], lchk))
             if stream:
                 ri, li = stream_match(
                     kernels.unique_join_match, rk, rnull,
@@ -1715,6 +1753,104 @@ class TPUHashJoinExec(Executor):
                     c.null_mask()[idx] = True
         return keep
 
+
+    def _semi_next(self) -> Optional[Chunk]:
+        """Semi / anti join on device: a membership test over the build
+        (subquery) side via kernels.semi_join_match — the sort +
+        searchsorted machinery the join kernels already ride — emitting
+        surviving LEFT rows only.  Under quota pressure the membership
+        derives from the spilled partitioned inner join instead (matches
+        are partition-local under key hashing, so presence/absence is
+        decidable per partition)."""
+        from ..chunk.column import LazyTakeColumn
+        plan = self.plan
+        anti = plan.tp == "anti"
+        null_aware = anti and getattr(plan, "null_aware", False)
+        est = _est_rows_of(plan.children[0]) + _est_rows_of(
+            plan.children[1])
+        sctx = _maybe_spill_ctx(self.ctx, est, 0, _JOIN_ROW_BYTES,
+                                "join")
+        lchk, lmask, lrep = self._side_input(0, plan.left_conditions,
+                                             compact=sctx is None)
+        rchk, rmask, rrep = self._side_input(1, plan.right_conditions,
+                                             compact=sctx is None)
+        if sctx is None:
+            sctx = _maybe_spill_ctx(
+                self.ctx, est,
+                lchk.full_rows() + rchk.full_rows(),
+                _JOIN_ROW_BYTES, "join")
+        host_keys = kernels.host_kernels_ok()
+        if len(plan.left_keys) > 1:
+            (lk, lnull), (rk, rnull) = _composite_key_lanes(
+                plan.left_keys, lchk, plan.right_keys, rchk)
+        else:
+            lk, lnull = self._key_arrays(plan.left_keys[0], lchk, lrep,
+                                         0, host_keys=host_keys)
+            rk, rnull = self._key_arrays(plan.right_keys[0], rchk, rrep,
+                                         1, host_keys=host_keys)
+        if getattr(lk, "dtype", None) != getattr(rk, "dtype", None) \
+                and isinstance(lk, np.ndarray) \
+                and isinstance(rk, np.ndarray):
+            lk = np.asarray(lk).astype(np.float64)
+            rk = np.asarray(rk).astype(np.float64)
+        if sctx is not None:
+            li = self._spill_semi(sctx, (lk, lnull), (rk, rnull), lchk,
+                                  rchk, lmask, rmask, anti, null_aware)
+        else:
+            li = kernels.semi_join_match(
+                (lk, lnull), lchk.full_rows(), (rk, rnull),
+                rchk.full_rows(), anti=anti, null_aware=null_aware,
+                lvalid=lmask, rvalid=rmask)
+        if len(li) == 0:
+            return None
+        cols: List[CCol] = [LazyTakeColumn(c, li) for c in lchk.columns]
+        return Chunk.from_columns(cols)
+
+    def _spill_semi(self, sctx, lpair, rpair, lchk, rchk, lmask, rmask,
+                    anti: bool, null_aware: bool) -> np.ndarray:
+        """Spill-mode membership: the empty/NULL-set ladder decides
+        host-side; otherwise the partitioned inner join supplies matched
+        probe rows (equal keys colocate per partition, so membership is
+        partition-local) and semi/anti derive from the matched set."""
+        from ..ops import spill
+        lk = np.asarray(lpair[0])
+        lnull = np.asarray(lpair[1], dtype=bool)
+        rk = np.asarray(rpair[0])
+        rnull = np.asarray(rpair[1], dtype=bool)
+        n_left = lchk.full_rows()
+        n_right = rchk.full_rows()
+        lv = np.ones(n_left, dtype=bool) if lmask is None \
+            else np.asarray(lmask[:n_left], dtype=bool)
+        rv = np.ones(n_right, dtype=bool) if rmask is None \
+            else np.asarray(rmask[:n_right], dtype=bool)
+        if int(rv.sum()) == 0:
+            sctx.close()
+            keep = lv if anti else np.zeros(n_left, dtype=bool)
+            return np.nonzero(keep)[0].astype(np.int64)
+        if anti and null_aware and bool((rv & rnull[:n_right]).any()):
+            sctx.close()
+            return np.empty(0, dtype=np.int64)
+        unique_build = getattr(self.plan, "right_unique", False)
+
+        def match(pp, n_p, bp, n_b):
+            if unique_build:
+                return kernels.unique_join_match(pp, n_p, bp, n_b,
+                                                 outer=False)
+            return kernels.join_match(pp, n_p, bp, n_b, outer=False)
+
+        with sctx:
+            mi, _ = spill.partitioned_join(
+                sctx, (lk, lnull), n_left, (rk, rnull), n_right, match,
+                outer=False, probe_valid=lmask, build_valid=rmask)
+        matched = np.zeros(n_left, dtype=bool)
+        matched[mi] = True
+        if anti:
+            keep = lv & ~matched
+            if null_aware:
+                keep &= ~lnull[:n_left]
+        else:
+            keep = matched
+        return np.nonzero(keep)[0].astype(np.int64)
 
     def _spill_join(self, sctx, lpair, rpair, lchk, rchk, lmask, rmask,
                     probe_side: int, right_unique: bool,
@@ -2080,12 +2216,8 @@ def _build_tpu_op_inner(plan) -> Optional[Executor]:
     if isinstance(plan, PhysicalHashAgg):
         return TPUHashAggExec(plan, build_executor(plan.children[0], True))
     if isinstance(plan, PhysicalHashJoin):
-        if len(plan.left_keys) != 1:
-            # multi-key joins ride devpipe composite lanes; the per-op
-            # kernel is single-key — CPU join over TPU-capable children
-            from .executors import HashJoinExec
-            return HashJoinExec(plan, build_executor(plan.children[0], True),
-                                build_executor(plan.children[1], True))
+        # multi-key joins collapse into ONE composite int64 lane (joint
+        # factorization) and ride the same single-key kernels
         return TPUHashJoinExec(plan, build_executor(plan.children[0], True),
                                build_executor(plan.children[1], True))
     if isinstance(plan, PhysicalTopN):

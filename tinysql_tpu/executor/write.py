@@ -156,6 +156,72 @@ class InsertExec:
         tbl.add_record(txn, row)
 
 
+class UpdateExec:
+    """reference: executor/update.go UpdateExec — read-modify-write over
+    the scanned qualifying rows (the plan carries the hidden handle
+    column), riding the SAME row-store + 2PC prewrite/commit path as
+    INSERT/DELETE, so every transactional guarantee (and failpoint) of
+    that path covers UPDATE for free."""
+
+    def __init__(self, session, info: TableInfo, assigns):
+        # assigns: [(ColumnInfo, Expression bound to scan-schema offsets)]
+        self.session = session
+        self.info = info
+        self.assigns = assigns
+        self.affected = 0
+
+    def execute(self, txn, rows: List[list]) -> int:
+        tbl = Table(self.info, get_allocator(self.session.storage,
+                                             self.info.id))
+        pk = self.info.get_pk_handle_col()
+        for row in rows:
+            handle = row[-1]
+            old = row[:-1]
+            new = list(old)
+            for ci, expr in self.assigns:
+                # MySQL single-table UPDATE evaluates assignments left to
+                # right, each seeing the values already assigned
+                v = expr.eval(new + [handle])
+                if v is None and ci.ft.not_null:
+                    raise WriteError(f"Column '{ci.name}' cannot be null")
+                new[ci.offset] = cast_datum(v, ci.ft) if v is not None \
+                    else None
+            if new == old:
+                continue  # no-op assignment: nothing to write
+            if pk is not None and new[pk.offset] != handle:
+                # handle change: the row MOVES in the keyspace
+                new_handle = int(new[pk.offset])
+                try:
+                    txn.get(tablecodec.encode_row_key(self.info.id,
+                                                      new_handle))
+                    raise DuplicateKeyError(self.info.name, "PRIMARY",
+                                            [new_handle])
+                except KeyNotFound:
+                    pass
+                # eager 1062 at STATEMENT time, same as the in-place
+                # branch — not deferred to commit-time prewrite
+                self._check_unique(txn, tbl, old, new, handle)
+                tbl.remove_record(txn, handle, old)
+                tbl.add_record(txn, new)
+            else:
+                self._check_unique(txn, tbl, old, new, handle)
+                tbl.update_record(txn, handle, old, new)
+            self.affected += 1
+        return self.affected
+
+    def _check_unique(self, txn, tbl: Table, old, new,
+                      handle: int) -> None:
+        for idx in tbl.indices:
+            if not idx.info.unique:
+                continue
+            if idx._index_values(old) == idx._index_values(new):
+                continue  # key unchanged: no new conflict possible
+            h = idx.exists_conflict(txn, new)
+            if h is not None and h != handle:
+                raise DuplicateKeyError(self.info.name, idx.info.name,
+                                        idx._index_values(new))
+
+
 class DeleteExec:
     """reference: executor/delete.go — scan qualifying rows (plan includes
     the hidden handle column), remove each."""

@@ -111,6 +111,27 @@ class MVCCStore:
                 i += 1
         return out
 
+    # ---- bulk-load backfill -------------------------------------------
+    def backfill(self, kvs: List[Tuple[bytes, bytes]], ts: int) -> int:
+        """Install committed PUT records directly at a HISTORICAL
+        commit_ts, bypassing Percolator — the columnar bulk-load
+        materialization path (columnar/store.py ensure_row_store): the
+        rows logically existed since the bulk load's timestamp, so
+        every snapshot >= ts must see them, exactly as the replica
+        already serves them.  Keys with any existing write or a live
+        lock are skipped untouched (they are already row-store-real);
+        returns the number installed."""
+        n = 0
+        with self._mu:
+            for key, value in kvs:
+                e = self._entry(key)
+                if e.lock is not None or e.writes:
+                    continue
+                e.data[ts] = value
+                e.writes.append((ts, W_PUT, ts))
+                n += 1
+        return n
+
     # ---- percolator write protocol ------------------------------------
     def prewrite(self, mutations: List[Mutation], primary: bytes,
                  start_ts: int, ttl_ms: int) -> None:

@@ -1736,6 +1736,140 @@ def unique_join_match(lkey, n_left: int, rkey, n_right: int,
     return li[:n_out], ri[:n_out]
 
 
+def _semi_kernel(anti: bool, null_aware: bool):
+    """Membership test over the build side (sort + searchsorted — the
+    same machinery the join kernels ride): per probe row, does ANY live
+    build row share its key?  Semi keeps members; anti keeps
+    non-members, with the NOT IN three-valued ladder when null_aware:
+    an empty build side keeps EVERY valid probe row, any NULL build key
+    keeps none, and a NULL probe key never passes."""
+    j = jax()
+    jn = jnp()
+
+    def kernel(lk, ln, lvalid, rk, rn, rvalid):
+        r_live = rvalid & ~rn
+        sentinel = (jn.iinfo(jn.int64).max if rk.dtype == jn.int64
+                    else jn.inf)
+        rk_clean = jn.where(r_live, rk, sentinel)
+        # live rows first within an equal-key run so a live key equal to
+        # the sentinel is still FOUND (same trick as the join kernels)
+        dead = (~r_live).astype(jn.int8)
+        rperm = jn.lexsort([dead, rk_clean])
+        rs = rk_clean[rperm]
+        pos = jn.searchsorted(rs, lk, side="left")
+        pos_c = jn.clip(pos, 0, rs.shape[0] - 1)
+        l_live = lvalid & ~ln
+        member = (l_live & (pos < rs.shape[0]) & (rs[pos_c] == lk)
+                  & r_live[rperm][pos_c])
+        if not anti:
+            keep = member
+        else:
+            # build-side shape scalars (traced): total live rows incl.
+            # NULL keys, and whether any live row's key IS NULL
+            n_build = jn.sum(rvalid.astype(jn.int64))
+            if null_aware:
+                has_null = jn.any(rvalid & rn)
+                keep = jn.where(
+                    n_build == 0, lvalid,
+                    jn.where(has_null, False, l_live & ~member))
+            else:
+                keep = jn.where(n_build == 0, lvalid, lvalid & ~member)
+        return keep, jn.sum(keep.astype(jn.int64))
+
+    return counted_jit(kernel)
+
+
+def _semi_pick_kernel(ob: int, nlb: int):
+    """Compact the kept probe rows device-side to a static bucket — one
+    packed download of the surviving indices."""
+    j = jax()
+    jn = jnp()
+    schema: list = []
+
+    def kernel(keep):
+        li = jn.nonzero(keep, size=ob, fill_value=nlb)[0]
+        return pack_arrays(schema, [li])
+
+    return counted_jit(kernel), schema
+
+
+def _np_semi_match(lk, ln, lv, rk, rn, rv, anti: bool, null_aware: bool):
+    """Host twin of the semi/anti membership kernel: identical keep
+    semantics and probe-order output."""
+    n_build = int(rv.sum())
+    if n_build == 0:
+        # empty subquery: semi keeps nothing, anti keeps every valid
+        # probe row (NULL probe keys included — NOT IN () is TRUE)
+        keep = lv if anti else np.zeros(len(lk), dtype=bool)
+        return np.nonzero(keep)[0].astype(np.int64)
+    if anti and null_aware and bool((rv & rn).any()):
+        return np.empty(0, dtype=np.int64)  # NULL in the build set
+    bk = rk[rv & ~rn]
+    l_live = lv & ~ln
+    member = np.zeros(len(lk), dtype=bool)
+    if len(bk):
+        member[l_live] = np.isin(lk[l_live], bk)
+    if anti:
+        keep = lv & ~member & (~ln if null_aware else True)
+    else:
+        keep = member
+    return np.nonzero(keep)[0].astype(np.int64)
+
+
+def semi_join_match(lkey, n_left: int, rkey, n_right: int,
+                    anti: bool = False, null_aware: bool = False,
+                    lvalid: np.ndarray = None,
+                    rvalid: np.ndarray = None) -> np.ndarray:
+    """Probe-row indices surviving a semi (membership) or anti
+    (non-membership) test against the build side, in probe order.
+    Same host-vs-device routing contract as join_match: host numpy twin
+    on the CPU backend, padded-bucket device kernels otherwise (the
+    progcache key is shape-only, so literal changes stay cache HITs)."""
+    if (isinstance(lkey[0], np.ndarray) and isinstance(rkey[0], np.ndarray)
+            and host_kernels_ok()):
+        lv = np.ones(n_left, dtype=bool) if lvalid is None \
+            else np.asarray(lvalid[:n_left], dtype=bool)
+        rv = np.ones(n_right, dtype=bool) if rvalid is None \
+            else np.asarray(rvalid[:n_right], dtype=bool)
+        host_dispatch()
+        return _np_semi_match(
+            np.asarray(lkey[0])[:n_left],
+            np.asarray(lkey[1])[:n_left], lv,
+            np.asarray(rkey[0])[:n_right],
+            np.asarray(rkey[1])[:n_right], rv, anti, null_aware)
+    jn = jnp()
+    nlb, nrb = bucket(max(n_left, 1)), bucket(max(n_right, 1))
+    lv = np.zeros(nlb, dtype=bool)
+    lv[:n_left] = lvalid if lvalid is not None else True
+    rv = np.zeros(nrb, dtype=bool)
+    rv[:n_right] = rvalid if rvalid is not None else True
+
+    def dev(a, n, fill):
+        if isinstance(a, np.ndarray):
+            return jn.asarray(pad1(a, n, fill))
+        assert a.shape[0] == n, (a.shape, n)
+        return a
+    lk = dev(lkey[0], nlb, 0)
+    ln = dev(lkey[1], nlb, True)
+    rk = dev(rkey[0], nrb, 0)
+    rn = dev(rkey[1], nrb, True)
+    if lk.dtype != rk.dtype:
+        lk = lk.astype(jn.float64)
+        rk = rk.astype(jn.float64)
+    ck = ("semi_match", anti, null_aware, nlb, nrb,
+          str(lk.dtype), str(rk.dtype))
+    fn = progcache.get(ck, lambda: _semi_kernel(anti, null_aware))
+    keep, n_keep = fn(lk, ln, jn.asarray(lv), rk, rn, jn.asarray(rv))
+    n_out = int(n_keep)  # one scalar sync
+    if n_out == 0:
+        return np.empty(0, dtype=np.int64)
+    ob = min(bucket(n_out), nlb)
+    pk = ("semi_pick", ob, nlb)
+    pfn, schema = progcache.get(pk, lambda: _semi_pick_kernel(ob, nlb))
+    (li,) = unpack_flat(pfn(keep), schema)
+    return li[:n_out]
+
+
 # =========================================================================
 # sort / top-k
 # =========================================================================

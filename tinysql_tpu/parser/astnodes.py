@@ -140,6 +140,25 @@ class ParenExpr(ExprNode):
     expr: ExprNode
 
 
+@dataclass
+class SubqueryExpr(ExprNode):
+    """(SELECT ...) used as an expression: a scalar subquery in a
+    comparison, or the list side of IN (reference: ast/expressions.go
+    SubqueryExpr).  The inner statement is NOT walked by walk_expr —
+    its aggregates/columns belong to the subquery's own scope."""
+    select: "SelectStmt" = None
+
+
+@dataclass
+class ExistsExpr(ExprNode):
+    """[NOT] EXISTS (SELECT ...) (reference: ast/expressions.go
+    ExistsSubqueryExpr).  Decorrelates into a semi/anti join when it is
+    a top-level WHERE conjunct; evaluates eagerly (uncorrelated only)
+    elsewhere."""
+    select: "SelectStmt" = None
+    negated: bool = False
+
+
 # ---------------- table refs -----------------------------------------------
 
 @dataclass
@@ -205,6 +224,16 @@ class InsertStmt(StmtNode):
 @dataclass
 class DeleteStmt(StmtNode):
     table: TableSource = None
+    where: Optional[ExprNode] = None
+
+
+@dataclass
+class UpdateStmt(StmtNode):
+    """UPDATE t SET c = expr [, ...] [WHERE ...] (reference: ast/dml.go
+    UpdateStmt, single-table form — a genuine extension past the
+    reference's reduced surface, ROADMAP item 5)."""
+    table: TableSource = None
+    assignments: List[Assignment] = field(default_factory=list)
     where: Optional[ExprNode] = None
 
 

@@ -122,6 +122,8 @@ class Parser:
             return self._insert_stmt(replace=True)
         if kw == "delete":
             return self._delete_stmt()
+        if kw == "update":
+            return self._update_stmt()
         if kw == "create":
             return self._create_stmt()
         if kw == "drop":
@@ -380,6 +382,38 @@ class Parser:
         if self._accept_kw("default"):
             return DefaultExpr()
         return self._expr()
+
+    def _update_stmt(self) -> UpdateStmt:
+        """UPDATE t [AS a] SET col = expr [, ...] [WHERE ...]."""
+        self._advance()  # update
+        tn = self._table_name()
+        as_name = ""
+        if self._accept_kw("as"):
+            as_name = self._ident()
+        elif (self._cur().kind in (T_IDENT, T_QIDENT)
+              and not self._at_kw("set")):
+            as_name = self._ident()
+        self._expect_kw("set")
+        stmt = UpdateStmt(TableSource(tn, as_name))
+        while True:
+            col = self._column_ref_only()
+            if not self._accept_op("="):
+                self._expect_op(":=")
+            stmt.assignments.append(Assignment(col, self._expr()))
+            if not self._accept_op(","):
+                break
+        if self._accept_kw("where"):
+            stmt.where = self._expr()
+        return stmt
+
+    def _column_ref_only(self) -> ColumnRef:
+        a = self._ident()
+        if self._accept_op("."):
+            b = self._ident()
+            if self._accept_op("."):
+                return ColumnRef(self._ident(), table=b, db=a)
+            return ColumnRef(b, table=a)
+        return ColumnRef(a)
 
     def _delete_stmt(self) -> DeleteStmt:
         self._advance()
@@ -809,6 +843,13 @@ class Parser:
                 continue
             if self._accept_kw("in"):
                 self._expect_op("(")
+                if self._at_kw("select"):
+                    # IN (subquery): the single item is a SubqueryExpr —
+                    # the planner decorrelates it into a semi/anti join
+                    sub = self._select_stmt()
+                    self._expect_op(")")
+                    left = InExpr(left, [SubqueryExpr(sub)], neg)
+                    continue
                 items = [self._expr()]
                 while self._accept_op(","):
                     items.append(self._expr())
@@ -880,6 +921,13 @@ class Parser:
             self._advance()
             return VariableExpr(t.value, is_system=False)
         if self._at_op("("):
+            if (self._peek().kind == T_IDENT
+                    and self._peek().value.lower() == "select"):
+                # scalar subquery: (SELECT ...) as an expression operand
+                self._advance()
+                sub = self._select_stmt()
+                self._expect_op(")")
+                return SubqueryExpr(sub)
             self._advance()
             e = self._expr()
             if self._at_op(","):
@@ -897,6 +945,19 @@ class Parser:
                 # words being reserved for joins/statements (MySQL allows
                 # them when directly followed by a parenthesis)
                 nxt = self._peek(1)
+                if word == "exists" and nxt.kind == T_OP \
+                        and nxt.value == "(":
+                    # EXISTS (SELECT ...); NOT EXISTS arrives via the
+                    # generic NOT operator and is normalized by the
+                    # planner's decorrelation pass
+                    self._advance()  # exists
+                    self._expect_op("(")
+                    if not self._at_kw("select"):
+                        raise ParseError("expected SELECT after EXISTS (",
+                                         self._cur().pos)
+                    sub = self._select_stmt()
+                    self._expect_op(")")
+                    return ExistsExpr(sub)
                 if word in ("left", "right", "replace") \
                         and nxt.kind == T_OP and nxt.value == "(":
                     return self._func_call()

@@ -62,12 +62,17 @@ class ExprRewriter:
 
     def __init__(self, schema: Schema, builder: "PlanBuilder",
                  agg_mapper: Optional[Dict[int, Column]] = None,
-                 alias_schema: Optional[Schema] = None):
+                 alias_schema: Optional[Schema] = None,
+                 outer_schema: Optional[Schema] = None):
         self.schema = schema
         self.builder = builder
         self.agg_mapper = agg_mapper or {}
         # secondary resolution scope (select aliases, for HAVING/ORDER BY)
         self.alias_schema = alias_schema
+        # correlated-subquery resolution scope: columns of the OUTER query
+        # visible inside an EXISTS subquery (planner/decorrelate.py pulls
+        # the conjuncts referencing them up to the semi join)
+        self.outer_schema = outer_schema
 
     def rewrite(self, e: ast.ExprNode) -> Expression:
         if isinstance(e, ast.Literal):
@@ -129,6 +134,23 @@ class ExprRewriter:
         if isinstance(e, ast.VariableExpr):
             v = self.builder.get_variable(e)
             return Constant(v, _lit_ft(v))
+        if isinstance(e, ast.SubqueryExpr):
+            # scalar subquery: evaluated eagerly at plan time (reference:
+            # expression_rewriter.go handleScalarSubquery — uncorrelated
+            # scalar subqueries fold to constants during optimization);
+            # PR 6 literal parameterization keeps the folded constant out
+            # of program-cache keys.  Note an IN-list landing here (not a
+            # decorrelated top-level WHERE conjunct) gets SCALAR
+            # semantics: >1 subquery row is a loud 1242 error.
+            v = self.builder.eval_scalar_subquery(e)
+            return Constant(v, _lit_ft(v))
+        if isinstance(e, ast.ExistsExpr):
+            # EXISTS outside a decorrelatable WHERE conjunct: eager
+            # boolean evaluation (uncorrelated only — a correlated column
+            # fails name resolution inside)
+            v = self.builder.eval_exists_subquery(e)
+            f = Constant(v, _lit_ft(v))
+            return new_function("not", [f]) if e.negated else f
         if isinstance(e, ast.RowExpr):
             raise PlanError("row expressions are only valid in IN lists")
         if isinstance(e, ast.DefaultExpr):
@@ -139,6 +161,9 @@ class ExprRewriter:
         hits = _find_in_schema(self.schema, ref)
         if not hits and self.alias_schema is not None:
             hits = _find_in_schema(self.alias_schema, ref)
+        if not hits and self.outer_schema is not None:
+            # correlated reference into the enclosing query's scope
+            hits = _find_in_schema(self.outer_schema, ref)
         if not hits:
             raise UnknownColumn(str(ref))
         if len(hits) > 1:
@@ -184,6 +209,33 @@ class PlanBuilder:
             return self.ctx.get_sysvar(e.name, e.scope)
         return self.ctx.get_uservar(e.name)
 
+    # ---- subquery evaluation (planner/decorrelate.py's eager arm) -------
+    def eval_scalar_subquery(self, e: ast.SubqueryExpr) -> Datum:
+        """Execute an uncorrelated scalar subquery NOW; 0 rows -> NULL,
+        >1 rows -> error 1242 (MySQL semantics)."""
+        rows = self._run_subquery(e.select)
+        if len(rows) > 1:
+            raise PlanError("Subquery returns more than 1 row")
+        if not rows:
+            return None
+        if len(rows[0]) != 1:
+            raise PlanError("Operand should contain 1 column(s)")
+        v = rows[0][0]
+        return v.item() if hasattr(v, "item") else v
+
+    def eval_exists_subquery(self, e: ast.ExistsExpr) -> int:
+        import copy
+        stmt = copy.copy(e.select)
+        if stmt.limit is None:
+            stmt.limit = (0, 1)  # EXISTS needs one row at most
+        return 1 if self._run_subquery(stmt) else 0
+
+    def _run_subquery(self, stmt: ast.SelectStmt) -> list:
+        runner = getattr(self.ctx, "_run_select_plan", None)
+        if runner is None:
+            raise PlanError("subqueries need an executing session context")
+        return runner(stmt, self.ctx.get_txn())
+
     # ---- entry -----------------------------------------------------------
     def build_select(self, stmt: ast.SelectStmt) -> LogicalPlan:
         if stmt.from_ is not None:
@@ -191,10 +243,17 @@ class PlanBuilder:
         else:
             p = LogicalTableDual()
         if stmt.where is not None:
+            # subquery-bearing conjuncts first: IN/EXISTS decorrelate
+            # into semi/anti joins over p (planner/decorrelate.py)
+            from .decorrelate import apply_where_subqueries
+            p, residual = apply_where_subqueries(self, p, stmt.where)
+            conds = []
             rw = ExprRewriter(p.schema, self)
-            conds = [fold_constants(c)
-                     for c in split_cnf(rw.rewrite(stmt.where))]
-            p = LogicalSelection(conds, p)
+            for conj in residual:
+                conds.extend(fold_constants(c)
+                             for c in split_cnf(rw.rewrite(conj)))
+            if conds:
+                p = LogicalSelection(conds, p)
 
         # ---- wildcard expansion -------------------------------------
         fields = self._expand_wildcards(stmt.fields, p.schema)

@@ -63,6 +63,13 @@ def derive_stats(p: PhysicalPlan) -> PhysicalPlan:
     elif isinstance(p, (PhysicalHashJoin, PhysicalMergeJoin)):
         left = p.children[0].stats_row_count
         right = p.children[1].stats_row_count
+        if p.tp in ("semi", "anti"):
+            # semi/anti joins filter the left side: output <= left rows
+            # (reference stats.go semi-join selectionFactor)
+            frac = SELECTION_FACTOR if p.tp == "semi" \
+                else 1.0 - SELECTION_FACTOR
+            _set(p, left * frac)
+            return p
         if getattr(p, "left_keys", None):
             rows = max(left, right)
         else:

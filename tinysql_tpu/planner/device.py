@@ -86,6 +86,31 @@ def tpu_admissibility(p: PhysicalPlan) -> Optional[str]:
         def _uns(e):
             return (e.eval_type is EvalType.INT
                     and getattr(e.ret_type, "is_unsigned", False))
+        if p.tp in ("semi", "anti"):
+            # device membership test (sort + searchsorted): numeric keys
+            # (multi-key rides the composite factorization lane), no
+            # per-pair residual evaluation
+            if p.other_conditions:
+                return ("semi/anti residual conditions need per-pair"
+                        " evaluation: CPU tier only")
+            if not p.left_keys:
+                return "cartesian semi/anti join has no device kernel"
+            if len(p.left_keys) == 1:
+                lk, rk = p.left_keys[0], p.right_keys[0]
+                if not (is_jittable(lk) and is_jittable(rk)):
+                    return "join keys not device-jittable"
+                if _uns(lk) != _uns(rk):
+                    return ("mixed-signedness int keys need per-pair"
+                            " compare semantics the membership kernel"
+                            " lacks")
+                return None
+            for k in list(p.left_keys) + list(p.right_keys):
+                if not (isinstance(k, Column)
+                        and k.eval_type is EvalType.INT
+                        and not _uns(k)):
+                    return ("multi-key semi/anti join needs plain"
+                            " signed-int columns (composite lane)")
+            return None
         if p.tp not in ("inner", "left"):
             return f"{p.tp} join has no device kernel"
         if not p.left_keys:

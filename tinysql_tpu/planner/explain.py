@@ -60,7 +60,8 @@ def _info(p: PhysicalPlan) -> str:
                         zip(p.left_keys, p.right_keys)) or "CARTESIAN"
         mesh = getattr(p, "mesh_strategy", None)
         mesh = f", mesh:{mesh}" if mesh else ""
-        return f"{p.tp} join, equal:[{keys}]{mesh}"
+        na = ", null-aware" if getattr(p, "null_aware", False) else ""
+        return f"{p.tp} join, equal:[{keys}]{mesh}{na}"
     if isinstance(p, (PhysicalSort, PhysicalTopN)):
         by = ",".join(f"{e.key()}{' desc' if d else ''}" for e, d in p.by)
         extra = (f", offset:{p.offset}, count:{p.count}"

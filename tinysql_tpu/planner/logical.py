@@ -93,12 +93,21 @@ class LogicalJoin(LogicalPlan):
         super().__init__()
         self.tp = tp
         self.children = [left, right]
-        self.schema = left.schema.merge(right.schema)
+        # semi/anti joins are FILTERS on the left side: they emit left
+        # rows only (reference: LogicalJoin.SemiJoin schema = left)
+        if tp in (JOIN_SEMI, JOIN_ANTI):
+            self.schema = Schema(list(left.schema.columns))
+        else:
+            self.schema = left.schema.merge(right.schema)
         # CNF split of the ON/WHERE conditions by side
         self.eq_conditions: List[Tuple[Expression, Expression]] = []  # (lcol expr, rcol expr)
         self.left_conditions: List[Expression] = []
         self.right_conditions: List[Expression] = []
         self.other_conditions: List[Expression] = []
+        # NOT IN anti joins carry three-valued NULL semantics: any NULL
+        # build key kills every probe row, a NULL probe key only passes
+        # an EMPTY build side (reference: null-aware anti join)
+        self.null_aware = False
 
 
 class LogicalSort(LogicalPlan):

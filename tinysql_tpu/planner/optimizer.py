@@ -15,10 +15,11 @@ from typing import List, Optional, Set, Tuple
 from ..expression import (AggFuncDesc, Column, Constant, Expression, Schema,
                           new_function, substitute_column)
 from .builder import HANDLE_COL_NAME, PlanError
-from .logical import (JOIN_INNER, JOIN_LEFT, LogicalAggregation,
-                      LogicalDataSource, LogicalJoin, LogicalLimit,
-                      LogicalPlan, LogicalProjection, LogicalSelection,
-                      LogicalSort, LogicalTableDual, LogicalTopN)
+from .logical import (JOIN_ANTI, JOIN_INNER, JOIN_LEFT, JOIN_SEMI,
+                      LogicalAggregation, LogicalDataSource, LogicalJoin,
+                      LogicalLimit, LogicalPlan, LogicalProjection,
+                      LogicalSelection, LogicalSort, LogicalTableDual,
+                      LogicalTopN)
 from .physical import (PhysicalHashAgg, PhysicalHashJoin, PhysicalLimit,
                        PhysicalMergeJoin, PhysicalPlan, PhysicalProjection,
                        PhysicalSelection, PhysicalSort, PhysicalTableDual,
@@ -62,7 +63,9 @@ def predicate_pushdown(p: LogicalPlan,
             conds, lsch, rsch, p.tp)
         p.eq_conditions.extend(new_eq)
         p.other_conditions.extend(other)
-        if p.tp == JOIN_INNER:
+        if p.tp in (JOIN_INNER, JOIN_SEMI, JOIN_ANTI):
+            # semi/anti joins FILTER the left side: a cond on left
+            # columns commutes below exactly like through an inner join
             left_push = list(p.left_conditions) + lp
             p.left_conditions = []
         else:
@@ -174,7 +177,12 @@ def column_pruning(p: LogicalPlan, needed: Set[int]) -> None:
         used |= _cols_of(p.left_conditions) | _cols_of(p.right_conditions)
         column_pruning(p.children[0], used)
         column_pruning(p.children[1], used)
-        p.schema = p.children[0].schema.merge(p.children[1].schema)
+        if p.tp in (JOIN_SEMI, JOIN_ANTI):
+            # semi/anti joins emit LEFT rows only; the right side kept
+            # just its equi/other condition columns
+            p.schema = Schema(list(p.children[0].schema.columns))
+        else:
+            p.schema = p.children[0].schema.merge(p.children[1].schema)
         return
     if isinstance(p, LogicalDataSource):
         used = needed | _cols_of(p.pushed_conds)
@@ -277,6 +285,10 @@ def _unique_on(side: LogicalPlan, key_uids: Set[int], n_keys: int) -> bool:
         if not key_uids <= ident:
             return False
         return _unique_on(side.child(0), key_uids, n_keys)
+    if isinstance(side, LogicalJoin) and side.tp in (JOIN_SEMI, JOIN_ANTI):
+        # a semi/anti join never duplicates left rows: uniqueness of the
+        # left child survives
+        return _unique_on(side.children[0], key_uids, n_keys)
     if isinstance(side, LogicalJoin) and side.tp == JOIN_INNER \
             and side.eq_conditions:
         lsch, rsch = side.children[0].schema, side.children[1].schema
@@ -341,7 +353,12 @@ def phys_aggregation(p: LogicalAggregation,
 
 def phys_join(p: LogicalJoin, left: PhysicalPlan, right: PhysicalPlan,
               cls=PhysicalHashJoin) -> PhysicalPlan:
-    join = cls(p.tp, left, right, p.schema)
+    # semi/anti joins emit the left child's rows VERBATIM: the physical
+    # schema must be the BUILT left child's (join_reorder may have
+    # rebuilt that subtree after the logical schema was captured)
+    schema = Schema(list(left.schema.columns)) \
+        if p.tp in (JOIN_SEMI, JOIN_ANTI) else p.schema
+    join = cls(p.tp, left, right, schema)
     join.left_keys = _bind([a for a, _ in p.eq_conditions], left.schema)
     join.right_keys = _bind([b for _, b in p.eq_conditions], right.schema)
     # key-uniqueness per side (reference: schema key info feeding the
@@ -354,10 +371,15 @@ def phys_join(p: LogicalJoin, left: PhysicalPlan, right: PhysicalPlan,
         p.children[1], {b.unique_id for _, b in p.eq_conditions
                         if isinstance(b, Column)},
         len(p.eq_conditions))
-    join.other_conditions = _bind(p.other_conditions, p.schema)
+    # other conds see BOTH sides even when the join's output schema is
+    # left-only (semi/anti): the executors evaluate them on candidate
+    # (probe row, build row) pairs
+    join.other_conditions = _bind(p.other_conditions,
+                                  left.schema.merge(right.schema))
     # leftover one-side conds (outer joins keep them at the join)
     join.left_conditions = _bind(p.left_conditions, left.schema)
     join.right_conditions = _bind(p.right_conditions, right.schema)
+    join.null_aware = getattr(p, "null_aware", False)
     return join
 
 
@@ -471,7 +493,8 @@ def normalize_logical(logical: LogicalPlan,
     predicate pushdown (its transformation rules own that)."""
     from .rules_extra import (eliminate_aggregation, eliminate_max_min,
                               eliminate_outer_joins, eliminate_projections,
-                              join_reorder, push_agg_through_join)
+                              join_reorder, push_agg_through_join,
+                              push_semi_joins_down)
     root_needed = {c.unique_id for c in logical.schema.columns}
     _propagate_constants_in_plan(logical)
     logical = eliminate_outer_joins(logical, root_needed)
@@ -484,7 +507,10 @@ def normalize_logical(logical: LogicalPlan,
     logical = eliminate_aggregation(logical)
     logical = eliminate_max_min(logical)
     logical = eliminate_projections(logical)
-    return join_reorder(logical, stats_of=_ds_row_count)
+    logical = join_reorder(logical, stats_of=_ds_row_count)
+    # after reorder: the left-deep inner chain is in place, sink each
+    # semi/anti join next to the side its keys come from
+    return push_semi_joins_down(logical)
 
 
 def optimize(logical: LogicalPlan, tpu: bool = True,

@@ -155,6 +155,8 @@ class PhysicalHashJoin(PhysicalPlan):
         self.other_conditions: List[Expression] = []
         self.build_side = 1  # 1 = right is build side
         self.use_tpu = False
+        # NOT IN three-valued semantics on anti joins (decorrelate.py)
+        self.null_aware = False
 
 
 class PhysicalMergeJoin(PhysicalHashJoin):

@@ -140,6 +140,9 @@ def unique_key_sets(p: LogicalPlan) -> List[Set[int]]:
         if p.group_by and len(gb_outs) == len(p.group_by):
             return [{c.unique_id for c in gb_outs}]
         return []
+    if isinstance(p, LogicalJoin) and p.tp in ("semi", "anti"):
+        # semi/anti joins never duplicate (or extend) left rows
+        return unique_key_sets(p.child(0))
     if isinstance(p, LogicalJoin) and p.tp in (JOIN_INNER, JOIN_LEFT):
         lkeys = unique_key_sets(p.child(0))
         rkeys = unique_key_sets(p.child(1))
@@ -286,6 +289,10 @@ def join_reorder(p: LogicalPlan, stats_of=None) -> LogicalPlan:
     estimated source first, preferring connected (equi-cond) pairs
     (reference: rule_join_reorder.go greedy solver)."""
     p.children = [join_reorder(c, stats_of) for c in p.children]
+    if isinstance(p, LogicalJoin) and p.tp in ("semi", "anti"):
+        # the reordered left subtree may expose its columns in a new
+        # order; a semi/anti join mirrors the left child exactly
+        p.schema = Schema(list(p.children[0].schema.columns))
     if not (isinstance(p, LogicalJoin) and p.tp == JOIN_INNER):
         return p
     nodes: List[LogicalPlan] = []
@@ -376,6 +383,50 @@ def _finish_reorder(cur: LogicalPlan, pending_eqs: List[tuple],
         if isinstance(cur, LogicalJoin):
             cur.other_conditions.append(new_function("=", [a, b]))
     return cur
+
+
+# ===== semi/anti join sink =================================================
+
+def push_semi_joins_down(p: LogicalPlan) -> LogicalPlan:
+    """Sink a semi/anti join below the inner-join chain under its left
+    child, next to the side its equi-keys actually come from (reference:
+    TiDB plans the decorrelated semi join against the correlated table,
+    not the whole FROM product).  A semi/anti join is a row FILTER on
+    its left input, so it commutes with inner joins (and the outer side
+    of a LEFT join) exactly like a selection — sinking it prunes the
+    chain EARLY instead of filtering the full join product (Q5: the
+    region membership lands on nation's 25 rows, not on the 5-way join
+    output)."""
+    p.children = [push_semi_joins_down(c) for c in p.children]
+    if isinstance(p, LogicalJoin) and p.tp in ("semi", "anti"):
+        return _sink_semi(p)
+    return p
+
+
+def _sink_semi(semi: LogicalJoin) -> LogicalPlan:
+    left = semi.children[0]
+    if not (isinstance(left, LogicalJoin)
+            and left.tp in (JOIN_INNER, JOIN_LEFT)):
+        return semi
+    need = set()
+    for a, _ in semi.eq_conditions:
+        need |= {c.unique_id for c in a.collect_columns()}
+    for c in semi.other_conditions:
+        need |= {x.unique_id for x in c.collect_columns()
+                 if left.schema.contains(x)}
+    if not need:
+        return semi  # cartesian membership: no side to sink toward
+    for side in (0, 1):
+        if side == 1 and left.tp != JOIN_INNER:
+            continue  # never below the inner side of a LEFT join
+        child_uids = {c.unique_id
+                      for c in left.children[side].schema.columns}
+        if need <= child_uids:
+            semi.children[0] = left.children[side]
+            semi.schema = Schema(list(left.children[side].schema.columns))
+            left.children[side] = _sink_semi(semi)
+            return left
+    return semi
 
 
 # ===== aggregation pushdown through join ===================================

@@ -85,7 +85,8 @@ def gauges() -> dict:
 
 #: statement classes that execute on the pool; the rest run directly on
 #: the connection thread (control plane must outlive a wedged pool)
-_POOLED_STMTS = (ast.SelectStmt, ast.InsertStmt, ast.DeleteStmt)
+_POOLED_STMTS = (ast.SelectStmt, ast.InsertStmt, ast.DeleteStmt,
+                 ast.UpdateStmt)
 
 
 class PoolClosed(Exception):

@@ -650,6 +650,8 @@ class Session:
             return self._exec_insert(stmt)
         if isinstance(stmt, ast.DeleteStmt):
             return self._exec_delete(stmt)
+        if isinstance(stmt, ast.UpdateStmt):
+            return self._exec_update(stmt)
         if isinstance(stmt, (ast.CreateDatabaseStmt, ast.DropDatabaseStmt,
                              ast.CreateTableStmt, ast.DropTableStmt,
                              ast.CreateIndexStmt, ast.DropIndexStmt,
@@ -850,22 +852,30 @@ class Session:
         if not db:
             raise SessionError("No database selected")
         info = self.infoschema().table_by_name(db, stmt.table.name)
+        self._ensure_writable(info)
         ex = InsertExec(self, stmt, info, db)
         self.last_affected = ex.execute(self.get_txn())
         return None
+
+    def _ensure_writable(self, info) -> None:
+        """Bulk-loaded tables exist only as a columnar replica; the
+        first write statement must materialize the row store first or
+        its commit invalidates the replica and drops every untouched
+        row (columnar/store.py ensure_row_store)."""
+        if self.storage is not None:
+            from ..columnar.store import ensure_row_store
+            ensure_row_store(self.storage, info)
 
     def _exec_delete(self, stmt: ast.DeleteStmt) -> None:
         builder = PlanBuilder(self)
         src = stmt.table
         ds = builder._build_table_source(src)
         info = ds.table_info
+        self._ensure_writable(info)
         handle_col = ExprColumn(new_int_type(), name=HANDLE_COL_NAME,
                                 table=ds.alias)
         ds.schema = Schema(ds.schema.columns + [handle_col])
-        plan = ds
-        if stmt.where is not None:
-            rw = ExprRewriter(plan.schema, builder)
-            plan = LogicalSelection(split_cnf(rw.rewrite(stmt.where)), plan)
+        plan = self._where_plan(builder, ds, stmt.where)
         use_tpu = self._use_tpu()
         phys = self._optimize(plan, use_tpu)
         txn = self.get_txn()
@@ -878,6 +888,72 @@ class Session:
             ex.close()
         dex = DeleteExec(self, info)
         self.last_affected = dex.execute(txn, rows)
+        return None
+
+    @staticmethod
+    def _where_plan(builder, ds, where):
+        """DML read plan for a WHERE over one table — the same
+        decorrelation the SELECT front door runs (IN/EXISTS subquery
+        conjuncts -> semi/anti joins; the join mirrors the scan schema,
+        hidden handle included, so the write executors see full rows)."""
+        if where is None:
+            return ds
+        from ..planner.decorrelate import apply_where_subqueries
+        plan, residual = apply_where_subqueries(builder, ds, where)
+        rw = ExprRewriter(plan.schema, builder)
+        conds = []
+        for conj in residual:
+            conds.extend(split_cnf(rw.rewrite(conj)))
+        if conds:
+            plan = LogicalSelection(conds, plan)
+        return plan
+
+    def _exec_update(self, stmt: ast.UpdateStmt) -> None:
+        """UPDATE t SET c = expr [...] WHERE ... — scan qualifying rows
+        (same planned read path as DELETE, hidden handle included), then
+        read-modify-write through the row store so the 2PC
+        prewrite/commit machinery (and its failpoints/chaos matrix)
+        covers the statement unchanged."""
+        from ..executor.write import UpdateExec
+        builder = PlanBuilder(self)
+        ds = builder._build_table_source(stmt.table)
+        info = ds.table_info
+        self._ensure_writable(info)
+        handle_col = ExprColumn(new_int_type(), name=HANDLE_COL_NAME,
+                                table=ds.alias)
+        ds.schema = Schema(ds.schema.columns + [handle_col])
+        scan_schema = ds.schema
+        plan = self._where_plan(builder, ds, stmt.where)
+        # bind SET targets/expressions against the scan schema BEFORE
+        # optimization prunes it (rows arrive in full-schema order)
+        rw = ExprRewriter(scan_schema, builder)
+        assigns = []
+        cols_by_name = {c.name.lower(): c for c in info.public_columns()}
+        # the only legal SET-target qualifier is the table's visible
+        # name in this statement (the alias when one is set — MySQL
+        # rejects the base name once aliased)
+        visible = (stmt.table.as_name or stmt.table.source.name).lower()
+        for a in stmt.assignments:
+            q = (a.column.table or "").lower()
+            ci = cols_by_name.get(a.column.name.lower())
+            if ci is None or (q and q != visible):
+                bad = f"{q}.{a.column.name}" if q else a.column.name
+                raise SessionError(
+                    f"Unknown column '{bad}' in 'field list'")
+            expr = rw.rewrite(a.expr).resolve_indices(scan_schema)
+            assigns.append((ci, expr))
+        use_tpu = self._use_tpu()
+        phys = self._optimize(plan, use_tpu)
+        txn = self.get_txn()
+        ex = build_executor(phys, use_tpu=use_tpu)
+        ex.open(ExecContext(txn, self.sysvars, self.infoschema(),
+                            self.storage))
+        try:
+            rows = ex.drain()
+        finally:
+            ex.close()
+        uex = UpdateExec(self, info, assigns)
+        self.last_affected = uex.execute(txn, rows)
         return None
 
     def add_warning(self, level: str, code: int, msg: str) -> None:
