@@ -190,6 +190,11 @@ def main():
                 stats.setdefault("dispatches", 0)
                 stats.setdefault("d2h_transfers", 0)
                 stats.setdefault("d2h_bytes", 0)
+                # symmetric transfer accounting (ISSUE 11): uploads
+                # (ParamTable pushes, column/mask uploads) are counted
+                # like downloads
+                stats.setdefault("h2d_transfers", 0)
+                stats.setdefault("h2d_bytes", 0)
                 stats.setdefault("host_dispatches", 0)
                 stats.setdefault("progcache_hits", 0)
                 stats.setdefault("progcache_misses", 0)
@@ -249,6 +254,11 @@ def main():
             run_stats[sql] = {"runs_s": walls, "first_run_s": walls[0],
                               "cold_vs_warm_ratio": round(
                                   walls[0] / max(best, 1e-9), 2),
+                              # the ROADMAP item 2 gate metric: compiled
+                              # dispatches ONE warm execution of this
+                              # query pays (per-query obs counters)
+                              "dispatches_per_query":
+                                  int(stats.get("dispatches", 0)),
                               **stats, **extra}
         return best, rows
 
@@ -315,6 +325,7 @@ def main():
             "speedup_vs_sqlite": round(lite_t / max(warm, 1e-9), 3),
             "rows": len(rows),
             "dispatches": int(st.get("dispatches", 0)),
+            "dispatches_per_query": int(st.get("dispatches", 0)),
             "host_dispatches": int(st.get("host_dispatches", 0)),
             "d2h_transfers": int(st.get("d2h_transfers", 0)),
             "warm_progcache_misses": int(d.get("progcache_misses", 0)),

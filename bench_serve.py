@@ -21,6 +21,7 @@ bench.py's contract):
     {"metric": "serve_p99_ms", "value": ..., "unit": "ms"}
     {"metric": "obs_overhead_frac", "value": ..., "unit": "frac"}
     {"metric": "serve_queue_wait_p99_share", "value": ..., "unit": "frac"}
+    {"metric": "serve_dispatches_per_query", "value": ..., "unit": "dispatches"}
 
 obs_overhead_frac is the time-series sampler's steady-state cost (one
 sample's wall over the default interval, measured against the live
@@ -189,6 +190,10 @@ def main():
     # the joins, so the storm's floods don't contaminate the split
     from tinysql_tpu.obs.stmtsummary import histogram_snapshot
     queue_h0 = histogram_snapshot()["queue"]
+    # dispatches-per-query over the mixed phase (the ROADMAP item 2
+    # gate): compiled-program dispatches the whole serving tier paid,
+    # divided by the statements the clients completed
+    disp0 = kernels.stats_snapshot()["dispatches"]
     t0 = time.time()
     threads = [threading.Thread(target=client_loop, args=(i,), daemon=True)
                for i in range(n_clients)]
@@ -203,6 +208,9 @@ def main():
         errors.append(f"{hung} client thread(s) still running after join")
     mixed_wall = time.time() - t0
     queue_hist = _hist_delta(queue_h0, histogram_snapshot()["queue"])
+    mixed_dispatches = kernels.stats_snapshot()["dispatches"] - disp0
+    dispatches_per_query = round(
+        mixed_dispatches / max(len(lat_ms), 1), 3)
     qps = len(lat_ms) / max(mixed_wall, 1e-9)
     p50, p99 = _pct(lat_ms, 50), _pct(lat_ms, 99)
     print(f"[serve] mixed: {len(lat_ms)} ok in {mixed_wall:.1f}s "
@@ -308,6 +316,8 @@ def main():
         "wall_s": round(mixed_wall, 2),
         "admission": adm, "batching": batching.stats_snapshot(),
         "storm": storm,
+        "mixed_dispatches": mixed_dispatches,
+        "dispatches_per_query": dispatches_per_query,
         "obs_overhead": obs_cost,
         "queue_wait_p99_ms": round(queue_p99_ms, 2),
         "queue_wait_stmts": queue_hist["count"],
@@ -322,6 +332,9 @@ def main():
                       "unit": "frac"}))
     print(json.dumps({"metric": "serve_queue_wait_p99_share",
                       "value": queue_share, "unit": "frac"}))
+    print(json.dumps({"metric": "serve_dispatches_per_query",
+                      "value": dispatches_per_query,
+                      "unit": "dispatches"}))
 
     # ---- the serve-smoke gate -------------------------------------------
     assert not errors, errors[:5]

@@ -1,0 +1,33 @@
+"""OB405 fixture: device-time counter writes outside the owning
+profiler/kernels/progcache modules.
+
+The device-time keys (``device_s`` / ``profiled_dispatches`` /
+``compile_s``) carry MEASURED walls — a block_until_ready-closed
+dispatch or a timed program build.  Writing them from anywhere else
+publishes a host submit wall as device truth.
+
+Every line marked OB405 below must fire the rule; the clean patterns at
+the bottom must stay silent.  Never imported — parsed by test_lint.py.
+"""
+from tinysql_tpu.obs import context as _obs
+from tinysql_tpu.ops import kernels
+
+
+def fake_device_wall(dt):
+    # a host wall laundered into the device-time counters
+    kernels.stats_add("device_s", dt)                  # OB405
+    kernels.stats_add("profiled_dispatches", 1)        # OB405
+
+
+def fake_compile_wall(dt):
+    _obs.record("compile_s", dt)                       # OB405
+
+
+def clean_patterns(dt):
+    # other counters route through the same accessors freely
+    kernels.stats_add("dispatches", 1)
+    _obs.record("d2h_bytes", 4096)
+    # reads of the measured values are fine anywhere — that is what
+    # EXPLAIN ANALYZE and statements_summary do
+    measured = dict(kernels.STATS).get("device_s", 0.0)
+    return measured

@@ -319,6 +319,36 @@ def test_ob403_ignores_unrelated_ingest_and_store(tmp_path):
     assert lint_obs_discipline(SourceFile(str(p))) == []
 
 
+def test_devtime_fixture_fires_ob405():
+    sf = SourceFile(os.path.join(FIXDIR, "bad_devtime.py"))
+    diags = lint_obs_discipline(sf)
+    got = [d for d in diags if d.rule == "OB405"]
+    # the two laundered device-time writes + the fake compile wall; the
+    # ordinary-counter accessors and the reads stay silent
+    assert len(got) == 3, [d.format() for d in diags]
+    assert all("device" in d.message or "compile" in d.message
+               for d in got)
+
+
+def test_ob405_owning_modules_exempt(tmp_path):
+    # kernels/profiler/progcache own the measured walls; a same-named
+    # file elsewhere is exempt by basename like OB401's contract
+    for name in ("kernels.py", "profiler.py", "progcache.py"):
+        p = tmp_path / name
+        p.write_text("def stats_add(k, n):\n    pass\n"
+                     "stats_add('device_s', 0.5)\n")
+        assert lint_obs_discipline(SourceFile(str(p))) == [], name
+
+
+def test_ob405_other_keys_silent(tmp_path):
+    # the rule polices the device-time KEYS, not the accessors
+    p = tmp_path / "elsewhere.py"
+    p.write_text("from tinysql_tpu.ops import kernels\n"
+                 "kernels.stats_add('dispatches', 1)\n"
+                 "kernels.stats_add('h2d_bytes', 64)\n")
+    assert lint_obs_discipline(SourceFile(str(p))) == []
+
+
 def test_metric_fixture_fires_ob404():
     sf = SourceFile(os.path.join(FIXDIR, "bad_metric.py"))
     diags = lint_obs_discipline(sf)
@@ -410,6 +440,7 @@ def test_corpus_plans_clean():
     ("obs", "bad_stats.py"),
     ("obs", "bad_summary.py"),
     ("obs", "bad_metric.py"),
+    ("obs", "bad_devtime.py"),
 ])
 def test_cli_exits_nonzero_on_fixture(passname, fixture):
     r = subprocess.run(

@@ -436,7 +436,10 @@ def test_slow_query_carries_wait_fields(storage):
 EXPECTED_RULES = {"compile-storm", "progcache-hit-rate",
                   "pool-saturation", "cooldown-flapping",
                   "memory-pressure", "spill-pressure",
-                  "prewarm-starvation"}
+                  "prewarm-starvation",
+                  # device-time truth (ISSUE 11)
+                  "dispatch-storm", "transfer-bound",
+                  "recompile-churn", "slo-burn"}
 
 
 def test_rule_catalogue_fully_covered():
@@ -546,6 +549,151 @@ def test_rule_prewarm_starvation():
     f = _findings(ring, "prewarm-starvation")
     assert {x.item for x in f} == {"budget", "errors"}
     assert all(x.severity == "warning" for x in f)
+
+
+def test_rule_dispatch_storm():
+    per = oinspect.DISPATCH_STORM_PER_QUERY
+    nq = oinspect.DISPATCH_STORM_MIN_QUERIES
+    ring = _ring_with({"tinysql_queries_total": nq,
+                       "tinysql_dispatches_total": nq * per})
+    f = _findings(ring, "dispatch-storm")
+    assert len(f) == 1 and f[0].severity == "warning"
+    assert f[0].metric == "tinysql_dispatches_total"
+    # 2x the per-query threshold escalates
+    ring = _ring_with({"tinysql_queries_total": nq,
+                       "tinysql_dispatches_total": nq * per * 2})
+    assert _findings(ring, "dispatch-storm")[0].severity == "critical"
+    # a healthy ratio is silent no matter the traffic
+    ring = _ring_with({"tinysql_queries_total": 1000,
+                       "tinysql_dispatches_total": 3000})
+    assert not _findings(ring, "dispatch-storm")
+    # too few queries to judge: silent even at a wild ratio
+    ring = _ring_with({"tinysql_queries_total": nq - 1,
+                       "tinysql_dispatches_total": (nq - 1) * per * 4})
+    assert not _findings(ring, "dispatch-storm")
+
+
+def test_rule_transfer_bound():
+    moved = oinspect.TRANSFER_BOUND_MIN_BYTES
+    # the window moved 32 MiB against ~1 ms of measured device time —
+    # orders of magnitude over the bytes-per-busy-second threshold
+    ring = _ring_with({"tinysql_d2h_bytes_total": moved,
+                       "tinysql_dispatches_total": 4,
+                       "tinysql_profiled_dispatches_total": 4,
+                       "tinysql_device_busy_seconds_total": 0.001})
+    f = _findings(ring, "transfer-bound")
+    assert len(f) == 1 and f[0].severity == "warning"
+    assert f[0].metric == "tinysql_d2h_bytes_total"
+    # plenty of measured device work for the bytes: silent
+    ring = _ring_with({"tinysql_d2h_bytes_total": moved,
+                       "tinysql_dispatches_total": 4,
+                       "tinysql_profiled_dispatches_total": 4,
+                       "tinysql_device_busy_seconds_total": 10.0})
+    assert not _findings(ring, "transfer-bound")
+    # fractional profile rate: measured busy covers only the sampled
+    # tenth of the dispatches — the rule must extrapolate, not read the
+    # workload as 10x more transfer-bound than it is (1 GiB against a
+    # true ~4 s of busy time is healthy)
+    ring = _ring_with({"tinysql_d2h_bytes_total": 1 << 30,
+                       "tinysql_dispatches_total": 40,
+                       "tinysql_profiled_dispatches_total": 4,
+                       "tinysql_device_busy_seconds_total": 0.4})
+    assert not _findings(ring, "transfer-bound")
+    # profiler off (no measured device time in the window): the rule
+    # must NOT judge against async submit walls — silent
+    ring = _ring_with({"tinysql_d2h_bytes_total": moved})
+    assert not _findings(ring, "transfer-bound")
+    # sub-threshold volume: silent
+    ring = _ring_with({"tinysql_d2h_bytes_total": moved // 4,
+                       "tinysql_dispatches_total": 4,
+                       "tinysql_profiled_dispatches_total": 4,
+                       "tinysql_device_busy_seconds_total": 0.001})
+    assert not _findings(ring, "transfer-bound")
+
+
+def test_rule_recompile_churn():
+    # a churning family: every execution compiles (misses per exec well
+    # beyond the first run's) — synthesized straight into the summary
+    # store with a unique digest, judged via summary_records
+    n = oinspect.RECOMPILE_MIN_EXECS
+    digest = "churn-test-digest"
+    for _ in range(n):
+        stmtsummary.STORE.ingest(
+            sql="select churn", sql_digest=digest, digest_text="x",
+            stmt_type="select", schema_name="ts", plan_digest="pd-churn",
+            info={"exec_s": 0.01},
+            device={"progcache_misses": oinspect.RECOMPILE_MISSES_PER_EXEC
+                    + 1})
+    try:
+        f = [x for x in _findings(MetricsRing(), "recompile-churn")
+             if x.item == digest]
+        assert len(f) == 1 and f[0].severity == "warning"
+        assert "warm digest family" in f[0].details
+        # a healthy family (compiles only on its first run) stays silent
+        healthy = "healthy-test-digest"
+        for i in range(n):
+            stmtsummary.STORE.ingest(
+                sql="select healthy", sql_digest=healthy, digest_text="y",
+                stmt_type="select", schema_name="ts",
+                plan_digest="pd-healthy", info={"exec_s": 0.01},
+                device={"progcache_misses": 3 if i == 0 else 0})
+        assert not [x for x in _findings(MetricsRing(), "recompile-churn")
+                    if x.item == healthy]
+    finally:
+        stmtsummary.STORE.reset()
+
+
+def test_rule_slo_burn():
+    oinspect.set_slo_p99_ms(50)
+    try:
+        total = 2 * oinspect.SLO_MIN_MEASUREMENTS
+        # 10% of windowed measurements breached a p99 objective: 10x the
+        # 1% budget — critical
+        ring = _ring_with({"tinysql_slo_exec_measurements_total": total,
+                           "tinysql_slo_exec_breaches_total": total * 0.1})
+        f = _findings(ring, "slo-burn")
+        assert len(f) == 1 and f[0].severity == "critical"
+        assert "tidb_slo_p99_ms=50" in f[0].details
+        # within budget (<= 1%): silent
+        ring = _ring_with({"tinysql_slo_exec_measurements_total": total,
+                           "tinysql_slo_exec_breaches_total":
+                           total * oinspect.SLO_BURN_FRAC})
+        assert not _findings(ring, "slo-burn")
+        # too few measurements to judge: silent
+        ring = _ring_with({"tinysql_slo_exec_measurements_total":
+                           oinspect.SLO_MIN_MEASUREMENTS - 1,
+                           "tinysql_slo_exec_breaches_total": 5})
+        assert not _findings(ring, "slo-burn")
+        # a threshold that CHANGED within the window invalidates the
+        # breach delta (a lowered SLO would reclassify all history):
+        # silent until a stable window
+        ring = MetricsRing()
+        for i, armed in enumerate((500.0, 50.0, 50.0)):
+            ring.record({"tinysql_slo_exec_measurements_total": 100 + i,
+                         "tinysql_slo_exec_breaches_total": 200 * (i > 0),
+                         "tinysql_slo_p99_ms": armed},
+                        now=1000.0 + 10 * i)
+        oinspect.set_slo_p99_ms(50)
+        assert not _findings(ring, "slo-burn")
+        # ... and a stable armed series that no longer matches the LIVE
+        # objective is equally unjudgeable
+        ring = MetricsRing()
+        for i in range(3):
+            ring.record({"tinysql_slo_exec_measurements_total":
+                         total * i / 2,
+                         "tinysql_slo_exec_breaches_total":
+                         total * 0.1 * i / 2,
+                         "tinysql_slo_p99_ms": 500.0},
+                        now=1000.0 + 10 * i)
+        oinspect.set_slo_p99_ms(50)
+        assert not _findings(ring, "slo-burn")
+        # no SLO armed: silent whatever the series say
+        oinspect.set_slo_p99_ms(0)
+        ring = _ring_with({"tinysql_slo_exec_measurements_total": total,
+                           "tinysql_slo_exec_breaches_total": total})
+        assert not _findings(ring, "slo-burn")
+    finally:
+        oinspect.set_slo_p99_ms(0)
 
 
 def test_rule_pool_saturation_under_armed_failpoint_via_sql(storage):

@@ -24,6 +24,15 @@ Rules:
   bypasses the window-rotation/eviction accounting behind
   ``information_schema.statements_summary`` and the /metrics latency
   histograms.
+- **OB405**: device-time counter write outside the owning modules.
+  The device-time keys (``device_s`` / ``profiled_dispatches`` /
+  ``compile_s``) carry MEASURED walls: ``device_s`` is only ever real
+  when the sampling profiler closed the dispatch with
+  ``block_until_ready`` (ops/profiler.py via ops/kernels.counted_jit),
+  and ``compile_s`` is the program-build wall timed inside
+  ops/progcache.get.  A ``stats_add``/``record`` of those keys anywhere
+  else would publish a host submit wall as device truth — the exact
+  fiction ISSUE 11 removes.
 - **OB404**: metric-name drift.  In any module that touches the
   time-series ring (imports ``obs/tsring.py``, or IS it), every
   ``tinysql_*`` metric-name string literal must be declared in the
@@ -58,6 +67,10 @@ register_rules({
     "OB404": "metric name not declared in the central registry "
              "(obs/metrics.METRICS) — /metrics, the time-series ring, "
              "and metrics_summary must share one name set",
+    "OB405": "device-time counter write outside the owning "
+             "profiler/kernels/progcache modules — only a "
+             "block_until_ready-closed dispatch or a timed program "
+             "build may claim device/compile wall",
 })
 
 #: modules that own a STATS dict and its accessors (the serving layer's
@@ -74,6 +87,17 @@ _MUTATORS = {"update", "clear", "setdefault", "pop", "popitem"}
 
 #: mutating entry points on the summary store / its module facade
 _SUMMARY_WRITERS = {"ingest", "reset"}
+
+#: device-time counter keys (OB405) and the modules that own their
+#: truth: kernels.counted_jit (the block_until_ready-closed dispatch),
+#: ops/profiler.py (the sampling decision + histogram), and
+#: ops/progcache.py (the timed program build -> compile_s)
+DEVTIME_KEYS = {"device_s", "profiled_dispatches", "compile_s"}
+DEVTIME_OWNING_MODULES = ("kernels.py", "profiler.py", "progcache.py")
+
+#: accumulator entry points a device-time key could ride through
+_DEVTIME_SINKS = {"stats_add", "stats_hwm", "record", "record_hwm",
+                  "add_counter", "add_device"}
 
 
 def _is_stats_target(e: ast.expr) -> bool:
@@ -149,6 +173,35 @@ def _lint_summary_writes(sf: SourceFile) -> List[Diagnostic]:
                 "statement-close hook (_finish_obs) may ingest; any "
                 "other writer double-counts or bypasses window/eviction "
                 "accounting",
+                sf.path, node.lineno))
+    return diags
+
+
+# ---- OB405: device-time write discipline ----------------------------------
+
+def _lint_devtime_writes(sf: SourceFile) -> List[Diagnostic]:
+    """Flag accumulator calls whose FIRST argument is a device-time key
+    literal (``stats_add("device_s", ...)``, ``_obs.record("compile_s",
+    ...)``) outside the owning modules.  obs/context.py defines the
+    generic fan-out but never names the keys; any module NAMING one is
+    claiming to have measured device time."""
+    diags: List[Diagnostic] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if name not in _DEVTIME_SINKS:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and arg.value in DEVTIME_KEYS:
+            diags.append(Diagnostic(
+                "OB405",
+                f"`{name}({arg.value!r}, ...)` writes a device-time "
+                "counter outside the owning profiler/kernels/progcache "
+                "modules — only a block_until_ready-closed dispatch or "
+                "a timed program build may claim device/compile wall",
                 sf.path, node.lineno))
     return diags
 
@@ -234,6 +287,8 @@ def lint_obs_discipline(sf: SourceFile) -> List[Diagnostic]:
         diags.extend(_lint_summary_writes(sf))
     if base != _REGISTRY_MODULE:
         diags.extend(_lint_metric_names(sf))
+    if base not in DEVTIME_OWNING_MODULES:
+        diags.extend(_lint_devtime_writes(sf))
     if base in OWNING_MODULES:
         return sf.filter(diags)
     for node in ast.walk(sf.tree):

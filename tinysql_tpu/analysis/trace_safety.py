@@ -292,8 +292,10 @@ class _TaintScanner(ast.NodeVisitor):
 # ---- TS106: pipeline stage callbacks ------------------------------------
 
 _PIPELINE_CTORS = {"BlockPipeline"}
-_DEV_UPLOAD_CALLS = {"asarray", "array", "device_put"}
-_DEV_UPLOAD_ROOTS = {"jn", "jnp"}
+# kernels.h2d / h2d_pad are the COUNTED upload wrappers (ISSUE 11 h2d
+# accounting) — device-producing exactly like a bare jn.asarray
+_DEV_UPLOAD_CALLS = {"asarray", "array", "device_put", "h2d", "h2d_pad"}
+_DEV_UPLOAD_ROOTS = {"jn", "jnp", "kernels"}
 
 
 def _stage_fn_names(tree: ast.Module) -> Set[str]:

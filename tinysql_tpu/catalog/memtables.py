@@ -15,7 +15,10 @@ filter like any other source:
   ring (obs/tsring.py) — raw samples, and windowed delta/rate/avg/max
   per metric ("what changed in the last N minutes");
 - ``inspection_result``: the automated inspection engine's findings
-  (obs/inspect.py), evaluated over the ring at scan time.
+  (obs/inspect.py), evaluated over the ring at scan time;
+- ``compiled_programs``: the per-program catalog (ops/progcache.py) —
+  dispatch counts, compile walls, measured device time, cost-analysis
+  flops/bytes, joinable with ``statements_summary`` on plan_digest.
 
 Rows are produced from the live InfoSchema / obs stores at query time.
 The catalog lists ITSELF: ``information_schema`` appears in SCHEMATA,
@@ -56,6 +59,11 @@ def _inspection_cols():
     return list(COLUMNS)
 
 
+def _programs_cols():
+    from ..ops.progcache import CATALOG_COLUMNS
+    return list(CATALOG_COLUMNS)
+
+
 # table name -> [(column name, kind)];  statements_summary's layout is
 # owned by obs/stmtsummary.COLUMNS (one definition for store + catalog)
 _TABLES = {
@@ -82,6 +90,7 @@ _TABLES = {
     "metrics_history": _metrics_history_cols,
     "metrics_summary": _metrics_summary_cols,
     "inspection_result": _inspection_cols,
+    "compiled_programs": _programs_cols,
     "processlist": [("id", "int"),
                     ("user", "str"),
                     ("db", "str"),
@@ -141,6 +150,12 @@ def memtable_rows(infoschema, table: str) -> List[list]:
     if t == "inspection_result":
         from ..obs import inspect as obs_inspect
         return obs_inspect.rows()
+    if t == "compiled_programs":
+        # the per-program catalog (ops/progcache.py): dispatch counts,
+        # compile walls, measured device time, cost-analysis flops/bytes
+        # — joinable against statements_summary on plan_digest
+        from ..ops import progcache
+        return progcache.catalog_rows()
     out: List[list] = []
     if t == "schemata":
         out.append(["def", DB_NAME])

@@ -725,7 +725,7 @@ class TPUHashAggExec(Executor):
                 got = _rep_string_dict(rep, sid, chk, idx)
                 codes = got[0]
                 dv = rep.memo(("devcodes", sid, nb),
-                              lambda c=codes: jn.asarray(kernels.pad1(c, nb)))
+                              lambda c=codes: kernels.h2d_pad(c, nb))
             elif v.dtype == object or v.dtype.kind == "U":
                 if kind == "full":
                     child._replica = rep
@@ -733,9 +733,9 @@ class TPUHashAggExec(Executor):
                 dv = None
             else:
                 dv = rep.memo(("devv", sid, nb),
-                              lambda v=v: jn.asarray(kernels.pad1(v, nb)))
+                              lambda v=v: kernels.h2d_pad(v, nb))
             dn = rep.memo(("devn", sid, nb),
-                          lambda m=m: jn.asarray(kernels.pad1(m, nb, True)))
+                          lambda m=m: kernels.h2d_pad(m, nb, True))
             if dev_cols[idx] is None or dv is not None:
                 dev_cols[idx] = (dv, dn)
 
@@ -750,7 +750,7 @@ class TPUHashAggExec(Executor):
         else:
             mask = np.zeros(nb, dtype=bool)
             mask[:n] = fmask if fmask is not None else True
-            mask_spec = ("host", jn.asarray(mask))
+            mask_spec = ("host", kernels.h2d(mask))
 
         # ---- run --------------------------------------------------------
         if not plan.group_by:
@@ -765,8 +765,8 @@ class TPUHashAggExec(Executor):
             gid_dev = rep.memo(
                 ("gid_dev", tuple(slot_ids[e.index]
                                   for e in plan.group_by), nb),
-                lambda: jn.asarray(kernels.pad1(
-                    self._compose_gid(key_layouts, n), nb)))
+                lambda: kernels.h2d_pad(
+                    self._compose_gid(key_layouts, n), nb))
             mesh = self._mesh_if_enabled(nb)
             if mesh is not None:
                 present, out_aggs, first_orig = \
@@ -871,15 +871,15 @@ class TPUHashAggExec(Executor):
                 if v.dtype == object or v.dtype.kind == "U":
                     dv = None  # mask-only slot (COUNT over a string col)
                 else:
-                    dv = jn.asarray(kernels.pad1(v[start:end], bb))
-                dn = jn.asarray(kernels.pad1(m_[start:end], bb, True))
+                    dv = kernels.h2d_pad(v[start:end], bb)
+                dn = kernels.h2d_pad(m_[start:end], bb, True)
                 if dev_cols[idx] is None or dv is not None:
                     dev_cols[idx] = (dv, dn)
             bmask = np.zeros(bb, dtype=bool)
             bmask[:m_rows] = fmask[start:end] if fmask is not None \
                 else True
-            mask_spec = ("host", jn.asarray(bmask))
-            gid_b = jn.asarray(kernels.pad1(gid_full[start:end], bb)) \
+            mask_spec = ("host", kernels.h2d(bmask))
+            gid_b = kernels.h2d_pad(gid_full[start:end], bb) \
                 if key_layouts else None
             return start, m_rows, dev_cols, mask_spec, gid_b
 
@@ -1370,7 +1370,7 @@ class TPUHashAggExec(Executor):
                 return outs
             return kernels.counted_jit(kernel)
         fn = progcache.get(key, build)
-        outs = fn(ids, live, list(out_aggs), jn.asarray(lay))
+        outs = fn(ids, live, list(out_aggs), kernels.h2d(lay))
         cols = []
         for (src, idx), (v, m) in zip(plan.output_map, outs):
             ft = (plan.aggs[idx].ret_type if src == "agg"
@@ -1620,8 +1620,8 @@ class TPUHashJoinExec(Executor):
                 kv, kn = pk[s_:e_], pn[s_:e_]
                 if dev_stage:
                     blk = kernels.bucket(max(m, 1))
-                    kv = jn.asarray(kernels.pad1(kv, blk))
-                    kn = jn.asarray(kernels.pad1(kn, blk, True))
+                    kv = kernels.h2d_pad(kv, blk)
+                    kn = kernels.h2d_pad(kn, blk, True)
                 pm = None if pmask is None else pmask[s_:e_]
                 return s_, (kv, kn), m, pm
 
@@ -1930,11 +1930,9 @@ class TPUHashJoinExec(Executor):
                 m = col.null_mask()
                 if v.dtype != object and v.dtype.kind != "U":
                     dv = rep.memo(("devv", sid, nb),
-                                  lambda v=v: jn.asarray(
-                                      kernels.pad1(v, nb)))
+                                  lambda v=v: kernels.h2d_pad(v, nb))
                     dn = rep.memo(("devn", sid, nb),
-                                  lambda m=m: jn.asarray(
-                                      kernels.pad1(m, nb, True)))
+                                  lambda m=m: kernels.h2d_pad(m, nb, True))
                     return dv, dn
         return key_expr.vec_eval(chk)
 
@@ -2090,7 +2088,7 @@ class TPUProjectionExec(Executor):
                                     for e in self.plan.exprs)
             pt = ParamTable()
             fns = [compile_expr_params(e, pt) for e in self.plan.exprs]
-            self._params = [kernels.jnp().asarray(a) for a in pt.arrays()]
+            self._params = [kernels.h2d(a) for a in pt.arrays()]
 
             def build():
                 def kernel(cols, params, fns=fns):
@@ -2142,7 +2140,7 @@ class TPUSelectionExec(Executor):
                                       for c in self.plan.conditions)
             pt = ParamTable()
             fns = [compile_expr_params(c, pt) for c in self.plan.conditions]
-            self._params = [kernels.jnp().asarray(a) for a in pt.arrays()]
+            self._params = [kernels.h2d(a) for a in pt.arrays()]
 
             def build():
                 jn = kernels.jnp()

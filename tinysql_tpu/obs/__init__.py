@@ -44,6 +44,15 @@ Three cooperating pieces:
   and the metric evidence window.  The serving path attributes each
   statement's queue/batch wait (server/pool.py measurement → spans,
   summary columns, slow-log fields, the ``queue`` phase histogram).
+- **device-time truth** (ops/profiler.py + ops/progcache.py, ISSUE
+  11): the default timings are host walls around ASYNC enqueues; the
+  opt-in sampling profiler (``tidb_device_profile_rate``) closes
+  sampled dispatches with ``block_until_ready`` so ``device_s`` /
+  ``compile_s`` carry measured truth into EXPLAIN ANALYZE,
+  ``statements_summary``, the per-program catalog
+  (``information_schema.compiled_programs``), and the
+  ``tinysql_dispatch_device_seconds`` histogram (qlint OB405 guards
+  the write path).
 
 See docs/OBSERVABILITY.md.
 """

@@ -31,7 +31,21 @@ tests/test_tsring.py):
   repartitioning fires (working sets far beyond the budget);
 - **prewarm-starvation**: the auto-prewarm worker left candidates
   unwarmed (budget exhausted / errors) while cold-run-shaped latency
-  exists — the cold-start killer is starved.
+  exists — the cold-start killer is starved;
+- **dispatch-storm** (ISSUE 11): device dispatches per query regressed
+  past the threshold within the window — plan fusion / micro-batching
+  stopped covering the workload, or block sizes collapsed;
+- **transfer-bound** (ISSUE 11): D2H bytes moved in the window dwarf
+  the MEASURED device busy time (sampling profiler on) — latency is
+  the link, not the kernels;
+- **recompile-churn** (ISSUE 11): program builds keep landing on WARM
+  digest families (statements_summary: misses across executions far
+  beyond the first run's) — literal parameterization or shape
+  bucketing regressed for those families;
+- **slo-burn** (ISSUE 11, ROADMAP item 3): the exec-phase latency
+  histogram shows > 1% of windowed measurements over the armed
+  ``tidb_slo_p99_ms`` — the p99 objective's error budget is burning.
+  Fed by the ``slo`` ring source (:func:`slo_sample`).
 
 Thresholds are module-level constants, deliberately conservative: an
 inspection finding is a diagnosis, so false positives cost trust.
@@ -66,6 +80,25 @@ COOLDOWN_FLAP_LOSSES = 2
 #: spilled bytes within the window that make the spill-pressure rule
 #: speak up (a trickle of spilling is the feature working as designed)
 SPILL_PRESSURE_BYTES = 1 << 20
+#: device dispatches per query that count as a storm (warm fused plans
+#: run 1-6 programs per query; micro-batching drives it under 1), and
+#: the minimum windowed query traffic before the ratio may judge
+DISPATCH_STORM_PER_QUERY = 12
+DISPATCH_STORM_MIN_QUERIES = 10
+#: D2H volume floor and the bytes-per-measured-device-second ratio
+#: beyond which a window reads transfer-bound (1 GiB per busy second:
+#: the link is doing orders of magnitude more work than the device)
+TRANSFER_BOUND_MIN_BYTES = 32 << 20
+TRANSFER_BOUND_BYTES_PER_DEVICE_S = 1 << 30
+#: recompile churn: a digest family with at least this many executions
+#: whose summed program builds exceed misses-per-exec x executions is
+#: compiling on WARM runs, not just its first
+RECOMPILE_MIN_EXECS = 4
+RECOMPILE_MISSES_PER_EXEC = 1.5
+#: SLO burn: minimum windowed exec measurements before judging, and the
+#: breach fraction that burns a p99 objective's error budget (1%)
+SLO_MIN_MEASUREMENTS = 20
+SLO_BURN_FRAC = 0.01
 
 
 class Finding:
@@ -169,6 +202,59 @@ class InspectionContext:
     def summary_records(self) -> List[dict]:
         from . import stmtsummary
         return stmtsummary.snapshot()
+
+
+# ---- the SLO objective (tidb_slo_p99_ms) ----------------------------------
+
+#: the armed p99 objective in MILLISECONDS (0 = no SLO): process-global
+#: module state applied by the session SET hook / server start, like
+#: kernels.set_compile_cache_dir — there is one latency surface
+SLO_STATE = {"p99_ms": 0.0}
+
+
+def set_slo_p99_ms(ms: float) -> None:
+    try:
+        v = float(ms)
+    except (TypeError, ValueError):
+        v = 0.0
+    SLO_STATE["p99_ms"] = max(v, 0.0)
+
+
+def slo_p99_ms() -> float:
+    return SLO_STATE["p99_ms"]
+
+
+def slo_sample() -> Dict[str, float]:
+    """The ``slo`` ring source payload: total exec-phase measurements
+    and the count PROVABLY over the armed threshold (bucket lower edge
+    >= SLO — conservative; the overflow bucket counts whenever the SLO
+    sits at or under the last bound).  Sampled into the ring so the
+    slo-burn rule judges a windowed DELTA, not the whole process
+    history.  Empty while no SLO is armed."""
+    slo_ms = SLO_STATE["p99_ms"]
+    if slo_ms <= 0:
+        return {}
+    from .stmtsummary import histogram_snapshot
+    h = histogram_snapshot().get("exec")
+    if not h:
+        return {}
+    slo_s = slo_ms / 1e3
+    over = 0
+    prev = 0.0
+    for le, count in h["buckets"]:
+        if prev >= slo_s:
+            over += count
+        prev = le
+    if slo_s <= prev:
+        over += h.get("overflow", 0)
+    # the armed threshold rides along as a gauge: breach counts are
+    # recomputed over the cumulative histogram against the CURRENT
+    # threshold, so the slo-burn rule must discard windows where the
+    # objective changed (a lowered SLO would otherwise reclassify all
+    # history as one window's breach delta)
+    return {"tinysql_slo_exec_measurements_total": h["count"],
+            "tinysql_slo_exec_breaches_total": over,
+            "tinysql_slo_p99_ms": slo_ms}
 
 
 # ---- the rule catalogue ---------------------------------------------------
@@ -318,6 +404,113 @@ def _rule_prewarm_starvation(ctx: InspectionContext) -> List[Finding]:
             "window: their families stay cold for the cooldown",
             "tinysql_prewarm_worker_errors_total"))
     return out
+
+
+@rule("dispatch-storm")
+def _rule_dispatch_storm(ctx: InspectionContext) -> List[Finding]:
+    queries = ctx.delta("tinysql_queries_total")
+    if queries < DISPATCH_STORM_MIN_QUERIES:
+        return []
+    dispatches = ctx.delta("tinysql_dispatches_total")
+    per_query = dispatches / queries
+    if per_query < DISPATCH_STORM_PER_QUERY:
+        return []
+    sev = "critical" if per_query >= 2 * DISPATCH_STORM_PER_QUERY \
+        else "warning"
+    return [ctx.evidence(
+        "dispatch-storm", "dispatches", sev,
+        f"{per_query:.1f} device dispatches per query over "
+        f"{queries:.0f} statements within the window (threshold "
+        f"{DISPATCH_STORM_PER_QUERY}): plan fusion / micro-batching "
+        "stopped covering this workload, or block sizes collapsed — "
+        "every extra dispatch pays the link's round trip",
+        "tinysql_dispatches_total")]
+
+
+@rule("transfer-bound")
+def _rule_transfer_bound(ctx: InspectionContext) -> List[Finding]:
+    moved = ctx.delta("tinysql_d2h_bytes_total")
+    if moved < TRANSFER_BOUND_MIN_BYTES:
+        return []
+    profiled = ctx.delta("tinysql_profiled_dispatches_total")
+    if profiled <= 0:
+        # no measured device time in the window: judging the ratio
+        # against an async submit wall would be exactly the fiction
+        # this PR removes
+        return []
+    busy = ctx.delta("tinysql_device_busy_seconds_total")
+    # busy accrues only on SAMPLED dispatches: at a fractional profile
+    # rate it covers ~rate of the true device time, so extrapolate by
+    # the window's dispatches-per-profiled-dispatch before judging —
+    # otherwise a healthy workload at rate 0.1 reads 10x too
+    # transfer-bound
+    dispatches = ctx.delta("tinysql_dispatches_total")
+    est_busy = busy * (max(dispatches, profiled) / profiled)
+    ratio = moved / max(est_busy, 1e-9)
+    if ratio < TRANSFER_BOUND_BYTES_PER_DEVICE_S:
+        return []
+    return [ctx.evidence(
+        "transfer-bound", "d2h", "warning",
+        f"{moved / (1 << 20):.1f} MiB pulled device-to-host against "
+        f"~{est_busy * 1e3:.1f} ms of device busy time within the "
+        f"window (measured {busy * 1e3:.1f} ms over {profiled:.0f} of "
+        f"{dispatches:.0f} dispatches): the workload is transfer-bound "
+        "— push projections/filters device-side, or keep results "
+        "resident (tidb_device_passthrough)",
+        "tinysql_d2h_bytes_total")]
+
+
+@rule("recompile-churn")
+def _rule_recompile_churn(ctx: InspectionContext) -> List[Finding]:
+    out: List[Finding] = []
+    for r in ctx.summary_records():
+        n = int(r.get("exec_count", 0))
+        if n < RECOMPILE_MIN_EXECS:
+            continue
+        misses = float(r.get("device", {}).get("progcache_misses", 0))
+        if misses <= n * RECOMPILE_MISSES_PER_EXEC:
+            continue
+        out.append(Finding(
+            "recompile-churn", r.get("digest", ""), "warning",
+            f"{misses:.0f} program builds across {n} executions of a "
+            "warm digest family (threshold "
+            f"{RECOMPILE_MISSES_PER_EXEC}/exec beyond the first run): "
+            "literal parameterization or shape bucketing stopped "
+            "covering this family — constant variants are compiling "
+            "instead of hitting", "tinysql_progcache_misses_total"))
+    return out
+
+
+@rule("slo-burn")
+def _rule_slo_burn(ctx: InspectionContext) -> List[Finding]:
+    slo_ms = SLO_STATE["p99_ms"]
+    if slo_ms <= 0:
+        return []
+    # an objective that CHANGED within (or since) the window makes the
+    # breach delta meaningless — the samples were judged against
+    # different thresholds; wait for a stable window
+    armed = ctx.series("tinysql_slo_p99_ms")
+    if armed and (min(v for _, v in armed) != max(v for _, v in armed)
+                  or armed[-1][1] != slo_ms):
+        return []
+    total = ctx.delta("tinysql_slo_exec_measurements_total")
+    if total < SLO_MIN_MEASUREMENTS:
+        return []
+    over = ctx.delta("tinysql_slo_exec_breaches_total")
+    if over <= 0:
+        return []
+    frac = over / total
+    if frac <= SLO_BURN_FRAC:
+        return []
+    sev = "critical" if frac >= 5 * SLO_BURN_FRAC else "warning"
+    return [ctx.evidence(
+        "slo-burn", "p99", sev,
+        f"{over:.0f} of {total:.0f} statements ({frac:.1%}) exceeded "
+        f"the armed tidb_slo_p99_ms={slo_ms:g} within the window "
+        f"(budget {SLO_BURN_FRAC:.0%} for a p99 objective): the error "
+        "budget is burning — split the regression into queue wait vs "
+        "execution via the phase histograms and statements_summary",
+        "tinysql_slo_exec_breaches_total")]
 
 
 # ---- evaluation -----------------------------------------------------------

@@ -32,6 +32,17 @@ _DEVICE_METRICS = {
                       "Device-to-host transfer operations"),
     "d2h_bytes": ("tinysql_d2h_bytes_total",
                   "Bytes materialized device-to-host"),
+    "h2d_transfers": ("tinysql_h2d_transfers_total",
+                      "Host-to-device upload operations (ParamTable "
+                      "pushes, column/mask uploads)"),
+    "h2d_bytes": ("tinysql_h2d_bytes_total",
+                  "Bytes uploaded host-to-device"),
+    "device_s": ("tinysql_device_busy_seconds_total",
+                 "MEASURED device busy seconds from profiled dispatches "
+                 "(block_until_ready-closed; tidb_device_profile_rate)"),
+    "profiled_dispatches": ("tinysql_profiled_dispatches_total",
+                            "Dispatches closed with block_until_ready "
+                            "by the sampling profiler"),
     "host_dispatches": ("tinysql_host_dispatches_total",
                         "Host-twin kernel invocations (numpy twins "
                         "serving the XLA:CPU backend)"),
@@ -82,6 +93,24 @@ METRICS: Dict[str, Tuple[str, str]] = {
         ("counter", "Query-path registry hits on prewarm-seeded programs "
                     "(compiles the prewarmer saved real queries)"),
     "tinysql_progcache_programs": ("gauge", "Registered compiled programs"),
+    "tinysql_compile_seconds_total":
+        ("counter", "Summed program-build wall seconds (inclusive of "
+                    "nested builds, like the compile spans)"),
+    "tinysql_pending_cost_analyses":
+        ("gauge", "Deferred XLA cost analyses awaiting resolution "
+                  "(drained by the tsring sampler tick / bench; "
+                  "bounded at kernels.PENDING_COSTS_MAX)"),
+    # SLO error-budget accounting (obs/inspect.slo_sample, fed from the
+    # exec-phase latency histogram against tidb_slo_p99_ms)
+    "tinysql_slo_exec_measurements_total":
+        ("counter", "Exec-phase latency measurements while an SLO "
+                    "(tidb_slo_p99_ms) was armed"),
+    "tinysql_slo_exec_breaches_total":
+        ("counter", "Exec-phase measurements provably over the armed "
+                    "tidb_slo_p99_ms threshold"),
+    "tinysql_slo_p99_ms":
+        ("gauge", "The armed SLO threshold at sample time (the slo-burn "
+                  "rule discards windows where it changed)"),
     # resilience (fail/, ops/degrade.py, utils/memory.py)
     "tinysql_failpoint_hits_total":
         ("counter", "Failpoint fires by name"),
@@ -152,6 +181,9 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "tinysql_stmt_phase_seconds":
         ("histogram", "Statement latency by phase (statement summary "
                       "store)"),
+    "tinysql_dispatch_device_seconds":
+        ("histogram", "Measured device busy time per profiled dispatch "
+                      "(ops/profiler.py, tidb_device_profile_rate)"),
     "tinysql_trace_ring_entries":
         ("gauge", "Query traces buffered for /debug/trace"),
     # time-series sampler self-accounting (obs/tsring.py)
@@ -276,10 +308,21 @@ def render_prometheus() -> str:
             continue
         mtype = "gauge" if key in hwm_keys else "counter"
         emit(name, help_text, mtype, [((), stats[key])])
+    try:
+        pending = len(kernels._PENDING_COSTS) if stats else 0
+    except Exception:
+        pending = None
+    if pending is not None and stats:
+        emit("tinysql_pending_cost_analyses",
+             METRICS["tinysql_pending_cost_analyses"][1], "gauge",
+             [((), pending)])
     if pstats:
         emit("tinysql_progcache_hits_total",
              "In-process program-registry hits", "counter",
              [((), pstats.get("hits", 0))])
+        emit("tinysql_compile_seconds_total",
+             METRICS["tinysql_compile_seconds_total"][1], "counter",
+             [((), pstats.get("compile_wall_s", 0.0))])
         emit("tinysql_progcache_misses_total",
              "In-process program-registry misses (program builds)",
              "counter", [((), pstats.get("misses", 0))])
@@ -468,6 +511,26 @@ def render_prometheus() -> str:
             lines.append(f'{name}_sum{{phase="{phase}"}} '
                          f'{_fmt_value(float(h["sum"]))}')
             lines.append(f'{name}_count{{phase="{phase}"}} {h["count"]}')
+
+    # measured device-time-per-dispatch histogram (ops/profiler.py) —
+    # empty until tidb_device_profile_rate samples a dispatch
+    try:
+        from ..ops.profiler import histogram_snapshot as prof_hist
+        ph = prof_hist()
+    except Exception:
+        ph = {"count": 0}
+    if ph.get("count"):
+        name = "tinysql_dispatch_device_seconds"
+        lines.append(f"# HELP {name} "
+                     f"{METRICS[name][1]}")
+        lines.append(f"# TYPE {name} histogram")
+        cum = 0
+        for le, count in ph["buckets"]:
+            cum += count
+            lines.append(f'{name}_bucket{{le="{le:g}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {ph["count"]}')
+        lines.append(f'{name}_sum {_fmt_value(float(ph["sum"]))}')
+        lines.append(f'{name}_count {ph["count"]}')
 
     from .trace import recent_traces
     emit("tinysql_trace_ring_entries", "Query traces buffered for "

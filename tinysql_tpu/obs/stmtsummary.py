@@ -224,8 +224,16 @@ class StmtRecord:
             round(self.sum_ms.get("batch", 0.0), 3),
             self.queued_count,
             int(d.get("dispatches", 0)), int(d.get("d2h_transfers", 0)),
-            int(d.get("d2h_bytes", 0)), int(d.get("progcache_hits", 0)),
+            int(d.get("d2h_bytes", 0)),
+            int(d.get("h2d_transfers", 0)), int(d.get("h2d_bytes", 0)),
+            int(d.get("progcache_hits", 0)),
             int(d.get("progcache_misses", 0)),
+            # device-time truth (ISSUE 11): MEASURED device busy ms from
+            # profiled dispatches (0 with tidb_device_profile_rate=0)
+            # and the program-build wall attributed to these executions
+            round(float(d.get("device_s", 0.0)) * 1e3, 3),
+            int(d.get("profiled_dispatches", 0)),
+            round(float(d.get("compile_s", 0.0)) * 1e3, 3),
             int(d.get("pipe_blocks", 0)), self._overlap_frac(),
             int(d.get("coalesced", 0)),
             int(d.get("spill_bytes", 0)), self.max_spill_bytes,
@@ -262,7 +270,10 @@ COLUMNS = [
     ("sum_queue_wait_ms", "real"), ("max_queue_wait_ms", "real"),
     ("sum_batch_wait_ms", "real"), ("queued_count", "int"),
     ("dispatches", "int"), ("d2h_transfers", "int"), ("d2h_bytes", "int"),
+    ("h2d_transfers", "int"), ("h2d_bytes", "int"),
     ("compile_cache_hits", "int"), ("compile_cache_misses", "int"),
+    ("sum_device_ms", "real"), ("profiled_dispatches", "int"),
+    ("sum_compile_ms", "real"),
     ("pipe_blocks", "int"), ("pipe_overlap_frac", "real"),
     ("coalesced", "int"),
     ("sum_spill_bytes", "int"), ("max_spill_bytes", "int"),

@@ -298,6 +298,22 @@ def summary_rows() -> List[list]:
     return RING.summary_rows()
 
 
+def drain_pending_costs() -> None:
+    """Resolve deferred XLA cost analyses (kernels._PENDING_COSTS) —
+    called every Sampler tick.  Before ISSUE 11 only bench.py ever
+    drained the queue, so serving mode with cost tracking enabled
+    accumulated pending analyses forever and flops/bytes undercounted;
+    the sampler is the natural steady-state drainer (off the query
+    path, already paced).  Exception-isolated: a broken backend must
+    not kill the sampler thread."""
+    try:
+        from ..ops import kernels
+        if kernels._PENDING_COSTS:
+            kernels.resolve_pending_costs()
+    except Exception:
+        pass
+
+
 def measure_overhead(n: int = 50) -> Dict[str, float]:
     """The sampler's steady-state cost, THE definition both benches
     publish as ``obs_overhead_frac``: one sample's wall (averaged over
@@ -373,6 +389,11 @@ class Sampler:
             if elapsed + 1e-9 < interval:
                 continue
             elapsed = 0.0
+            # deferred cost analyses resolve on the sampler's cadence
+            # (the serving-mode _PENDING_COSTS drain, ISSUE 11) — BEFORE
+            # the sample, so resolved flops/bytes start accruing into
+            # the very counters this tick snapshots
+            drain_pending_costs()
             try:
                 self.ring.sample_once(
                     retention_s=self._int_sysvar(
@@ -397,9 +418,11 @@ def _src_kernels() -> Dict[str, float]:
     from ..ops import kernels
     from .metrics import _DEVICE_METRICS
     stats = dict(kernels.STATS)
-    return {name: stats[key]
-            for key, (name, _help) in _DEVICE_METRICS.items()
-            if key in stats}
+    out = {name: stats[key]
+           for key, (name, _help) in _DEVICE_METRICS.items()
+           if key in stats}
+    out["tinysql_pending_cost_analyses"] = len(kernels._PENDING_COSTS)
+    return out
 
 
 def _src_progcache() -> Dict[str, float]:
@@ -409,6 +432,7 @@ def _src_progcache() -> Dict[str, float]:
             "tinysql_progcache_misses_total": p.get("misses", 0),
             "tinysql_prewarm_seeded_total": p.get("prewarm_seeded", 0),
             "tinysql_prewarm_hits_total": p.get("prewarm_hits", 0),
+            "tinysql_compile_seconds_total": p.get("compile_wall_s", 0.0),
             "tinysql_progcache_programs": progcache.size()}
 
 
@@ -484,6 +508,14 @@ def _src_prewarm() -> Dict[str, float]:
             for k, v in stats_snapshot().items()}
 
 
+def _src_slo() -> Dict[str, float]:
+    # SLO error-budget accounting: empty while tidb_slo_p99_ms is
+    # unarmed (obs/inspect.slo_sample owns the bucket-edge math so the
+    # source and the slo-burn rule share one definition)
+    from . import inspect as oinspect
+    return oinspect.slo_sample()
+
+
 def _src_tsring() -> Dict[str, float]:
     s = stats_snapshot()
     return {"tinysql_metrics_samples_total": s.get("samples", 0),
@@ -500,5 +532,6 @@ for _name, _fn in (("queries", _src_queries), ("kernels", _src_kernels),
                    ("batching", _src_batching), ("memory", _src_memory),
                    ("spill", _src_spill), ("degrade", _src_degrade),
                    ("failpoints", _src_failpoints),
-                   ("prewarm", _src_prewarm), ("tsring", _src_tsring)):
+                   ("prewarm", _src_prewarm), ("slo", _src_slo),
+                   ("tsring", _src_tsring)):
     register_source(_name, _fn)

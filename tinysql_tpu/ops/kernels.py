@@ -16,13 +16,14 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 
 from functools import partial
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from . import progcache
+from . import profiler, progcache
 from .. import fail
 from ..obs import context as _obs
 
@@ -250,7 +251,9 @@ def jnp():
 # staging-queue depth high-water mark (reported as an absolute value by
 # stats_delta — a high-water is not a per-interval delta).
 STATS = {"dispatches": 0, "d2h_transfers": 0, "d2h_bytes": 0,
+         "h2d_transfers": 0, "h2d_bytes": 0,
          "host_dispatches": 0,
+         "device_s": 0.0, "profiled_dispatches": 0,
          "flops": 0.0, "bytes_accessed": 0.0,
          "pipe_blocks": 0, "pipe_stage_s": 0.0, "pipe_dispatch_s": 0.0,
          "pipe_drain_s": 0.0, "pipe_wall_s": 0.0, "pipe_depth_hwm": 0}
@@ -378,14 +381,20 @@ def _abstractify(tree):
 
 
 # (costs dict, spec, jitted fn, abstract args) awaiting cost analysis —
-# resolved OUTSIDE the timed region (resolve_pending_costs), so the AOT
-# retrace never inflates the walls the MFU is computed from
+# resolved OUTSIDE the timed region (resolve_pending_costs: the bench
+# between timed runs, the tsring Sampler every tick in serving mode), so
+# the AOT retrace never inflates the walls the MFU is computed from.
+# BOUNDED: beyond the cap a new spec records (0, 0) instead of queueing
+# — with cost tracking on and no drainer the list must not grow forever
+# (the pre-ISSUE-11 serving-mode leak)
 _PENDING_COSTS: list = []
+PENDING_COSTS_MAX = 256
 
 
 def resolve_pending_costs() -> None:
     """Run the deferred cost analyses (bench calls this between timed
-    runs).  Unresolvable programs record (0, 0)."""
+    runs; the tsring Sampler drains it every tick).  Unresolvable
+    programs record (0, 0)."""
     while _PENDING_COSTS:
         costs, spec, w, absargs = _PENDING_COSTS.pop()
         a, k = absargs
@@ -403,30 +412,78 @@ def counted_jit(fn, **kw):
     """jax.jit wrapper that counts program dispatches (and, when cost
     tracking is on, the dispatched program's flops / bytes accessed —
     first sight of a (program, shape) only ENQUEUES the analysis; counts
-    accrue on dispatches after resolve_pending_costs ran)."""
+    accrue on dispatches after resolve_pending_costs ran).
+
+    Constructed inside a progcache builder, the wrapper learns its
+    registry key (progcache.building_key) and reports every dispatch to
+    the per-program catalog; when the sampling profiler is on
+    (ops/profiler.py, tidb_device_profile_rate) a sampled dispatch is
+    closed with block_until_ready so the recorded wall is MEASURED
+    device busy time, not async submit time."""
     # qlint: disable=TS104 -- counted_jit IS the wrapper factory; callers cache its result
     w = jax().jit(fn, **kw)
     costs: Dict[tuple, Optional[tuple]] = {}
+    prog_key = progcache.building_key()
 
     def call(*a, **k):
         fail.inject("kernelDispatchError")
         stats_add("dispatches", 1)
+        cost = None
         if _COST_TRACKING["on"]:
             spec = _arg_spec((a, k))
             c = costs.get(spec)
             if c is not None:
+                cost = c
                 stats_add("flops", c[0])
                 stats_add("bytes_accessed", c[1])
             elif spec not in costs:
-                costs[spec] = None
-                _PENDING_COSTS.append((costs, spec, w,
-                                       _abstractify((a, k))))
+                if len(_PENDING_COSTS) >= PENDING_COSTS_MAX:
+                    # nothing is draining the queue: record zeros (an
+                    # honest undercount) instead of leaking memory
+                    costs[spec] = (0.0, 0.0)
+                else:
+                    costs[spec] = None
+                    _PENDING_COSTS.append((costs, spec, w,
+                                           _abstractify((a, k))))
+        sampled = profiler.should_sample()
+        t0 = time.perf_counter() if sampled else 0.0
         with _obs.span("dispatch", cat="device"):
-            return w(*a, **k)
+            res = w(*a, **k)
+            if sampled:
+                # close the async enqueue: the span and the recorded
+                # wall now cover true device busy time for this dispatch
+                jax().block_until_ready(res)
+        if sampled:
+            dt = time.perf_counter() - t0
+            stats_add("device_s", dt)
+            stats_add("profiled_dispatches", 1)
+            profiler.observe(dt)
+            progcache.note_dispatch(prog_key, device_s=dt, cost=cost)
+        else:
+            progcache.note_dispatch(prog_key, cost=cost)
+        return res
     # AOT hook for the bucket prewarmer (tools/warm.py):
     # fn.lower(*abstract).compile() compiles without dispatching
     call.lower = w.lower
     return call
+
+
+def h2d(a):
+    """Counted host->device upload — the H2D mirror of :func:`d2h`, so
+    transfer accounting is symmetric (pre-ISSUE-11, ParamTable pushes
+    and column uploads were invisible: d2h had counters, h2d had none).
+    One transfer per array; bytes charged from the HOST buffer."""
+    host = np.asarray(a)
+    out = jnp().asarray(host)
+    stats_add("h2d_transfers", 1)
+    stats_add("h2d_bytes", int(host.nbytes))
+    return out
+
+
+def h2d_pad(a: np.ndarray, n: int, fill=0):
+    """Counted upload of ``pad1(a, n, fill)`` — THE bucketed column
+    upload idiom (bytes charged at the padded size actually shipped)."""
+    return h2d(pad1(a, n, fill))
 
 
 def d2h(dev_arr) -> np.ndarray:
@@ -696,15 +753,15 @@ def group_aggregate(key_cols: List[Tuple[np.ndarray, np.ndarray]],
         valid[:n_rows] = filter_mask
     else:
         valid[:n_rows] = True
-    kv = [jn.asarray(pad1(v, nb)) for v, _ in key_cols]
-    kn = [jn.asarray(pad1(m, nb, True)) for _, m in key_cols]
-    av = [jn.asarray(pad1(v, nb)) for v, _ in arg_cols]
-    an = [jn.asarray(pad1(m, nb, True)) for _, m in arg_cols]
+    kv = [h2d_pad(v, nb) for v, _ in key_cols]
+    kn = [h2d_pad(m, nb, True) for _, m in key_cols]
+    av = [h2d_pad(v, nb) for v, _ in arg_cols]
+    an = [h2d_pad(m, nb, True) for _, m in arg_cols]
     key = ("group_agg", len(key_cols), tuple(agg_specs), nb,
            tuple(str(v.dtype) for v in kv), tuple(str(v.dtype) for v in av))
     fn = progcache.get(key, lambda: _group_agg_kernel(len(key_cols),
                                                       tuple(agg_specs)))
-    n_groups, first_orig, gkeys, outs = fn(kv, kn, jn.asarray(valid), av, an)
+    n_groups, first_orig, gkeys, outs = fn(kv, kn, h2d(valid), av, an)
     items = [first_orig]
     for v, m in gkeys:
         items += [v, m]
@@ -797,9 +854,9 @@ def segment_group_aggregate(gids: np.ndarray, n_segments: int,
         valid[:n_rows] = filter_mask
     else:
         valid[:n_rows] = True
-    g = jn.asarray(pad1(gids.astype(np.int64), nb))
-    av = [jn.asarray(pad1(v, nb)) for v, _ in arg_cols]
-    an = [jn.asarray(pad1(m, nb, True)) for _, m in arg_cols]
+    g = h2d_pad(gids.astype(np.int64), nb)
+    av = [h2d_pad(v, nb) for v, _ in arg_cols]
+    an = [h2d_pad(m, nb, True) for _, m in arg_cols]
     # bucket the segment count too: one compiled kernel serves every
     # cardinality in the bucket (gids above the true count never occur,
     # their segments simply stay empty and are compressed away)
@@ -808,7 +865,7 @@ def segment_group_aggregate(gids: np.ndarray, n_segments: int,
            tuple(str(v.dtype) for v in av))
     fn = progcache.get(key, lambda: _segment_agg_kernel(tuple(agg_specs),
                                                         ns))
-    presence, first_orig, outs, n_present = fn(g, jn.asarray(valid), av, an)
+    presence, first_orig, outs, n_present = fn(g, h2d(valid), av, an)
     return _present_extract(presence, first_orig, outs, n_present, ns)
 
 
@@ -1014,7 +1071,7 @@ def _params_dev(params):
     if params is None:
         return (_EMPTY_I64, _EMPTY_F64)
     pi, pf = params
-    return (jn.asarray(pi), jn.asarray(pf))
+    return (h2d(pi), h2d(pf))
 
 
 def _lower_arg(e):
@@ -1375,13 +1432,13 @@ def scalar_aggregate(agg_specs, arg_cols, n_rows: int,
         valid[:n_rows] = filter_mask
     else:
         valid[:n_rows] = True
-    av = [jn.asarray(pad1(v, nb)) for v, _ in arg_cols]
-    an = [jn.asarray(pad1(m, nb, True)) for _, m in arg_cols]
+    av = [h2d_pad(v, nb) for v, _ in arg_cols]
+    an = [h2d_pad(m, nb, True) for _, m in arg_cols]
     key = ("scalar_agg", tuple(agg_specs), nb,
            tuple(str(v.dtype) for v in av))
     fn, schema = progcache.get(key,
                                lambda: _scalar_agg_kernel(tuple(agg_specs)))
-    return _unpack_scalar_agg(unpack_flat(fn(jn.asarray(valid), av, an),
+    return _unpack_scalar_agg(unpack_flat(fn(h2d(valid), av, an),
                                           schema))
 
 
@@ -1529,7 +1586,7 @@ def join_match(lkey: Tuple[np.ndarray, np.ndarray], n_left: int,
     def dev(a, n, fill):
         # already-padded device arrays (replica-memoized keys) pass through
         if isinstance(a, np.ndarray):
-            return jn.asarray(pad1(a, n, fill))
+            return h2d_pad(a, n, fill)
         assert a.shape[0] == n, (a.shape, n)
         return a
     lk = dev(lkey[0], nlb, 0)
@@ -1538,8 +1595,8 @@ def join_match(lkey: Tuple[np.ndarray, np.ndarray], n_left: int,
     rn = dev(rkey[1], nrb, True)
     ck = ("join_count", nlb, nrb, str(lk.dtype), str(rk.dtype))
     cfn = progcache.get(ck, _join_count_kernel)
-    lv_dev = jn.asarray(lv)
-    counts, lo, rperm, totals = cfn(lk, ln, lv_dev, rk, rn, jn.asarray(rv))
+    lv_dev = h2d(lv)
+    counts, lo, rperm, totals = cfn(lk, ln, lv_dev, rk, rn, h2d(rv))
     totals = d2h(totals)  # ONE scalar-pair sync
     n_out = int(totals[1]) if outer else int(totals[0])
     if n_out == 0:
@@ -1707,7 +1764,7 @@ def unique_join_match(lkey, n_left: int, rkey, n_right: int,
 
     def dev(a, n, fill):
         if isinstance(a, np.ndarray):
-            return jn.asarray(pad1(a, n, fill))
+            return h2d_pad(a, n, fill)
         assert a.shape[0] == n, (a.shape, n)
         return a
     lk = dev(lkey[0], nlb, 0)
@@ -1717,8 +1774,8 @@ def unique_join_match(lkey, n_left: int, rkey, n_right: int,
     ck = ("unique_join", nlb, nrb, str(lk.dtype), str(rk.dtype),
           build_sorted)
     fn = progcache.get(ck, lambda: _unique_join_kernel(build_sorted))
-    lv_dev = jn.asarray(lv)
-    match, cand, n_match = fn(lk, ln, lv_dev, rk, rn, jn.asarray(rv))
+    lv_dev = h2d(lv)
+    match, cand, n_match = fn(lk, ln, lv_dev, rk, rn, h2d(rv))
     if outer:
         # ALL valid left rows survive — NULL-key rows match nothing and
         # null-extend; the output size is host-known (lv is host-side),
@@ -1846,7 +1903,7 @@ def semi_join_match(lkey, n_left: int, rkey, n_right: int,
 
     def dev(a, n, fill):
         if isinstance(a, np.ndarray):
-            return jn.asarray(pad1(a, n, fill))
+            return h2d_pad(a, n, fill)
         assert a.shape[0] == n, (a.shape, n)
         return a
     lk = dev(lkey[0], nlb, 0)
@@ -1859,7 +1916,7 @@ def semi_join_match(lkey, n_left: int, rkey, n_right: int,
     ck = ("semi_match", anti, null_aware, nlb, nrb,
           str(lk.dtype), str(rk.dtype))
     fn = progcache.get(ck, lambda: _semi_kernel(anti, null_aware))
-    keep, n_keep = fn(lk, ln, jn.asarray(lv), rk, rn, jn.asarray(rv))
+    keep, n_keep = fn(lk, ln, h2d(lv), rk, rn, h2d(rv))
     n_out = int(n_keep)  # one scalar sync
     if n_out == 0:
         return np.empty(0, dtype=np.int64)
@@ -1906,11 +1963,11 @@ def sort_permutation(key_cols: List[Tuple[np.ndarray, np.ndarray]],
     nb = bucket(max(n_rows, 1))
     valid = np.zeros(nb, dtype=bool)
     valid[:n_rows] = True
-    kv = [jn.asarray(pad1(v, nb)) for v, _ in key_cols]
-    kn = [jn.asarray(pad1(m, nb, True)) for _, m in key_cols]
+    kv = [h2d_pad(v, nb) for v, _ in key_cols]
+    kn = [h2d_pad(m, nb, True) for _, m in key_cols]
     key = ("sort", tuple(descs), nb, tuple(str(v.dtype) for v in kv))
     fn = progcache.get(key, lambda: _sort_kernel(tuple(descs)))
-    perm = d2h(fn(kv, kn, jn.asarray(valid)))
+    perm = d2h(fn(kv, kn, h2d(valid)))
     return perm[:n_rows]
 
 
@@ -1957,7 +2014,7 @@ def _topk_single(key, desc: bool, n_rows: int, k: int):
         return None
     ck = ("topk", nb, kb, str(score.dtype))
     fn = progcache.get(ck, lambda: _topk_kernel(kb))
-    ids = d2h(fn(jn.asarray(pad1(score, nb, pad_val))))[:k]
+    ids = d2h(fn(h2d_pad(score, nb, pad_val)))[:k]
     return ids[ids < n_rows]  # k may exceed the row count
 
 

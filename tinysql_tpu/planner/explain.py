@@ -179,9 +179,20 @@ def _device_info(st) -> str:
     parts = []
     if d.get("dispatches"):
         parts.append(f"dispatches:{int(d['dispatches'])}")
+    if d.get("device_s"):
+        # MEASURED device busy time (sampling profiler,
+        # tidb_device_profile_rate) — distinct from the host wall in
+        # execution info, which on a real device times the async submit
+        parts.append(f"device:{d['device_s'] * 1e3:.1f}ms"
+                     f"/{int(d.get('profiled_dispatches', 0))}smp")
+    if d.get("compile_s"):
+        parts.append(f"compile:{d['compile_s'] * 1e3:.1f}ms")
     if d.get("d2h_transfers"):
         parts.append(f"d2h:{int(d['d2h_transfers'])}/"
                      f"{_fmt_bytes(d.get('d2h_bytes', 0))}")
+    if d.get("h2d_transfers"):
+        parts.append(f"h2d:{int(d['h2d_transfers'])}/"
+                     f"{_fmt_bytes(d.get('h2d_bytes', 0))}")
     hits, misses = d.get("progcache_hits", 0), d.get("progcache_misses", 0)
     if hits or misses:
         parts.append(f"cache:{int(hits)}h/{int(misses)}m")

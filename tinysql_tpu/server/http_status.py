@@ -77,6 +77,11 @@ def _make_handler(server_ref):
                 self._send(200, json.dumps(
                     RING.summary_rows(), default=str).encode())
                 return
+            if parsed.path == "/debug/programs":
+                from ..ops.progcache import catalog_snapshot
+                self._send(200, json.dumps(catalog_snapshot(),
+                                           default=str).encode())
+                return
             if parsed.path == "/debug/prewarm":
                 from ..session.prewarm import stats_snapshot
                 worker = getattr(srv, "prewarm", None) if srv else None
@@ -114,6 +119,7 @@ def _make_handler(server_ref):
                            b'<a href="/debug/trace">traces</a> '
                            b'<a href="/debug/slowlog">slowlog</a> '
                            b'<a href="/debug/stmtsummary">stmtsummary</a> '
+                           b'<a href="/debug/programs">programs</a> '
                            b'<a href="/debug/prewarm">prewarm</a> '
                            b'<a href="/debug/inspection">inspection</a> '
                            b'<a href="/debug/metrics/summary">'

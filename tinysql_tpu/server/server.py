@@ -383,6 +383,20 @@ class Server:
         t.start()
         self.prewarm.start()
         self.metrics_sampler.start()
+        # device-time truth knobs are process-global module state applied
+        # at SET time (session/session.py) — a fresh server re-applies
+        # whatever GLOBAL scope the storage carries
+        try:
+            g = getattr(self.storage, "_global_vars", {})
+            from ..ops import profiler
+            profiler.set_rate(float(
+                g.get("tidb_device_profile_rate", 0) or 0))
+            from ..obs import inspect as obs_inspect
+            obs_inspect.set_slo_p99_ms(float(
+                g.get("tidb_slo_p99_ms", 0) or 0))
+        except Exception:
+            log.warning("device-profile knob re-apply failed",
+                        exc_info=True)
         log.info("listening on %s:%d", self.host, self.port)
         return self.port
 

@@ -153,6 +153,16 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # seconds of sample history information_schema.metrics_history /
     # metrics_summary retain; shrinking it trims the ring immediately
     "tidb_metrics_retention": 900,
+    # ---- device-time truth (ops/profiler.py + obs/inspect.py; both are
+    # process-global module state applied at SET time, like
+    # tidb_compile_cache_dir) --------------------------------------------
+    # fraction of device dispatches the sampling profiler closes with
+    # block_until_ready to record MEASURED device busy time (0 = off and
+    # byte-identical; 1 = every dispatch — diagnosis, not steady state)
+    "tidb_device_profile_rate": 0,
+    # p99 latency objective in MILLISECONDS the slo-burn inspection rule
+    # judges the exec-phase histogram against (0 = no SLO armed)
+    "tidb_slo_p99_ms": 0,
 }
 
 
@@ -1039,7 +1049,8 @@ class Session:
                      "tidb_metrics_interval",
                      "tidb_metrics_retention",
                      "tidb_spill_partitions",
-                     "tidb_spill_max_depth")
+                     "tidb_spill_max_depth",
+                     "tidb_slo_p99_ms")
 
     @staticmethod
     def _validate_uint_sysvar(name: str, v: Datum) -> int:
@@ -1073,10 +1084,11 @@ class Session:
                 continue
             if name in self._UINT_SYSVARS:
                 v = self._validate_uint_sysvar(name, v)
-            if name == "tidb_mem_quota_spill_ratio":
-                # a fraction of the quota: validated to [0, 1] at SET
-                # time (0 disables the soft watermark — quota becomes a
-                # hard kill line again)
+            if name in ("tidb_mem_quota_spill_ratio",
+                        "tidb_device_profile_rate"):
+                # fractions validated to [0, 1] at SET time (spill
+                # ratio: 0 disables the soft watermark; profile rate:
+                # 0 disables dispatch sampling entirely)
                 try:
                     fv = float(v if not isinstance(v, bool) else "x")
                 except (TypeError, ValueError):
@@ -1107,6 +1119,16 @@ class Session:
                 # new directory (ops/kernels.py resolution chain)
                 from ..ops import kernels
                 kernels.set_compile_cache_dir(str(v) if v else "")
+            elif name == "tidb_device_profile_rate":
+                # the dispatch path is process-global: apply immediately
+                # (ops/profiler.py owns the sampling decision)
+                from ..ops import profiler
+                profiler.set_rate(float(v))
+            elif name == "tidb_slo_p99_ms":
+                # arm the slo-burn inspection rule + the `slo` ring
+                # source (obs/inspect.py owns the objective state)
+                from ..obs import inspect as obs_inspect
+                obs_inspect.set_slo_p99_ms(float(v))
         return None
 
     # ---- SHOW (reference: executor/show.go) ------------------------------
