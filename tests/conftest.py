@@ -21,11 +21,42 @@ import jax  # noqa: E402  (sitecustomize already imported it anyway)
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+# TINYSQL_RACE_STRESS: arm the dynamic concurrency verifier BEFORE any
+# tinysql_tpu module is imported — module-level locks must come out of
+# the instrumented constructors or the guard audit cannot see them
+# (tools/race_stress.py drives this; utils/racestress.py implements it)
+_RACE_STRESS = os.environ.get("TINYSQL_RACE_STRESS")
+if _RACE_STRESS:
+    # load by FILE PATH, not package import: `import tinysql_tpu.utils`
+    # would pull failpoint -> fail and create fail._mu with the RAW
+    # constructor before install() could patch it
+    import importlib.util as _ilu
+    import sys as _sys
+    _rs_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tinysql_tpu", "utils", "racestress.py")
+    _spec = _ilu.spec_from_file_location(
+        "tinysql_tpu.utils.racestress", _rs_path)
+    _racestress = _ilu.module_from_spec(_spec)
+    _sys.modules["tinysql_tpu.utils.racestress"] = _racestress
+    _spec.loader.exec_module(_racestress)
+    _racestress.install()
+    _racestress.audit_known()
+
 
 import threading as _threading
 import time as _time
 
 import pytest as _pytest
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Race-stress mode publishes its lock-contention / unguarded-write
+    report at session end (the CI job uploads it as an artifact)."""
+    if _RACE_STRESS:
+        path = os.environ.get("TINYSQL_RACE_STRESS_REPORT")
+        if path:
+            _racestress.write_report(path)
 
 
 @_pytest.fixture(autouse=True, scope="module")

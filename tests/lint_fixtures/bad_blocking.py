@@ -1,0 +1,32 @@
+"""qlint known-bad fixture: CC703 blocking-under-lock.  Queue waits,
+sleeps, thread joins, and device syncs issued while a lock is held:
+every thread contending on the lock stalls behind the wait (the latency
+hazard an event-loop front end cannot absorb)."""
+import queue
+import threading
+import time
+
+
+class Pump:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+
+    def _drain(self):
+        while True:
+            with self._mu:
+                item = self._q.get()  # CC703: queue.get under lock
+                time.sleep(0.01)      # CC703: sleep under lock
+                self._emit(item)
+
+    def _emit(self, item):
+        return item
+
+    def sync(self, res):
+        with self._mu:
+            res.block_until_ready()   # CC703: device sync under lock
+
+    def stop(self):
+        with self._mu:
+            self._thread.join()       # CC703: join under lock

@@ -1,0 +1,32 @@
+"""qlint known-bad fixture: CC704 context-hop discipline.  `Obs` spawns
+a bare thread whose target reads/writes a ContextVar — the values land
+on an orphan context instead of the submitter's.  `OkObs` is the correct
+idiom (copy_context + ctx.run) and must stay clean."""
+import contextvars
+import threading
+
+REQUEST = contextvars.ContextVar("request", default=None)
+
+
+class Obs:
+    def start(self):
+        t = threading.Thread(target=self._worker, daemon=True)  # CC704
+        t.start()
+
+    def _worker(self):
+        REQUEST.set("worker")
+        return self._emit()
+
+    def _emit(self):
+        return REQUEST.get()
+
+
+class OkObs:
+    def start(self):
+        cctx = contextvars.copy_context()
+        t = threading.Thread(target=cctx.run, args=(self._worker,),
+                             daemon=True)
+        t.start()
+
+    def _worker(self):
+        REQUEST.set("worker")

@@ -1,0 +1,28 @@
+"""qlint known-bad fixture: CC702 lock-order deadlock cycle.  `fwd`
+(main root) acquires A then B; `rev` (worker root) acquires B then A
+(one hop through a helper, so the transitive acquisition edge is
+exercised too): the two threads running concurrently deadlock."""
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def fwd():
+    with _a:
+        with _b:
+            return 1
+
+
+def _take_a():
+    with _a:
+        return 2
+
+
+def rev():
+    with _b:
+        return _take_a()  # B held -> acquires A: the reverse edge
+
+
+def spin():
+    threading.Thread(target=rev, daemon=True).start()

@@ -1,0 +1,40 @@
+"""qlint known-bad fixture: CC701 shared-state races (whole-program
+concurrency pass).  A module-level container written both bare (hot
+path) and under a lock (cold path), and an instance attribute written
+from a worker thread AND from a main-root method with no guard at all."""
+import threading
+
+SHARED = {}
+_mu = threading.Lock()
+
+
+def hot_path(key, val):
+    SHARED[key] = val  # CC701: no lock on the multi-root write path
+
+
+def cold_path(key, val):
+    with _mu:
+        SHARED[key] = val  # guarded here -> the guard is inconsistent
+
+
+class Worker:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._state = {}
+        self._n = 0
+
+    def start(self):
+        t = threading.Thread(target=self._loop, daemon=True)
+        t.start()
+
+    def _loop(self):
+        while True:
+            self._n += 1  # CC701: unguarded write from the worker root
+            hot_path("beat", self._n)
+
+    def reset(self):
+        self._n = 0  # CC701: unguarded write from the main root
+
+    def bump(self):
+        with self._mu:
+            self._state["n"] = self._n

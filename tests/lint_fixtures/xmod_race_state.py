@@ -1,0 +1,14 @@
+"""qlint cross-module fixture, half 1: the shared registry and its
+main-thread writers.  THIS FILE ALONE IS CLEAN — no thread ever starts
+here.  The race only exists once xmod_race_worker.py (which mutates
+REGISTRY from a spawned thread) joins the analysis batch, which is what
+proves the CC7xx pass is whole-program."""
+REGISTRY = {}
+
+
+def publish(key, val):
+    REGISTRY[key] = val
+
+
+def seed():
+    REGISTRY.clear()

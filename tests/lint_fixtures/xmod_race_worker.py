@@ -1,0 +1,16 @@
+"""qlint cross-module fixture, half 2: a worker thread mutating the
+OTHER module's registry — the race between modules that per-class
+analysis (LD3xx) can never see."""
+import threading
+
+import xmod_race_state as state
+
+
+def spin():
+    t = threading.Thread(target=_refresh, daemon=True)
+    t.start()
+
+
+def _refresh():
+    state.REGISTRY["beat"] = 1
+    state.publish("x", 2)

@@ -1,14 +1,18 @@
 """qlint self-tests: every pass must fire on its known-bad fixture, the
 CLI must exit non-zero on each fixture, and the TREE must be lint-clean —
 this file is the local mirror of the CI `tools/lint.py --strict` gate."""
+import json
 import os
 import subprocess
 import sys
+import threading
 
 import pytest
 
-from tinysql_tpu.analysis import (gather_sources, lint_lock_discipline,
-                                  lint_obs_discipline, lint_trace_safety)
+from tinysql_tpu.analysis import (gather_sources, lint_concurrency,
+                                  lint_lock_discipline,
+                                  lint_obs_discipline, lint_trace_safety,
+                                  thread_roots)
 from tinysql_tpu.analysis.diag import SourceFile
 from tinysql_tpu.analysis.plan_device import (PlanDeviceError, check_plan,
                                               check_explain_consistency,
@@ -134,6 +138,156 @@ def test_lock_clean_class_not_flagged(tmp_path):
     p = tmp_path / "ok_locks.py"
     p.write_text(src)
     assert lint_lock_discipline(SourceFile(str(p))) == []
+
+
+# ---- pass 6: whole-program concurrency (CC7xx) --------------------------
+
+def _conc(*names):
+    return lint_concurrency([SourceFile(os.path.join(FIXDIR, n))
+                             for n in names])
+
+
+def test_race_fixture_fires_cc701():
+    diags = _conc("bad_race.py")
+    got = [d for d in diags if d.rule == "CC701"]
+    # the inconsistently guarded module dict (hot path only — the
+    # locked cold path is not the actionable site) + both unguarded
+    # writes to the instance attr; the consistently guarded
+    # Worker._state stays silent
+    assert len(got) == 3, [d.format() for d in diags]
+    assert any("SHARED" in d.message for d in got)
+    assert sum("Worker._n" in d.message for d in got) == 2
+    assert not any("_state" in d.message for d in got)
+
+
+def test_lockorder_fixture_fires_cc702():
+    diags = _conc("bad_lockorder.py")
+    assert [d.rule for d in diags] == ["CC702"], \
+        [d.format() for d in diags]
+    assert "_a" in diags[0].message and "_b" in diags[0].message
+
+
+def test_blocking_fixture_fires_cc703():
+    diags = _conc("bad_blocking.py")
+    got = [d for d in diags if d.rule == "CC703"]
+    assert len(got) == 4, [d.format() for d in diags]
+    reasons = "\n".join(d.message for d in got)
+    for probe in ("queue.get", "time.sleep", "block_until_ready",
+                  "Thread.join"):
+        assert probe in reasons, reasons
+
+
+def test_ctxhop_fixture_fires_cc704_only_on_bare_spawn():
+    diags = _conc("bad_ctxhop.py")
+    got = [d for d in diags if d.rule == "CC704"]
+    # the bare Thread(target=self._worker) spawn fires; the
+    # copy_context + ctx.run spawn in OkObs stays clean
+    assert len(got) == 1, [d.format() for d in diags]
+    assert got[0].line < 20, got[0].format()  # in Obs, not OkObs
+
+
+def test_cross_module_race_requires_whole_program():
+    # each half alone is clean; only the UNION of both files reveals
+    # the worker thread in one module mutating the registry owned by
+    # the other — the property per-file passes (LD3xx) cannot have
+    assert _conc("xmod_race_state.py") == []
+    assert _conc("xmod_race_worker.py") == []
+    both = _conc("xmod_race_state.py", "xmod_race_worker.py")
+    got = [d for d in both if d.rule == "CC701"]
+    assert len(got) == 3, [d.format() for d in both]
+    assert {os.path.basename(d.path) for d in got} \
+        == {"xmod_race_state.py", "xmod_race_worker.py"}
+
+
+def test_conc_suppression_respected(tmp_path):
+    src = ("import threading\n\n"
+           "STATE = {}\n\n\n"
+           "def worker():\n"
+           "    STATE['x'] = 1"
+           "  # qlint: disable=CC701 -- fixture: pretend init-only\n\n\n"
+           "def spin():\n"
+           "    threading.Thread(target=worker).start()\n\n\n"
+           "def main_write():\n"
+           "    STATE['y'] = 2"
+           "  # qlint: disable=CC701 -- fixture: pretend init-only\n")
+    p = tmp_path / "suppressed.py"
+    p.write_text(src)
+    assert lint_concurrency([SourceFile(str(p))]) == []
+
+
+def test_thread_root_discovery_covers_known_loops():
+    srcs = gather_sources(os.path.join(REPO, "tinysql_tpu"))
+    entries = {q.split(":")[-1] for q in thread_roots(srcs)}
+    for loop in ("StatementPool._worker_loop", "Sampler._loop",
+                 "PrewarmWorker._loop", "BlockPipeline._run",
+                 "CopClient._run_task", "ClientConn.run",
+                 "Server._accept_loop"):
+        assert loop in entries, sorted(entries)
+
+
+def test_tree_concurrency_clean():
+    # the whole-package CC7xx gate (CI runs the same via --strict);
+    # every finding on the tree is either fixed or suppressed with a
+    # justification
+    srcs = gather_sources(os.path.join(REPO, "tinysql_tpu"))
+    diags = lint_concurrency(srcs)
+    assert not diags, "\n".join(d.format() for d in diags)
+
+
+# ---- the dynamic verifier's building blocks (utils/racestress) ----------
+
+def test_racestress_lock_and_audit_dict():
+    from tinysql_tpu.utils import racestress as rs
+    lk = rs.InstrumentedLock(threading.Lock(), "test-site-a")
+    with lk:
+        assert lk.held_by_current()
+    assert not lk.held_by_current()
+    d = rs.AuditDict({"n": 0}, lk, "test.state")
+    base = rs.report()["unguarded_write_count"]
+    with lk:
+        d["n"] = 1  # guarded: silent
+    assert rs.report()["unguarded_write_count"] == base
+    d["n"] = 2      # unguarded: one report, mutation still lands
+    rep = rs.report()
+    assert rep["unguarded_write_count"] == base + 1
+    assert d["n"] == 2
+    assert rep["unguarded_writes"][-1]["state"] == "test.state"
+
+
+def test_racestress_dynamic_lock_order_cycle():
+    from tinysql_tpu.utils import racestress as rs
+    a = rs.InstrumentedLock(threading.Lock(), "test-site-x")
+    b = rs.InstrumentedLock(threading.Lock(), "test-site-y")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    cycles = rs.report()["lock_order_cycles"]
+    assert any({"test-site-x", "test-site-y"} <= set(c)
+               for c in cycles), cycles
+
+
+def test_racestress_condition_compatible():
+    # Condition(InstrumentedLock) must wait/notify correctly — the
+    # statement pool's _cv rides exactly this shape under stress mode
+    from tinysql_tpu.utils import racestress as rs
+    lk = rs.InstrumentedLock(threading.Lock(), "test-site-cv")
+    cv = threading.Condition(lk)
+    hits = []
+
+    def waker():
+        with cv:
+            hits.append(1)
+            cv.notify()
+
+    t = threading.Thread(target=waker)
+    with cv:
+        t.start()
+        assert cv.wait(timeout=5.0)
+    t.join(timeout=5.0)
+    assert hits == [1]
 
 
 # ---- pass 2: plan-device invariants ------------------------------------
@@ -441,6 +595,10 @@ def test_corpus_plans_clean():
     ("obs", "bad_summary.py"),
     ("obs", "bad_metric.py"),
     ("obs", "bad_devtime.py"),
+    ("conc", "bad_race.py"),
+    ("conc", "bad_lockorder.py"),
+    ("conc", "bad_blocking.py"),
+    ("conc", "bad_ctxhop.py"),
 ])
 def test_cli_exits_nonzero_on_fixture(passname, fixture):
     r = subprocess.run(
@@ -456,6 +614,51 @@ def test_cli_clean_on_tree_trace_locks():
         [sys.executable, LINT, "--pass", "trace", "--pass", "locks"],
         capture_output=True, text=True, timeout=300, cwd=REPO)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---- the --json machine surface + distinct exit codes -------------------
+
+def test_cli_json_findings_exit1():
+    r = subprocess.run(
+        [sys.executable, LINT, "--json", "--pass", "conc",
+         os.path.join(FIXDIR, "bad_lockorder.py")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["clean"] is False
+    assert payload["count"] == len(payload["violations"]) == 1
+    v = payload["violations"][0]
+    assert v["rule"] == "CC702" and v["line"] > 0
+    assert v["path"].endswith("bad_lockorder.py")
+
+
+def test_cli_json_clean_exit0(tmp_path):
+    p = tmp_path / "ok.py"
+    p.write_text("X = 1\n")
+    r = subprocess.run(
+        [sys.executable, LINT, "--json", "--pass", "conc", str(p)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["clean"] is True and payload["violations"] == []
+
+
+def test_cli_internal_error_exit2(tmp_path):
+    # missing path and unparseable source must both exit 2 (internal),
+    # never 0 (clean) or 1 (findings) — CI tells the cases apart
+    r = subprocess.run(
+        [sys.executable, LINT, "--pass", "conc",
+         str(tmp_path / "missing.py")],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2, r.stdout + r.stderr
+    broken = tmp_path / "broken.py"
+    broken.write_text("def broken(:\n")
+    r = subprocess.run(
+        [sys.executable, LINT, "--json", "--pass", "conc", str(broken)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert "error" in payload and payload["clean"] is False
 
 
 # ---- pass 5: fail discipline (FP5xx) ------------------------------------

@@ -26,6 +26,12 @@ Three passes over the invariants nothing else checks mechanically:
   only sleep through ``Backoffer`` (FP501), and every failpoint inject
   site must name a point registered in the ``fail/points.py`` catalogue
   (FP502) so the chaos suite can arm it.
+- **concurrency** (`concurrency.py`, CC7xx): the WHOLE-PROGRAM pass —
+  thread-root discovery + cross-module reachability, shared-state race
+  detection with unified guard inference (CC701, subsuming LD3xx's
+  per-class maps), lock-order deadlock cycles (CC702),
+  blocking-under-lock (CC703), and context-hop discipline for thread
+  spawns (CC704).  Its dynamic twin is ``tools/race_stress.py``.
 
 Every pass honors inline suppressions with REQUIRED justification text:
 
@@ -33,6 +39,7 @@ Every pass honors inline suppressions with REQUIRED justification text:
 
 See docs/LINT.md and tools/lint.py.
 """
+from .concurrency import lint_concurrency, thread_roots
 from .diag import (Diagnostic, Severity, SourceFile, format_diagnostics,
                    gather_sources)
 from .fail_discipline import lint_fail_discipline
@@ -44,6 +51,6 @@ from .trace_safety import lint_trace_safety
 __all__ = [
     "Diagnostic", "Severity", "SourceFile", "format_diagnostics",
     "gather_sources", "lint_trace_safety", "lint_lock_discipline",
-    "lint_obs_discipline", "lint_fail_discipline", "check_plan",
-    "verify_plan", "PlanDeviceError",
+    "lint_obs_discipline", "lint_fail_discipline", "lint_concurrency",
+    "thread_roots", "check_plan", "verify_plan", "PlanDeviceError",
 ]

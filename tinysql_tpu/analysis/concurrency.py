@@ -1,0 +1,1101 @@
+"""Pass 6 — whole-program concurrency analysis (CC7xx).
+
+Unlike every other qlint pass, this one is NOT per-file: it consumes the
+ENTIRE package at once, builds a cross-module call graph, and reasons
+about which code runs on which threads.  Four cooperating analyses:
+
+**Thread-root graph.**  Every thread entry point is discovered from the
+AST: ``threading.Thread(target=...)`` (including the devpipe idiom
+``Thread(target=cctx.run, args=(real_target,))``), the first argument of
+``ThreadPoolExecutor.submit`` (including the distsql idiom
+``submit(ctx.run, real_target, ...)``), and functions handed to
+``BlockPipeline(stage_fn, ...)`` (the staging producer runs them on its
+own thread).  Reachability is computed from each root over a name-based
+call graph (direct calls, ``self.`` methods, module-alias calls,
+constructor calls, and ``self.attr``/local calls through inferred
+``self.x = ClassName(...)`` types).  A handful of SEED_EDGES document
+the dynamic dispatches the AST cannot see (the pool worker invoking
+``Session.execute_stmt`` through ``entry.session``, the prewarm worker
+driving ``Session.query``); they are the machine-readable catalogue of
+the known worker loops.  Everything additionally reachable from
+public/zero-caller functions or module bodies carries the synthetic
+``main`` root.  A function (or a piece of state) is *multi-root* when
+two or more distinct roots reach it — that is the precondition for
+every CC7xx rule: single-threaded code carries no concurrency
+discipline to enforce.
+
+**CC701 — shared-state races.**  Module-level mutable containers
+(dict/list/set/deque/... literals or constructors) and instance
+attributes of lock-carrying classes that are WRITTEN from multi-root
+code: the guard of a piece of state is inferred as the INTERSECTION of
+the locks held across all of its write sites (lexically held ``with``
+locks plus caller-held locks propagated one level: a helper whose every
+call site holds ``_mu`` analyzes as entered with ``_mu`` held).  An
+empty intersection over multi-root writes means no lock consistently
+protects the state — a data race.  ``__init__`` and module-body writes
+are exempt (publish-before-share), as are ``threading.local()`` and
+``contextvars.ContextVar`` bindings (per-thread/per-context by
+construction).  This subsumes LD301/LD303's per-class and dict-slot
+maps with ONE cross-module inference (docs/LINT.md has the LD3xx
+deprecation path).
+
+**CC702 — lock-order deadlock cycles.**  Every ``with lock:`` region
+nested (lexically or through a call, locks-acquired propagated
+transitively) inside another lock's region contributes an edge
+``outer -> inner`` to the global acquisition graph; ``Condition(lock)``
+aliases to its underlying lock.  A cycle means two threads can acquire
+the participating locks in opposite orders — deadlock.
+
+**CC703 — blocking-under-lock.**  Calls that can block indefinitely or
+sleep — ``time.sleep``, ``queue.Queue.get/put/join``, ``Thread.join``,
+``Event.wait``, ``block_until_ready`` (a device sync!), socket
+send/recv/accept/connect — issued while any catalogued lock is held.
+``Condition.wait`` is exempt (it releases the lock it waits on).
+Receivers are typed from assignments (``self._q = queue.Queue()``,
+``t = threading.Thread(...)``), so ``",".join(...)`` or ``dict.get``
+never misfire.
+
+**CC704 — context-hop discipline.**  A thread spawn whose target
+(transitively, depth-limited) touches ``contextvars``-scoped state —
+the obs fan-out (``record``/``record_hwm``/``record_bucket``/``span``/
+``current``), ``interrupt.check``, or a module-level ``ContextVar``'s
+``get``/``set`` — without the spawn being wrapped in
+``contextvars.copy_context()`` and without the target establishing its
+OWN scope (``activate``/``QueryObs``/``copy_context`` on its path).
+This is the bug class PR 8 fixed by hand in server/pool.py: spans and
+counters silently landing on an orphan context.
+
+Run it through ``tools/lint.py --pass conc`` (whole package) or over an
+explicit file set (the two-file fixture test proves findings appear
+only when both halves are in the batch).  Dynamic twin:
+``tools/race_stress.py`` converts PLAUSIBLE findings into CONFIRMED
+ones under a shrunk ``sys.setswitchinterval``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .diag import Diagnostic, SourceFile, register_rules
+
+register_rules({
+    "CC701": "shared state written from >=2 thread roots without a "
+             "consistently held guard",
+    "CC702": "lock acquisition order cycle across threads (deadlock)",
+    "CC703": "blocking/sleeping call while holding a lock",
+    "CC704": "thread target touches context-scoped state without "
+             "copy_context or its own scope",
+})
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_COND_CTORS = {"Condition"}
+_LOCKLIKE_CTORS = _LOCK_CTORS | _COND_CTORS | {"Semaphore",
+                                               "BoundedSemaphore"}
+_CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                    "OrderedDict", "Counter", "WeakSet",
+                    "WeakValueDictionary", "WeakKeyDictionary"}
+_PERTHREAD_CTORS = {"local", "ContextVar"}  # threading.local / contextvars
+_MUTATORS = {"append", "extend", "insert", "pop", "popitem", "clear",
+             "update", "setdefault", "add", "remove", "discard",
+             "appendleft", "popleft"}
+#: ambient contextvars-scoped touch points (obs/context.py fan-out +
+#: utils/interrupt) — CC704's "uses the submitting thread's context"
+_AMBIENT_ATTRS = {"record", "record_hwm", "record_bucket", "span",
+                  "current", "current_op", "check"}
+_AMBIENT_OWNERS = {"_obs", "obs", "context", "_interrupt", "interrupt",
+                   "_ctx"}
+#: calls that ESTABLISH a scope of their own (or hop one across): a
+#: target reaching these needs no inherited context
+_SCOPE_ATTRS = {"activate", "copy_context"}
+
+#: dynamic-dispatch edges the AST cannot see — the catalogue of known
+#: worker-loop hand-offs (module-path suffix -> module-path suffix).
+#: Each entry is an ordinary call edge added to the graph when both
+#: endpoints resolve, so thread reach flows through ``entry.session``-
+#: style indirections.
+SEED_EDGES: List[Tuple[str, str]] = [
+    # pool workers drive statements through _Entry.session
+    ("server.pool:StatementPool._exec_entry",
+     "session.session:Session.execute_stmt"),
+    # the prewarm worker replays sample SQL on its internal session
+    ("session.prewarm:PrewarmWorker._warm_family",
+     "session.session:Session.query"),
+    ("session.prewarm:PrewarmWorker._warm_family",
+     "session.session:Session.execute"),
+]
+
+MAIN_ROOT = "main"
+
+
+# =========================================================================
+# module model
+# =========================================================================
+
+def _call_name(fn: ast.expr) -> Optional[str]:
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _self_attr(e: ast.expr) -> Optional[str]:
+    if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) \
+            and e.value.id == "self":
+        return e.attr
+    return None
+
+
+class _ClassInfo:
+    __slots__ = ("name", "lock_fields", "cond_alias", "queue_fields",
+                 "thread_fields", "event_fields", "attr_types")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.lock_fields: Set[str] = set()
+        #: Condition field -> the lock field it wraps (same OS lock)
+        self.cond_alias: Dict[str, str] = {}
+        self.queue_fields: Set[str] = set()
+        self.thread_fields: Set[str] = set()
+        self.event_fields: Set[str] = set()
+        #: self.attr -> ClassName it is constructed from
+        self.attr_types: Dict[str, str] = {}
+
+
+class _Func:
+    __slots__ = ("mod", "cls", "name", "node", "qual",
+                 "calls", "writes", "acquires", "blocking", "spawns",
+                 "ambient", "establishes", "entry_held", "nested_in")
+
+    def __init__(self, mod: str, cls: Optional[str], name: str, node,
+                 nested_in: Optional[str] = None):
+        self.mod = mod
+        self.cls = cls
+        self.name = name
+        self.node = node
+        self.qual = f"{mod}:{cls + '.' if cls else ''}{name}"
+        #: (callee qual | None-unresolved, held frozenset, lineno)
+        self.calls: List[tuple] = []
+        #: (state_id, node, held, is_init)
+        self.writes: List[tuple] = []
+        #: (lock_id, node, held-before)
+        self.acquires: List[tuple] = []
+        #: (reason, node, held)
+        self.blocking: List[tuple] = []
+        #: (target_qual | None, node, ctx_wrapped)
+        self.spawns: List[tuple] = []
+        self.ambient = False
+        self.establishes = False
+        self.entry_held: FrozenSet = frozenset()
+        self.nested_in = nested_in
+
+
+class _Module:
+    def __init__(self, sf: SourceFile, modpath: str):
+        self.sf = sf
+        self.modpath = modpath
+        self.imports: Dict[str, str] = {}     # alias -> dotted target
+        self.containers: Dict[str, int] = {}  # name -> def lineno
+        self.locks: Set[str] = set()
+        self.classes: Dict[str, _ClassInfo] = {}
+        self.funcs: List[_Func] = []
+        self.body_calls: List[tuple] = []     # module-body call names
+
+
+def _modpath_for(path: str) -> str:
+    """Dotted module path: the longest package-ish suffix of the file
+    path (``a/b/c.py`` -> ``a.b.c``), stable across absolute roots."""
+    norm = os.path.normpath(path)
+    parts = norm.split(os.sep)
+    parts[-1] = parts[-1][:-3] if parts[-1].endswith(".py") else parts[-1]
+    # keep at most the trailing 4 components: enough to be unique in a
+    # package tree, short enough for messages
+    return ".".join(p for p in parts[-4:] if p not in ("", "."))
+
+
+def _scan_module(sf: SourceFile) -> _Module:
+    m = _Module(sf, _modpath_for(sf.path))
+    # imports anywhere in the file (this tree lazy-imports inside
+    # functions pervasively) — aliases are module-scoped for resolution
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                m.imports[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            for a in node.names:
+                m.imports[a.asname or a.name] = (base + "." + a.name
+                                                 if base else a.name)
+    for node in sf.tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            val = node.value
+            kind = _value_kind(val)
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if kind == "container":
+                    m.containers[t.id] = node.lineno
+                elif kind == "lock":
+                    m.locks.add(t.id)
+        elif isinstance(node, ast.ClassDef):
+            m.classes[node.name] = _scan_class(node)
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            nm = _call_name(node.value.func)
+            if nm:
+                m.body_calls.append((nm, node.value, node.lineno))
+        elif isinstance(node, (ast.For, ast.If, ast.With, ast.Try)):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    nm = _call_name(sub.func)
+                    if nm:
+                        m.body_calls.append((nm, sub, sub.lineno))
+    return m
+
+
+def _value_kind(val: Optional[ast.expr]) -> Optional[str]:
+    if val is None:
+        return None
+    if isinstance(val, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                        ast.DictComp, ast.SetComp)):
+        return "container"
+    if isinstance(val, ast.Call):
+        nm = _call_name(val.func)
+        if nm in _PERTHREAD_CTORS:
+            return "perthread"
+        if nm in _LOCK_CTORS or nm in _COND_CTORS:
+            return "lock"
+        if nm in _CONTAINER_CTORS:
+            return "container"
+    return None
+
+
+def _scan_class(cls: ast.ClassDef) -> _ClassInfo:
+    ci = _ClassInfo(cls.name)
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Call):
+            continue
+        nm = _call_name(node.value.func)
+        for tgt in node.targets:
+            a = _self_attr(tgt)
+            if a is None:
+                continue
+            if nm in _LOCK_CTORS:
+                ci.lock_fields.add(a)
+            elif nm in _COND_CTORS:
+                args = node.value.args
+                inner = _self_attr(args[0]) if args else None
+                if inner:
+                    ci.cond_alias[a] = inner
+                else:
+                    ci.lock_fields.add(a)  # Condition() owns its lock
+            elif nm == "Queue" or nm in ("LifoQueue", "PriorityQueue",
+                                         "SimpleQueue"):
+                ci.queue_fields.add(a)
+            elif nm == "Thread":
+                ci.thread_fields.add(a)
+            elif nm == "Event":
+                ci.event_fields.add(a)
+            elif nm and nm[0].isupper() and nm not in _LOCKLIKE_CTORS:
+                ci.attr_types[a] = nm
+    return ci
+
+
+# =========================================================================
+# per-function walker
+# =========================================================================
+
+class _Walker:
+    """One pass over a function body collecting events with the
+    LEXICALLY held lock set.  Nested defs are walked as separate
+    functions with an empty held set (they run later, possibly on
+    another thread)."""
+
+    def __init__(self, mod: _Module, cls: Optional[_ClassInfo],
+                 func: _Func, out_funcs: List[_Func]):
+        self.mod = mod
+        self.cls = cls
+        self.func = func
+        self.out = out_funcs
+        #: local name -> inferred kind ("thread"|"queue"|"event"|"ctx"
+        #: |ClassName)
+        self.local_types: Dict[str, str] = {}
+
+    # ---- lock identity ---------------------------------------------------
+    def _lock_id(self, e: ast.expr) -> Optional[tuple]:
+        a = _self_attr(e)
+        if a is not None and self.cls is not None:
+            a = self.cls.cond_alias.get(a, a)
+            if a in self.cls.lock_fields:
+                return ("C", self.mod.modpath, self.cls.name, a)
+            return None
+        if isinstance(e, ast.Name) and e.id in self.mod.locks:
+            return ("M", self.mod.modpath, e.id)
+        if isinstance(e, ast.Subscript) \
+                and isinstance(e.slice, ast.Constant) \
+                and e.slice.value == "lock" \
+                and isinstance(e.value, ast.Name):
+            return ("D", self.mod.modpath, e.value.id)
+        return None
+
+    # ---- statements ------------------------------------------------------
+    def walk(self, stmts, held: FrozenSet) -> None:
+        for s in stmts:
+            self._stmt(s, held)
+
+    def _stmt(self, s: ast.stmt, held: FrozenSet) -> None:
+        if isinstance(s, ast.With):
+            got = set()
+            for item in s.items:
+                lid = self._lock_id(item.context_expr)
+                if lid is not None:
+                    got.add(lid)
+                    self.func.acquires.append((lid, item.context_expr,
+                                               held))
+                else:
+                    self._expr(item.context_expr, held)
+            self.walk(s.body, held | frozenset(got))
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = _Func(self.mod.modpath,
+                        self.cls.name if self.cls else None,
+                        s.name, s, nested_in=self.func.qual)
+            self.out.append(sub)
+            _Walker(self.mod, self.cls, sub, self.out).walk(
+                s.body, frozenset())
+        elif isinstance(s, (ast.If, ast.While)):
+            self._expr(s.test, held)
+            self.walk(s.body, held)
+            self.walk(s.orelse, held)
+        elif isinstance(s, ast.For):
+            self._expr(s.iter, held)
+            self.walk(s.body, held)
+            self.walk(s.orelse, held)
+        elif isinstance(s, ast.Try):
+            for blk in ([s.body, s.orelse, s.finalbody]
+                        + [h.body for h in s.handlers]):
+                self.walk(blk, held)
+        elif isinstance(s, ast.Return) and s.value is not None:
+            self._expr(s.value, held)
+        elif isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = s.targets if isinstance(s, ast.Assign) else [s.target]
+            val = getattr(s, "value", None)
+            if val is not None:
+                self._infer_local(targets, val)
+                self._expr(val, held)
+            for t in targets:
+                self._write_target(t, held)
+        elif isinstance(s, ast.Delete):
+            for t in s.targets:
+                self._write_target(t, held)
+        elif isinstance(s, ast.Expr):
+            self._expr(s.value, held)
+        else:
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self._expr(child, held)
+
+    def _infer_local(self, targets, val) -> None:
+        if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+            return
+        name = targets[0].id
+        if not isinstance(val, ast.Call):
+            return
+        nm = _call_name(val.func)
+        if nm == "Thread":
+            self.local_types[name] = "thread"
+        elif nm in ("Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"):
+            self.local_types[name] = "queue"
+        elif nm == "Event":
+            self.local_types[name] = "event"
+        elif nm == "copy_context":
+            self.local_types[name] = "ctx"
+        elif nm and nm[0].isupper():
+            self.local_types[name] = nm
+
+    # ---- writes ----------------------------------------------------------
+    def _state_id(self, base: ast.expr) -> Optional[tuple]:
+        """State identity of a mutation receiver: a module-level
+        container (here or through a module alias) or an instance attr
+        of a lock-carrying class."""
+        if isinstance(base, ast.Name):
+            if base.id in self.mod.containers:
+                return ("G", self.mod.modpath, base.id)
+            return None
+        a = _self_attr(base)
+        if a is not None and self.cls is not None \
+                and self.cls.lock_fields \
+                and a not in self.cls.lock_fields \
+                and a not in self.cls.cond_alias:
+            return ("A", self.mod.modpath, self.cls.name, a)
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name):
+            tgt = self.mod.imports.get(base.value.id)
+            if tgt is not None:
+                return ("X", tgt, base.attr)  # cross-module: resolve later
+        return None
+
+    def _write_target(self, tgt: ast.expr, held: FrozenSet) -> None:
+        if isinstance(tgt, ast.Subscript):
+            sid = self._state_id(tgt.value)
+            if sid is not None:
+                self._note_write(sid, tgt, held)
+            else:
+                self._expr(tgt.value, held)
+            self._expr(tgt.slice, held)
+            return
+        sid = self._state_id(tgt)
+        if sid is not None and sid[0] == "A":
+            self._note_write(sid, tgt, held)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._write_target(e, held)
+
+    def _note_write(self, sid: tuple, node, held: FrozenSet) -> None:
+        is_init = self.func.name in ("__init__", "__new__") \
+            and self.func.nested_in is None
+        self.func.writes.append((sid, node, held, is_init))
+
+    # ---- expressions: calls, mutators, spawns, blocking ------------------
+    def _recv_kind(self, recv: ast.expr) -> Optional[str]:
+        a = _self_attr(recv)
+        if a is not None and self.cls is not None:
+            if a in self.cls.thread_fields:
+                return "thread"
+            if a in self.cls.queue_fields:
+                return "queue"
+            if a in self.cls.event_fields:
+                return "event"
+            return None
+        if isinstance(recv, ast.Name):
+            k = self.local_types.get(recv.id)
+            if k in ("thread", "queue", "event"):
+                return k
+        return None
+
+    def _expr(self, e: ast.expr, held: FrozenSet) -> None:
+        for node in ast.walk(e):
+            if not isinstance(node, ast.Call):
+                continue
+            self._call(node, held)
+
+    def _call(self, node: ast.Call, held: FrozenSet) -> None:
+        fn = node.func
+        # ---- spawns -----------------------------------------------------
+        nm = _call_name(fn)
+        if nm == "Thread":
+            self._spawn_thread(node)
+        elif nm == "submit" and isinstance(fn, ast.Attribute):
+            self._spawn_submit(node)
+        elif nm == "BlockPipeline" and node.args:
+            tq = self._resolve_ref(node.args[0])
+            # the pipeline copies its creator's context by construction
+            self.func.spawns.append((tq, node, True))
+        # ---- mutator calls on shared state ------------------------------
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS:
+            sid = self._state_id(fn.value)
+            if sid is not None:
+                self._note_write(sid, node, held)
+        # ---- blocking-under-lock (CC703): record regardless of the
+        # LEXICAL held set — a caller-held lock (entry_held) only
+        # becomes known after propagation, so filtering happens in the
+        # rule, not here
+        reason = self._blocking_reason(node)
+        if reason:
+            self.func.blocking.append((reason, node, held))
+        # ---- ambient-context / scope markers (CC704) ---------------------
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _AMBIENT_ATTRS and (
+                    (isinstance(fn.value, ast.Name)
+                     and fn.value.id in _AMBIENT_OWNERS)
+                    or fn.attr in ("record", "record_hwm",
+                                   "record_bucket")):
+                self.func.ambient = True
+            if fn.attr in ("get", "set") \
+                    and isinstance(fn.value, ast.Name) \
+                    and self._is_contextvar(fn.value.id):
+                self.func.ambient = True
+            if fn.attr in _SCOPE_ATTRS:
+                self.func.establishes = True
+            if fn.attr == "run" and self._is_ctx(fn.value):
+                self.func.establishes = True
+        elif isinstance(fn, ast.Name) and fn.id in _SCOPE_ATTRS:
+            self.func.establishes = True
+        # ---- the call edge ----------------------------------------------
+        callee = self._resolve_call(fn)
+        self.func.calls.append((callee, held, node.lineno))
+
+    def _is_contextvar(self, name: str) -> bool:
+        # module-level `X = contextvars.ContextVar(...)` assignments
+        for n in self.mod.sf.tree.body:
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                    and _call_name(n.value.func) == "ContextVar":
+                for t in n.targets:
+                    if isinstance(t, ast.Name) and t.id == name:
+                        return True
+        return False
+
+    def _is_ctx(self, e: ast.expr) -> bool:
+        if isinstance(e, ast.Name):
+            return self.local_types.get(e.id) == "ctx" \
+                or "ctx" in e.id.lower()
+        if isinstance(e, ast.Attribute):
+            return "ctx" in e.attr.lower()
+        return False
+
+    def _blocking_reason(self, node: ast.Call) -> Optional[str]:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            if fn.attr == "sleep" and isinstance(recv, ast.Name) \
+                    and recv.id == "time":
+                return "time.sleep"
+            if fn.attr == "block_until_ready":
+                return "block_until_ready (device sync)"
+            kind = self._recv_kind(recv)
+            if kind == "queue" and fn.attr in ("get", "put", "join"):
+                return f"queue.{fn.attr}"
+            if kind == "thread" and fn.attr == "join":
+                return "Thread.join"
+            if kind == "event" and fn.attr == "wait":
+                return "Event.wait"
+            if fn.attr in ("recv", "accept", "connect", "sendall",
+                           "makefile") and isinstance(recv, ast.Name) \
+                    and ("sock" in recv.id.lower()
+                         or recv.id == "socket"):
+                return f"socket.{fn.attr}"
+        elif isinstance(fn, ast.Name) and fn.id == "sleep":
+            if self.mod.imports.get("sleep", "").startswith("time"):
+                return "time.sleep"
+        return None
+
+    # ---- spawn helpers ---------------------------------------------------
+    def _spawn_thread(self, node: ast.Call) -> None:
+        target = None
+        args_kw = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target = kw.value
+            elif kw.arg == "args":
+                args_kw = kw.value
+        if target is None:
+            return
+        wrapped = False
+        if isinstance(target, ast.Attribute) and target.attr == "run" \
+                and self._is_ctx(target.value):
+            wrapped = True
+            # devpipe idiom: the real entry rides args=(real_target,)
+            if isinstance(args_kw, (ast.Tuple, ast.List)) and args_kw.elts:
+                target = args_kw.elts[0]
+        tq = self._resolve_ref(target)
+        self.func.spawns.append((tq, node, wrapped))
+
+    def _spawn_submit(self, node: ast.Call) -> None:
+        if not node.args:
+            return
+        first = node.args[0]
+        wrapped = False
+        if isinstance(first, ast.Attribute) and first.attr == "run" \
+                and self._is_ctx(first.value):
+            wrapped = True
+            if len(node.args) > 1:
+                first = node.args[1]
+        tq = self._resolve_ref(first)
+        if tq is not None or not wrapped:
+            self.func.spawns.append((tq, node, wrapped))
+
+    # ---- resolution ------------------------------------------------------
+    def _resolve_ref(self, e: ast.expr) -> Optional[str]:
+        """A function REFERENCE (not call): qual or None."""
+        a = _self_attr(e)
+        if a is not None and self.cls is not None:
+            return f"{self.mod.modpath}:{self.cls.name}.{a}"
+        if isinstance(e, ast.Name):
+            if self.cls is not None:
+                # nested stage fns defined inside a method index under
+                # the class; _find_qual falls back to module level
+                return f"{self.mod.modpath}:{self.cls.name}.{e.id}"
+            return f"{self.mod.modpath}:{e.id}"
+        if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name):
+            tgt = self.mod.imports.get(e.value.id)
+            if tgt:
+                return f"?{tgt}:{e.attr}"  # cross-module, resolve later
+            ty = self.local_types.get(e.value.id)
+            if ty and ty not in ("thread", "queue", "event", "ctx"):
+                return f"{self.mod.modpath}:{ty}.{e.attr}"
+        return None
+
+    def _resolve_call(self, fn: ast.expr) -> Optional[str]:
+        if isinstance(fn, ast.Name):
+            return f"{self.mod.modpath}:{fn.id}"
+        if isinstance(fn, ast.Attribute):
+            a = _self_attr(fn)
+            if a is not None and self.cls is not None:
+                ty = self.cls.attr_types.get(a)
+                if ty:  # self.pool.run(...) -> StatementPool.run later?
+                    return None
+                return f"{self.mod.modpath}:{self.cls.name}.{a}"
+            if isinstance(fn.value, ast.Name):
+                base = fn.value.id
+                tgt = self.mod.imports.get(base)
+                if tgt:
+                    return f"?{tgt}:{fn.attr}"
+                ty = self.local_types.get(base)
+                if ty and ty not in ("thread", "queue", "event", "ctx"):
+                    return f"{self.mod.modpath}:{ty}.{fn.attr}"
+            elif isinstance(fn.value, ast.Attribute):
+                a2 = _self_attr(fn.value)
+                if a2 is not None and self.cls is not None:
+                    ty = self.cls.attr_types.get(a2)
+                    if ty:
+                        return f"{self.mod.modpath}:{ty}.{fn.attr}"
+        return None
+
+
+# =========================================================================
+# the whole-program analysis
+# =========================================================================
+
+class _Program:
+    def __init__(self, sources: List[SourceFile]):
+        self.modules: List[_Module] = [_scan_module(sf) for sf in sources]
+        self.by_path: Dict[str, SourceFile] = {sf.path: sf
+                                               for sf in sources}
+        self.funcs: Dict[str, _Func] = {}
+        self._index()
+        self._resolve()
+        self._propagate_held()
+        self.roots = self._compute_roots()
+
+    # ---- indexing --------------------------------------------------------
+    def _index(self) -> None:
+        for m in self.modules:
+            out: List[_Func] = []
+            for node in m.sf.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    f = _Func(m.modpath, None, node.name, node)
+                    out.append(f)
+                    _Walker(m, None, f, out).walk(node.body, frozenset())
+                elif isinstance(node, ast.ClassDef):
+                    ci = m.classes[node.name]
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            f = _Func(m.modpath, node.name, sub.name, sub)
+                            out.append(f)
+                            _Walker(m, ci, f, out).walk(sub.body,
+                                                        frozenset())
+            m.funcs = out
+            for f in out:
+                self.funcs.setdefault(f.qual, f)
+
+    def _find_qual(self, ref: str) -> Optional[str]:
+        """Resolve a ``?module:name`` cross-module ref (or check a direct
+        qual) against the index; module matching is by dotted-suffix."""
+        if not ref:
+            return None
+        if ref in self.funcs:
+            return ref
+        if not ref.startswith("?"):
+            if ":" in ref:
+                mod, name = ref.split(":", 1)
+                # a CLASS name: constructor -> __init__
+                cand = f"{mod}:{name}.__init__"
+                if cand in self.funcs:
+                    return cand
+                # class-qualified miss -> module-level function
+                if "." in name:
+                    bare = f"{mod}:{name.rsplit('.', 1)[1]}"
+                    if bare in self.funcs:
+                        return bare
+            return None
+        modref, name = ref[1:].split(":", 1)
+        tail = modref.split(".")
+        for m in self.modules:
+            mp = m.modpath.split(".")
+            if mp[-len(tail):] == tail or mp[-1] == tail[-1]:
+                q = f"{m.modpath}:{name}"
+                if q in self.funcs:
+                    return q
+                q2 = f"{m.modpath}:{name}.__init__"
+                if q2 in self.funcs:
+                    return q2
+        return None
+
+    def _resolve(self) -> None:
+        for f in self.funcs.values():
+            f.calls = [(self._find_qual(c) if c else None, held, ln)
+                       for c, held, ln in f.calls]
+            f.spawns = [(self._find_qual(t) if t else None, node, wrapped)
+                        for t, node, wrapped in f.spawns]
+        # the hand-seeded dynamic-dispatch edges (known worker loops)
+        for src_sfx, dst_sfx in SEED_EDGES:
+            src = self._suffix_func(src_sfx)
+            dst = self._suffix_func(dst_sfx)
+            if src is not None and dst is not None:
+                src.calls.append((dst.qual, frozenset(), 0))
+
+    def _suffix_func(self, sfx: str) -> Optional[_Func]:
+        msfx, name = sfx.split(":", 1)
+        for q, f in self.funcs.items():
+            mod, fname = q.split(":", 1)
+            if fname == name and (mod.endswith(msfx)
+                                  or mod.split(".")[-1]
+                                  == msfx.split(".")[-1]):
+                return f
+        return None
+
+    # ---- caller-held propagation ----------------------------------------
+    def _propagate_held(self) -> None:
+        callers: Dict[str, List[FrozenSet]] = {}
+        for _ in range(3):
+            callers.clear()
+            for f in self.funcs.values():
+                eh = f.entry_held
+                for callee, held, _ln in f.calls:
+                    if callee is not None:
+                        callers.setdefault(callee, []).append(held | eh)
+            changed = False
+            for q, sets in callers.items():
+                f = self.funcs.get(q)
+                if f is None or f.name in ("__init__", "__new__"):
+                    continue
+                inter = frozenset.intersection(*map(frozenset, sets)) \
+                    if sets else frozenset()
+                if inter != f.entry_held:
+                    f.entry_held = inter
+                    changed = True
+            if not changed:
+                break
+
+    # ---- thread roots ----------------------------------------------------
+    def _compute_roots(self) -> Dict[str, Set[str]]:
+        edges: Dict[str, List[str]] = {}
+        has_caller: Set[str] = set()
+        for f in self.funcs.values():
+            lst = edges.setdefault(f.qual, [])
+            for callee, _h, _ln in f.calls:
+                if callee is not None:
+                    lst.append(callee)
+                    has_caller.add(callee)
+            # a nested def belongs to its parent's reach (closures run
+            # where — and as often as — their enclosing scope wires them)
+            if f.nested_in is not None:
+                edges.setdefault(f.nested_in, []).append(f.qual)
+                has_caller.add(f.qual)
+        entries: Set[str] = set()
+        for f in self.funcs.values():
+            for target, _node, _w in f.spawns:
+                if target is not None:
+                    entries.add(target)
+
+        def reach(starts: Set[str]) -> Set[str]:
+            seen = set(starts)
+            stack = list(starts)
+            while stack:
+                cur = stack.pop()
+                for nxt in edges.get(cur, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+            return seen
+
+        roots: Dict[str, Set[str]] = {q: set() for q in self.funcs}
+        for e in sorted(entries):
+            for q in reach({e}):
+                if q in roots:
+                    roots[q].add(e)
+        main_seeds = {q for q in self.funcs
+                      if q not in has_caller and q not in entries}
+        # module bodies call into the graph at import time (main)
+        for m in self.modules:
+            for nm, _node, _ln in m.body_calls:
+                q = f"{m.modpath}:{nm}"
+                if q in self.funcs:
+                    main_seeds.add(q)
+        for q in reach(main_seeds):
+            if q in roots:
+                roots[q].add(MAIN_ROOT)
+        return roots
+
+    # ---- public: the thread-root report ---------------------------------
+    def thread_root_report(self) -> Dict[str, List[str]]:
+        entries: Dict[str, List[str]] = {}
+        for f in self.funcs.values():
+            for target, node, wrapped in f.spawns:
+                if target is not None:
+                    entries.setdefault(target, []).append(
+                        f"{f.qual}:{getattr(node, 'lineno', 0)}")
+        return entries
+
+
+def thread_roots(sources: List[SourceFile]) -> Dict[str, List[str]]:
+    """Discovered thread entry points -> their spawn sites (the
+    ``--roots`` introspection surface and the race-stress catalogue)."""
+    return _Program(sources).thread_root_report()
+
+
+# =========================================================================
+# rules
+# =========================================================================
+
+def _fmt_lock(lid: tuple) -> str:
+    if lid[0] == "M":
+        return f"{lid[1]}.{lid[2]}"
+    if lid[0] == "C":
+        return f"{lid[1]}.{lid[2]}.{lid[3]}"
+    if lid[0] == "D":
+        return f"{lid[1]}.{lid[2]}[\"lock\"]"
+    return str(lid)
+
+
+def _fmt_state(sid: tuple) -> str:
+    if sid[0] == "G":
+        return f"{sid[1]}.{sid[2]}"
+    return f"{sid[1]}.{sid[2]}.{sid[3]}"
+
+
+def _cc701(prog: _Program) -> List[Diagnostic]:
+    # gather write events per state id, cross-module refs folded in
+    writes: Dict[tuple, List[tuple]] = {}
+    for f in prog.funcs.values():
+        eff_extra = f.entry_held
+        for sid, node, held, is_init in f.writes:
+            if is_init:
+                continue
+            if sid[0] == "X":  # alias.NAME -> owning module's container
+                owner = None
+                tail = sid[1].split(".")
+                for m in prog.modules:
+                    if m.modpath.split(".")[-1] == tail[-1] \
+                            or m.modpath.endswith(sid[1]):
+                        if sid[2] in m.containers:
+                            owner = ("G", m.modpath, sid[2])
+                            break
+                if owner is None:
+                    continue
+                sid = owner
+            writes.setdefault(sid, []).append(
+                (f, node, held | eff_extra))
+    out: List[Diagnostic] = []
+    for sid, evs in sorted(writes.items(), key=lambda kv: str(kv[0])):
+        root_union: Set[str] = set()
+        for f, _n, _h in evs:
+            root_union |= prog.roots.get(f.qual, set())
+        if len(root_union) < 2:
+            continue
+        guard = frozenset.intersection(*[frozenset(h) for _f, _n, h in evs])
+        if guard:
+            continue
+        locks_seen: Set[tuple] = set()
+        for _f, _n, h in evs:
+            locks_seen |= h
+        hint = (" (locks held at other sites: "
+                + ", ".join(sorted(_fmt_lock(x) for x in locks_seen))
+                + ")") if locks_seen else " (no lock at any write site)"
+        nroots = ", ".join(sorted(r.split(":")[-1] for r in root_union))
+        seen_lines: Set[tuple] = set()
+        for f, node, held in evs:
+            if held:
+                continue  # only the unguarded sites are actionable
+            path = _path_of(prog, f.mod)
+            key = (path, node.lineno)
+            if key in seen_lines:
+                continue
+            seen_lines.add(key)
+            out.append(Diagnostic(
+                "CC701",
+                f"`{_fmt_state(sid)}` is written from >=2 thread roots "
+                f"[{nroots}] with no consistently held guard{hint}; "
+                f"write in `{f.name}` holds nothing",
+                path, node.lineno, getattr(node, "col_offset", 0)))
+    return out
+
+
+def _lock_roots(prog: _Program) -> Dict[tuple, Set[str]]:
+    """Thread roots that reach each lock's acquire sites — the
+    contention precondition: a lock only ever taken from ONE root has
+    no second thread to deadlock or stall (multi-root gating for
+    CC702/CC703, same contract as CC701)."""
+    out: Dict[tuple, Set[str]] = {}
+    for f in prog.funcs.values():
+        for lid, _node, _held in f.acquires:
+            out.setdefault(lid, set()).update(
+                prog.roots.get(f.qual, set()))
+    return out
+
+
+def _cc702(prog: _Program) -> List[Diagnostic]:
+    # transitive acquired-set per function (2 rounds is plenty for the
+    # helper-under-lock chains in this tree)
+    acq: Dict[str, Set[tuple]] = {q: {lid for lid, _n, _h in f.acquires}
+                                  for q, f in prog.funcs.items()}
+    for _ in range(2):
+        for q, f in prog.funcs.items():
+            for callee, _h, _ln in f.calls:
+                if callee in acq:
+                    acq[q] |= acq[callee]
+    edges: Dict[tuple, Set[tuple]] = {}
+    witness: Dict[Tuple[tuple, tuple], tuple] = {}
+    for q, f in prog.funcs.items():
+        for lid, node, held in f.acquires:
+            for h in (held | f.entry_held):
+                if h != lid:
+                    edges.setdefault(h, set()).add(lid)
+                    witness.setdefault((h, lid),
+                                       (f, getattr(node, "lineno", 0)))
+        # call-through acquisition: holding h, call g which acquires l
+        for callee, held, ln in f.calls:
+            if callee is None:
+                continue
+            for h in (held | f.entry_held):
+                for l2 in acq.get(callee, ()):
+                    if l2 != h:
+                        edges.setdefault(h, set()).add(l2)
+                        witness.setdefault((h, l2), (f, ln))
+    # cycle detection (DFS, report each cycle's edges once); a cycle
+    # only deadlocks when >= 2 roots can traverse its locks
+    lroots = _lock_roots(prog)
+    out: List[Diagnostic] = []
+    color: Dict[tuple, int] = {}
+    stack: List[tuple] = []
+    reported: Set[frozenset] = set()
+
+    def dfs(u: tuple) -> None:
+        color[u] = 1
+        stack.append(u)
+        for v in sorted(edges.get(u, ()), key=str):
+            if color.get(v, 0) == 0:
+                dfs(v)
+            elif color.get(v) == 1:
+                cyc = stack[stack.index(v):] + [v]
+                key = frozenset(cyc)
+                if key in reported:
+                    continue
+                reported.add(key)
+                roots: Set[str] = set()
+                for lid in key:
+                    roots |= lroots.get(lid, set())
+                if len(roots) < 2:
+                    continue  # single-root: no second thread to oppose
+                pairs = list(zip(cyc, cyc[1:]))
+                f, ln = witness[pairs[0]]
+                order = " -> ".join(_fmt_lock(x) for x in cyc)
+                out.append(Diagnostic(
+                    "CC702",
+                    f"lock-order cycle: {order} (witness edge in "
+                    f"`{f.name}`; a thread taking these in the opposite "
+                    f"order deadlocks)",
+                    _path_of(prog, f.mod), ln))
+        stack.pop()
+        color[u] = 2
+
+    for u in sorted(edges, key=str):
+        if color.get(u, 0) == 0:
+            dfs(u)
+    return out
+
+
+def _cc703(prog: _Program) -> List[Diagnostic]:
+    lroots = _lock_roots(prog)
+    out = []
+    for f in prog.funcs.values():
+        for reason, node, held in f.blocking:
+            eff = held | f.entry_held
+            if not eff:
+                continue
+            # contention precondition: some held lock must be taken
+            # from >= 2 roots — nobody stalls behind a one-root lock
+            roots: Set[str] = set()
+            for lid in eff:
+                roots |= lroots.get(lid, set())
+            if len(roots) < 2:
+                continue
+            locks = ", ".join(sorted(_fmt_lock(x) for x in eff))
+            out.append(Diagnostic(
+                "CC703",
+                f"`{reason}` called in `{f.name}` while holding "
+                f"{locks}: every thread contending on the lock stalls "
+                f"behind this wait",
+                _path_of(prog, f.mod), node.lineno,
+                getattr(node, "col_offset", 0)))
+    return out
+
+
+def _cc704(prog: _Program) -> List[Diagnostic]:
+    out = []
+    for f in prog.funcs.values():
+        for target, node, wrapped in f.spawns:
+            if wrapped or target is None:
+                continue
+            tf = prog.funcs.get(target)
+            if tf is None:
+                continue
+            uses, establishes = _bfs_ctx(prog, tf, depth=3)
+            if uses and not establishes:
+                out.append(Diagnostic(
+                    "CC704",
+                    f"thread target `{tf.name}` (spawned in `{f.name}`) "
+                    f"touches contextvars-scoped obs/interrupt state "
+                    f"but the spawn neither copies the submitting "
+                    f"context (contextvars.copy_context) nor opens its "
+                    f"own scope — counters/spans land on an orphan "
+                    f"context",
+                    _path_of(prog, f.mod), node.lineno,
+                    getattr(node, "col_offset", 0)))
+    return out
+
+
+def _bfs_ctx(prog: _Program, start: _Func, depth: int) -> Tuple[bool, bool]:
+    seen = {start.qual}
+    frontier = [start]
+    uses = establishes = False
+    for _ in range(depth):
+        nxt: List[_Func] = []
+        for f in frontier:
+            uses = uses or f.ambient
+            establishes = establishes or f.establishes
+            for callee, _h, _ln in f.calls:
+                if callee and callee not in seen:
+                    seen.add(callee)
+                    g = prog.funcs.get(callee)
+                    if g is not None:
+                        nxt.append(g)
+        frontier = nxt
+    for f in frontier:  # the last ring's own markers still count
+        uses = uses or f.ambient
+        establishes = establishes or f.establishes
+    return uses, establishes
+
+
+def _path_of(prog: _Program, modpath: str) -> str:
+    for m in prog.modules:
+        if m.modpath == modpath:
+            return m.sf.path
+    return modpath
+
+
+# =========================================================================
+# entry point
+# =========================================================================
+
+def lint_concurrency(sources: List[SourceFile]) -> List[Diagnostic]:
+    """The CC7xx pass over one whole-program batch of sources.  Inline
+    suppressions are honored per owning file."""
+    if not sources:
+        return []
+    prog = _Program(sources)
+    diags = _cc701(prog) + _cc702(prog) + _cc703(prog) + _cc704(prog)
+    out: List[Diagnostic] = []
+    for d in diags:
+        sf = prog.by_path.get(d.path)
+        if sf is not None and sf.suppressed(d.rule, d.line):
+            continue
+        out.append(d)
+    out.sort(key=lambda d: (d.path, d.line, d.rule))
+    return out

@@ -84,8 +84,16 @@ MAX_DENSE_RANGE = 1 << 25   # dense key->pos tables up to 32M slots (128MB)
 MAX_EXPAND = 1 << 23        # CSR-join output bucket cap (8M rows)
 
 # structural node keys that have actually been compiled into some fused
-# pipeline — introspection surface for tests and the multichip dryrun
+# pipeline — introspection surface for tests and the multichip dryrun.
+# Guarded (qlint CC7xx triage): concurrent pool workers and the prewarm
+# worker both publish keys; set.update over an iterable is NOT atomic
+_CNK_MU = threading.Lock()
 COMPILED_NODE_KEYS: set = set()
+
+
+def _note_compiled(kparts) -> None:
+    with _CNK_MU:
+        COMPILED_NODE_KEYS.update(kparts)
 
 
 # =========================================================================
@@ -2618,7 +2626,7 @@ class DevPipeExec:
                         flat.append(v)
                         flat.append(m)
                     return kernels.pack_arrays(schema, flat)
-                COMPILED_NODE_KEYS.update(pb.kparts)
+                _note_compiled(pb.kparts)
                 return kernels.counted_jit(mega), schema
             fn, schema = progcache.get(key, build_small)
             vals = kernels.unpack_flat(fn(pb.inputs), schema)
@@ -2632,7 +2640,7 @@ class DevPipeExec:
                 def mega(args):
                     valid, cols = emit(args)
                     return [valid] + [x for vm in cols for x in vm]
-                COMPILED_NODE_KEYS.update(pb.kparts)
+                _note_compiled(pb.kparts)
                 return kernels.counted_jit(mega)
             fn = progcache.get(key, build_big)
             res = fn(pb.inputs)

@@ -217,6 +217,7 @@ def set_slo_p99_ms(ms: float) -> None:
         v = float(ms)
     except (TypeError, ValueError):
         v = 0.0
+    # qlint: disable=CC701 -- single GIL-atomic scalar-slot publish (no compound invariant); readers tolerate either the old or new objective
     SLO_STATE["p99_ms"] = max(v, 0.0)
 
 

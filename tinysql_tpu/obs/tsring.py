@@ -345,6 +345,10 @@ class Sampler:
         self.ring = ring if ring is not None else RING
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: guards the start/close lifecycle (qlint CC7xx triage): two
+        #: concurrent start() calls both passing the None-check would
+        #: leak a second sampler thread ticking the same ring
+        self._mu = threading.Lock()
 
     def _int_sysvar(self, name: str, default: int) -> int:
         # THE server-side config-read helper (server/pool.py) — one
@@ -357,19 +361,31 @@ class Sampler:
                                 DEFAULT_INTERVAL_S)
 
     def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._stop.clear()  # restartable after close()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="metrics-sampler")
-        self._thread.start()
+        with self._mu:
+            if self._thread is not None:
+                return
+            self._stop.clear()  # restartable after close()
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True,
+                                            name="metrics-sampler")
+            self._thread.start()
 
     def close(self) -> None:
-        self._stop.set()
-        t = self._thread
+        # set the stop flag UNDER the lock, atomically with reading the
+        # thread slot: a start() interleaved between the two would
+        # clear the flag and spawn a thread this close() then orphans
+        with self._mu:
+            self._stop.set()
+            t = self._thread
         if t is not None:
             t.join(timeout=5.0)
-        self._thread = None
+        # clear the slot only AFTER the join: a start() racing this
+        # close must keep seeing the old thread (and stay a no-op)
+        # until it has actually exited — nulling early would let start
+        # clear _stop before the old loop observed it
+        with self._mu:
+            if self._thread is t:
+                self._thread = None
 
     def _loop(self) -> None:
         # wait in 1 s slices, re-reading the interval each slice: an

@@ -190,6 +190,7 @@ def set_compile_cache_dir(path: str) -> None:
     "first run" skips the 20-40s XLA compiles entirely.  Empty path
     restores the resolution chain below.  Applies immediately when the
     backend is already initialized."""
+    # qlint: disable=CC701 -- single GIL-atomic scalar-slot publish; _cache_dir readers tolerate either the old or new override
     _CACHE_DIR_STATE["override"] = str(path) if path else None
     if _jax is not None:
         try:
@@ -386,17 +387,27 @@ def _abstractify(tree):
 # the AOT retrace never inflates the walls the MFU is computed from.
 # BOUNDED: beyond the cap a new spec records (0, 0) instead of queueing
 # — with cost tracking on and no drainer the list must not grow forever
-# (the pre-ISSUE-11 serving-mode leak)
+# (the pre-ISSUE-11 serving-mode leak).  GUARDED (_PENDING_MU, qlint
+# CC701): query threads append while the tsring Sampler AND bench.py can
+# drain concurrently — an unguarded pop raced against another drainer
+# raises IndexError out of whichever caller loses, and the cap check
+# raced against a concurrent append overshoots the bound
 _PENDING_COSTS: list = []
+_PENDING_MU = threading.Lock()
 PENDING_COSTS_MAX = 256
 
 
 def resolve_pending_costs() -> None:
     """Run the deferred cost analyses (bench calls this between timed
-    runs; the tsring Sampler drains it every tick).  Unresolvable
-    programs record (0, 0)."""
-    while _PENDING_COSTS:
-        costs, spec, w, absargs = _PENDING_COSTS.pop()
+    runs; the tsring Sampler drains it every tick — both may run at
+    once, so each entry is claimed under the lock and the expensive
+    lower/compile happens OUTSIDE it).  Unresolvable programs record
+    (0, 0)."""
+    while True:
+        with _PENDING_MU:
+            if not _PENDING_COSTS:
+                return
+            costs, spec, w, absargs = _PENDING_COSTS.pop()
         a, k = absargs
         try:
             ca = w.lower(*a, **k).compile().cost_analysis()
@@ -437,14 +448,19 @@ def counted_jit(fn, **kw):
                 stats_add("flops", c[0])
                 stats_add("bytes_accessed", c[1])
             elif spec not in costs:
-                if len(_PENDING_COSTS) >= PENDING_COSTS_MAX:
-                    # nothing is draining the queue: record zeros (an
-                    # honest undercount) instead of leaking memory
-                    costs[spec] = (0.0, 0.0)
-                else:
-                    costs[spec] = None
-                    _PENDING_COSTS.append((costs, spec, w,
-                                           _abstractify((a, k))))
+                with _PENDING_MU:
+                    # re-check under the lock: two threads first-
+                    # dispatching the same spec must not both enqueue
+                    # (duplicate cost analyses + wasted queue slots)
+                    if spec not in costs:
+                        if len(_PENDING_COSTS) >= PENDING_COSTS_MAX:
+                            # nothing is draining the queue: record
+                            # zeros (an honest undercount), not a leak
+                            costs[spec] = (0.0, 0.0)
+                        else:
+                            costs[spec] = None
+                            _PENDING_COSTS.append(
+                                (costs, spec, w, _abstractify((a, k))))
         sampled = profiler.should_sample()
         t0 = time.perf_counter() if sampled else 0.0
         with _obs.span("dispatch", cat="device"):

@@ -56,8 +56,13 @@ from ..utils.interrupt import QueryKilled
 log = logging.getLogger("tinysql_tpu.pool")
 
 #: live pools (weak — a pool dies with its Server); /metrics sums their
-#: queued/running gauges so the queued-vs-running split is scrapeable
+#: queued/running gauges so the queued-vs-running split is scrapeable.
+#: Guarded (qlint CC7xx triage): the sampler thread snapshots the set
+#: while servers register pools (and GC discards dead ones) on other
+#: threads — iterating a WeakSet under concurrent mutation raises
+#: RuntimeError out of the /metrics scrape
 _POOLS: "weakref.WeakSet" = weakref.WeakSet()
+_POOLS_MU = threading.Lock()
 
 
 def read_global_int(storage, name: str, default: int) -> int:
@@ -76,7 +81,9 @@ def gauges() -> dict:
     """Aggregate queued/running across every live pool (the /metrics
     feed)."""
     out = {"queued": 0, "running": 0}
-    for p in list(_POOLS):
+    with _POOLS_MU:
+        pools = list(_POOLS)
+    for p in pools:
         snap = p.snapshot()
         if not snap["closed"]:
             out["queued"] += snap["queued"]
@@ -160,7 +167,8 @@ class StatementPool:
         self._workers: List[threading.Thread] = []
         self._running = 0
         self._closed = False
-        _POOLS.add(self)
+        with _POOLS_MU:
+            _POOLS.add(self)
 
     # ---- config (GLOBAL sysvars, read live) -----------------------------
     def _gvar(self, name: str, default: int) -> int:

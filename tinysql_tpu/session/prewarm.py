@@ -172,8 +172,13 @@ class PrewarmWorker:
             t.join(timeout=5.0)
         self._thread = None
         # sessions are weakref-registered (utils/interrupt): dropping the
-        # reference retires the worker's conn id from the processlist
-        self._session = None
+        # reference retires the worker's conn id from the processlist.
+        # Under _mu (qlint CC701): a close() racing a still-draining
+        # cycle must not null the slot between _ensure_session's
+        # None-check and its use — the worker would crash on a vanished
+        # session instead of finishing its cycle
+        with self._mu:
+            self._session = None
 
     def _loop(self) -> None:
         # first cycle one full interval AFTER start: a cold server has an
@@ -284,16 +289,21 @@ class PrewarmWorker:
 
     def _ensure_session(self):
         from .session import DEFAULT_SYSVARS, Session
-        if self._session is None:
-            s = Session(self.storage, domain=self.domain)
-            s.internal = True  # stay OUT of the obs fan-out (see
-            #                    Session._finish_obs)
-            self._session = s
+        # check-and-create under _mu, then work on the LOCAL reference:
+        # a concurrent close() nulling self._session between the check
+        # and the use was a crash (AttributeError on None) in the
+        # middle of a warming cycle (qlint CC701)
+        with self._mu:
+            s = self._session
+            if s is None:
+                s = Session(self.storage, domain=self.domain)
+                s.internal = True  # stay OUT of the obs fan-out (see
+                #                    Session._finish_obs)
+                self._session = s
         # re-overlay the GLOBAL scope every use: Session.__init__
         # snapshots globals once, but the worker lives for the server's
         # lifetime — a later SET GLOBAL (tidb_use_tpu=0, block rows,
         # pipeline depth, ...) must reach warming executions
-        s = self._session
         s.sysvars = dict(DEFAULT_SYSVARS)
         s.sysvars.update(getattr(self.storage, "_global_vars", None) or {})
         return s

@@ -1,0 +1,340 @@
+"""TINYSQL_RACE_STRESS — the dynamic half of qlint's CC7xx concurrency
+pass (tools/race_stress.py is the CLI; tests/conftest.py arms this when
+the env var is set).
+
+Static analysis yields PLAUSIBLE findings; this module converts them
+into CONFIRMED (or measured-benign) ones by making races overwhelmingly
+more likely to fire and by instrumenting the lock catalogue:
+
+- :func:`install` shrinks ``sys.setswitchinterval`` (default 20 us vs
+  CPython's 5 ms — thread preemption every few bytecodes) and patches
+  ``threading.Lock``/``RLock`` so every lock constructed AFTERWARD is an
+  :class:`InstrumentedLock`: per-allocation-site acquire / contention /
+  wait / hold accounting, a per-thread held-stack, and a dynamic
+  lock-order edge set (the runtime twin of static CC702).
+- :func:`audit_known` wraps the catalogued shared module dicts
+  (kernels.STATS, progcache registries, admission/fail/prewarm/tsring
+  state) in an :class:`AuditDict` that records an UNGUARDED-WRITE report
+  whenever a mutation arrives without the owning instrumented lock held
+  by the writing thread — the dynamic twin of static CC701.
+- :func:`report` / :func:`write_report` publish the whole picture (top
+  contended locks, max hold times, dynamic lock-order cycles, unguarded
+  writes) — the race-stress CI job uploads it as an artifact.
+
+Counter updates are deliberately lock-free (approximate under extreme
+contention): the instrumentation must not serialize the very schedules
+it exists to provoke.  Release-by-another-thread (Condition waiter
+hand-offs) is tolerated: the holder slot clears, the held-stack entry is
+discarded only from the releasing thread's own stack.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional
+
+_STATE = {"installed": False, "switch_interval": 0.0}
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+#: allocation site -> aggregate stats (site = "file:line" of the first
+#: frame outside threading/queue/this module)
+_SITES: Dict[str, dict] = {}
+_SITES_MU = _REAL_LOCK()
+
+#: dynamic lock-order edges between allocation sites
+_ORDER_EDGES: set = set()
+
+#: unguarded-write reports from AuditDict
+_UNGUARDED: List[dict] = []
+
+#: labels successfully wrapped by audit_known
+_AUDITED: List[str] = []
+
+_TLS = threading.local()
+
+
+def _held_stack() -> list:
+    st = getattr(_TLS, "held", None)
+    if st is None:
+        st = _TLS.held = []
+    return st
+
+
+def _alloc_site() -> str:
+    skip = (os.sep + "threading.py", os.sep + "queue.py", "racestress.py",
+            os.sep + "logging" + os.sep)
+    for frame in traceback.extract_stack()[-12:][::-1]:
+        fn = frame.filename
+        if not any(s in fn for s in skip):
+            parts = fn.split(os.sep)
+            return "/".join(parts[-3:]) + f":{frame.lineno}"
+    return "<unknown>"
+
+
+def _site_stats(site: str) -> dict:
+    st = _SITES.get(site)
+    if st is None:
+        with _SITES_MU:
+            st = _SITES.setdefault(site, {
+                "acquires": 0, "contended": 0, "wait_s": 0.0,
+                "hold_s": 0.0, "hold_max_s": 0.0})
+    return st
+
+
+class InstrumentedLock:
+    """Wrapper around a real lock with site-aggregated accounting.
+    Quacks enough like ``threading.Lock`` for ``Condition`` (explicit
+    ``_is_owned`` so plain-Lock conditions work; RLock extras delegate
+    to the inner lock)."""
+
+    __slots__ = ("_inner", "_stats", "_site", "_holder", "_t0")
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self._site = site
+        self._stats = _site_stats(site)
+        self._holder = None
+        self._t0 = 0.0
+
+    # ---- the lock protocol ----------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        st = self._stats
+        got = self._inner.acquire(False)
+        if not got:
+            st["contended"] += 1
+            if not blocking:
+                return False
+            t0 = time.perf_counter()
+            got = self._inner.acquire(True, timeout)
+            st["wait_s"] += time.perf_counter() - t0
+            if not got:
+                return False
+        st["acquires"] += 1
+        me = threading.get_ident()
+        if self._holder != me:  # first (non-reentrant) level
+            self._holder = me
+            self._t0 = time.perf_counter()
+            held = _held_stack()
+            for h in held:
+                # edges are SITE-keyed: skip same-site pairs — two
+                # DIFFERENT instances born at one `self._mu = Lock()`
+                # line nested once would otherwise read as a self-cycle
+                if h is not self and h._site != self._site:
+                    _ORDER_EDGES.add((h._site, self._site))
+            held.append(self)
+        return True
+
+    def release(self):
+        me = threading.get_ident()
+        if self._holder == me:
+            st = self._stats
+            dt = time.perf_counter() - self._t0
+            st["hold_s"] += dt
+            if dt > st["hold_max_s"]:
+                st["hold_max_s"] = dt
+            self._holder = None
+        held = getattr(_TLS, "held", None)
+        if held is not None and self in held:
+            held.remove(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    # Condition-protocol hook (plain locks): "owned" == this thread took
+    # it through the wrapper and has not released it
+    def _is_owned(self):
+        return self._holder == threading.get_ident()
+
+    def held_by_current(self) -> bool:
+        return self._holder == threading.get_ident()
+
+    def __getattr__(self, name):  # RLock _release_save/_acquire_restore
+        return getattr(self._inner, name)
+
+    def __repr__(self):
+        return f"<InstrumentedLock {self._site} {self._inner!r}>"
+
+
+def _make_lock():
+    return InstrumentedLock(_REAL_LOCK(), _alloc_site())
+
+
+def _make_rlock():
+    return InstrumentedLock(_REAL_RLOCK(), _alloc_site())
+
+
+def install(switch_interval: Optional[float] = None) -> None:
+    """Arm the stress mode (idempotent): shrink the bytecode switch
+    interval and patch the lock constructors.  Locks created BEFORE the
+    call stay raw — arm before importing tinysql_tpu modules."""
+    if _STATE["installed"]:
+        return
+    if switch_interval is None:
+        switch_interval = float(os.environ.get(
+            "TINYSQL_RACE_STRESS_SWITCH", "2e-5"))
+    sys.setswitchinterval(switch_interval)
+    _STATE["switch_interval"] = switch_interval
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+    _STATE["installed"] = True
+
+
+class AuditDict(dict):
+    """dict whose mutations must arrive with the owning instrumented
+    lock held by the writing thread; violations are recorded (never
+    raised — the suite must finish so the report is complete)."""
+
+    __slots__ = ("_guard", "_label")
+
+    def __init__(self, src, guard, label: str):
+        super().__init__(src)
+        self._guard = guard
+        self._label = label
+
+    def _check(self):
+        g = self._guard
+        if g is not None and not g.held_by_current():
+            frames = [f"{'/'.join(f.filename.split(os.sep)[-3:])}"
+                      f":{f.lineno}"
+                      for f in traceback.extract_stack()[-6:-2]]
+            _UNGUARDED.append({
+                "state": self._label,
+                "thread": threading.current_thread().name,
+                "stack": frames})
+
+    def __setitem__(self, k, v):
+        self._check()
+        dict.__setitem__(self, k, v)
+
+    def __delitem__(self, k):
+        self._check()
+        dict.__delitem__(self, k)
+
+    def update(self, *a, **kw):
+        self._check()
+        dict.update(self, *a, **kw)
+
+    def pop(self, *a):
+        self._check()
+        return dict.pop(self, *a)
+
+    def popitem(self):
+        self._check()
+        return dict.popitem(self)
+
+    def clear(self):
+        self._check()
+        dict.clear(self)
+
+    def setdefault(self, k, d=None):
+        self._check()
+        return dict.setdefault(self, k, d)
+
+
+#: the audited-state catalogue: (module, dict attr, guard lock attr).
+#: Exactly the guard relationships qlint CC701 infers statically.
+AUDIT_CATALOG = [
+    ("tinysql_tpu.ops.kernels", "STATS", "_STATS_MU"),
+    ("tinysql_tpu.ops.progcache", "STATS", "_mu"),
+    ("tinysql_tpu.ops.progcache", "_REG", "_mu"),
+    ("tinysql_tpu.ops.progcache", "_CATALOG", "_mu"),
+    ("tinysql_tpu.server.admission", "STATS", "_mu"),
+    ("tinysql_tpu.session.prewarm", "PREWARM_STATS", "_STATS_MU"),
+    ("tinysql_tpu.obs.tsring", "_SOURCES", "_src_mu"),
+    ("tinysql_tpu.fail", "_ACTIVE", "_mu"),
+    ("tinysql_tpu.fail", "_HITS", "_mu"),
+]
+
+
+def audit_known() -> List[str]:
+    """Wrap every catalogued shared dict whose guard lock came out of
+    the instrumented constructors.  Returns the labels wrapped."""
+    import importlib
+    wrapped = []
+    for modname, dname, lname in AUDIT_CATALOG:
+        try:
+            mod = importlib.import_module(modname)
+        except Exception:
+            continue
+        d = getattr(mod, dname, None)
+        g = getattr(mod, lname, None)
+        if not isinstance(d, dict) or isinstance(d, AuditDict) \
+                or not isinstance(g, InstrumentedLock):
+            continue
+        label = f"{modname}.{dname}"
+        setattr(mod, dname, AuditDict(d, g, label))
+        wrapped.append(label)
+    _AUDITED.extend(wrapped)
+    return wrapped
+
+
+def _order_cycles() -> List[List[str]]:
+    """Cycles in the dynamically observed lock-order graph."""
+    edges: Dict[str, set] = {}
+    for a, b in _ORDER_EDGES:
+        edges.setdefault(a, set()).add(b)
+    cycles: List[List[str]] = []
+    seen_keys = set()
+    color: Dict[str, int] = {}
+    stack: List[str] = []
+
+    def dfs(u: str) -> None:
+        color[u] = 1
+        stack.append(u)
+        for v in sorted(edges.get(u, ())):
+            if color.get(v, 0) == 0:
+                dfs(v)
+            elif color.get(v) == 1:
+                cyc = stack[stack.index(v):] + [v]
+                key = frozenset(cyc)
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    cycles.append(cyc)
+        stack.pop()
+        color[u] = 2
+
+    for u in sorted(edges):
+        if color.get(u, 0) == 0:
+            dfs(u)
+    return cycles
+
+
+def report() -> dict:
+    """The full stress report (JSON-able)."""
+    with _SITES_MU:
+        sites = {k: dict(v) for k, v in _SITES.items()}
+    locks = [dict(site=site, **st) for site, st in sites.items()]
+    locks.sort(key=lambda r: (-r["contended"], -r["hold_max_s"]))
+    for r in locks:
+        for k in ("wait_s", "hold_s", "hold_max_s"):
+            r[k] = round(r[k], 6)
+    return {
+        "installed": _STATE["installed"],
+        "switch_interval": _STATE["switch_interval"],
+        "locks_instrumented": len(locks),
+        "locks": locks,
+        "lock_order_edges": len(_ORDER_EDGES),
+        "lock_order_cycles": _order_cycles(),
+        "audited_state": list(_AUDITED),
+        "unguarded_writes": list(_UNGUARDED[:200]),
+        "unguarded_write_count": len(_UNGUARDED),
+    }
+
+
+def write_report(path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report(), f, indent=2, sort_keys=True)

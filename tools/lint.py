@@ -2,22 +2,30 @@
 """qlint CLI — run the static-analysis passes (see docs/LINT.md).
 
 Usage:
-    python tools/lint.py [--strict] [--pass trace|locks|plans|all]
+    python tools/lint.py [--strict] [--json]
+                         [--pass trace|locks|obs|fail|conc|plans|all]
                          [--rules] [--fuzz-n N] [paths...]
 
 - `--strict` (the CI entry point): run every pass over its default scope
   and exit non-zero on any violation.
-- `--pass trace|locks` over explicit paths: lint just those files.
+- `--pass trace|locks|...` over explicit paths: lint just those files.
+  `conc` is WHOLE-PROGRAM: all given paths form one analysis batch
+  (default: the entire package).
 - `--pass plans`: plan the SQL corpus (tests/test_sql.py statement
   replay + tests/test_sqlite_diff.py's seeded generator) with the TPU
   tier enabled and check every placed plan's device invariants.
+- `--json`: machine-readable report on stdout (CI annotation feed)
+  instead of the human text.
 - `--rules`: print the rule catalogue.
 
-Exit status: 0 clean, 1 violations, 2 usage/internal error.
+Exit status: 0 clean, 1 violations, 2 usage/internal error — distinct,
+so CI can tell "findings" from "the linter itself broke" without
+grepping text.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -101,10 +109,38 @@ def run_fail(paths):
     return diags
 
 
+def run_conc(paths):
+    """Whole-program CC7xx: every file under every given path joins ONE
+    analysis batch (cross-module races only exist in the union)."""
+    from tinysql_tpu.analysis import gather_sources, lint_concurrency
+    batch = []
+    for p in paths:
+        batch.extend(gather_sources(p))
+    diags = []
+    for sf in batch:
+        diags.extend(sf.check_suppression_syntax())
+    diags.extend(lint_concurrency(batch))
+    return diags
+
+
 def run_plans(fuzz_n=None):
     _force_cpu_backend()
     from tinysql_tpu.analysis.plan_device import check_corpus
     return check_corpus(REPO_ROOT, fuzz_queries=fuzz_n)
+
+
+def _emit_json(diags, passes, error: str = "") -> None:
+    payload = {
+        "clean": not diags and not error,
+        "count": len(diags),
+        "passes": sorted(passes),
+        "violations": [{"rule": d.rule, "path": d.path, "line": d.line,
+                        "col": d.col, "severity": d.severity,
+                        "message": d.message} for d in diags],
+    }
+    if error:
+        payload["error"] = error
+    print(json.dumps(payload, indent=2, sort_keys=True))
 
 
 def main(argv=None) -> int:
@@ -114,10 +150,12 @@ def main(argv=None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="run all passes over their default scopes")
     ap.add_argument("--pass", dest="passes", action="append",
-                    choices=["trace", "locks", "obs", "fail", "plans",
-                             "all"],
+                    choices=["trace", "locks", "obs", "fail", "conc",
+                             "plans", "all"],
                     help="which pass(es) to run (default: trace+locks+obs"
-                         "+fail over paths; all under --strict)")
+                         "+fail+conc over paths; all under --strict)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON report on stdout")
     ap.add_argument("--rules", action="store_true",
                     help="print the rule catalogue and exit")
     ap.add_argument("--fuzz-n", type=int, default=None,
@@ -135,30 +173,46 @@ def main(argv=None) -> int:
 
     passes = set(args.passes or [])
     if args.strict or "all" in passes:
-        passes = {"trace", "locks", "obs", "fail", "plans"}
+        passes = {"trace", "locks", "obs", "fail", "conc", "plans"}
     elif not passes:
-        passes = {"trace", "locks", "obs", "fail"}
+        passes = {"trace", "locks", "obs", "fail", "conc"}
 
     pkg = os.path.join(REPO_ROOT, "tinysql_tpu")
     paths = args.paths or [pkg]
     diags = []
-    if "trace" in passes:
-        diags.extend(run_trace(paths))
-    if "locks" in passes:
-        lock_paths = (args.paths if args.paths
-                      else [os.path.join(REPO_ROOT, p)
-                            for p in LOCK_SCOPE])
-        diags.extend(run_locks(lock_paths))
-    if "obs" in passes:
-        diags.extend(run_obs(paths))
-    if "fail" in passes:
-        fail_paths = (args.paths if args.paths
-                      else [os.path.join(REPO_ROOT, p)
-                            for p in FAIL_SCOPE])
-        diags.extend(run_fail(fail_paths))
-    if "plans" in passes:
-        diags.extend(run_plans(args.fuzz_n))
+    try:
+        for p in paths:
+            if not os.path.exists(p):
+                raise FileNotFoundError(f"no such path: {p}")
+        if "trace" in passes:
+            diags.extend(run_trace(paths))
+        if "locks" in passes:
+            lock_paths = (args.paths if args.paths
+                          else [os.path.join(REPO_ROOT, p)
+                                for p in LOCK_SCOPE])
+            diags.extend(run_locks(lock_paths))
+        if "obs" in passes:
+            diags.extend(run_obs(paths))
+        if "fail" in passes:
+            fail_paths = (args.paths if args.paths
+                          else [os.path.join(REPO_ROOT, p)
+                                for p in FAIL_SCOPE])
+            diags.extend(run_fail(fail_paths))
+        if "conc" in passes:
+            diags.extend(run_conc(paths))
+        if "plans" in passes:
+            diags.extend(run_plans(args.fuzz_n))
+    except Exception as e:  # the linter itself broke: exit 2, not 1
+        msg = f"{type(e).__name__}: {e}"
+        if args.json:
+            _emit_json(diags, passes, error=msg)
+        else:
+            print(f"qlint: internal error: {msg}", file=sys.stderr)
+        return 2
 
+    if args.json:
+        _emit_json(diags, passes)
+        return 1 if diags else 0
     if diags:
         print(format_diagnostics(diags))
         return 1

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""race_stress — the dynamic verifier behind qlint's CC7xx pass.
+
+Replays the concurrency-heavy test subset (chaos + serve + spill, the
+suites that exercise the statement pool, devpipe producers, the tsring
+sampler, spill eviction, and the failpoint ladder) in
+``TINYSQL_RACE_STRESS`` mode:
+
+- ``sys.setswitchinterval`` shrunk ~250x (preemption every few hundred
+  bytecodes), so GIL-window races that survive a normal run fire;
+- every ``threading.Lock``/``RLock`` constructed by the engine is
+  instrumented (acquire / contention / wait / hold accounting plus a
+  dynamic lock-order edge graph — the runtime twin of CC702);
+- the catalogued shared dicts (kernels.STATS, progcache registries,
+  admission/fail/prewarm/tsring state) audit every mutation against
+  their owning lock — an unguarded write is recorded with its stack
+  (the runtime twin of CC701).
+
+Exit status: 0 = subset green AND zero unguarded writes AND zero
+dynamic lock-order cycles; 1 otherwise.  The JSON report (default
+``race_stress_report.json``) is the CI artifact.
+
+Usage:
+    python tools/race_stress.py [--report PATH] [--switch SECONDS]
+                                [--subset chaos,serve,spill] [tests...]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SUBSETS = {
+    "chaos": "tests/test_chaos.py",
+    "serve": "tests/test_serve.py",
+    "spill": "tests/test_spill.py",
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="race_stress", description=__doc__)
+    ap.add_argument("tests", nargs="*",
+                    help="explicit test paths (override --subset)")
+    ap.add_argument("--subset", default="chaos,serve,spill",
+                    help="named subsets to replay (default: all three)")
+    ap.add_argument("--report", default="race_stress_report.json",
+                    help="where to write the JSON report")
+    ap.add_argument("--switch", default=None,
+                    help="sys.setswitchinterval override (seconds)")
+    args = ap.parse_args(argv)
+
+    paths = args.tests
+    if not paths:
+        paths = []
+        for name in args.subset.split(","):
+            name = name.strip()
+            if name not in SUBSETS:
+                print(f"race_stress: unknown subset {name!r} "
+                      f"(have: {', '.join(sorted(SUBSETS))})",
+                      file=sys.stderr)
+                return 1
+            paths.append(SUBSETS[name])
+
+    report_path = os.path.abspath(args.report)
+    if os.path.exists(report_path):
+        os.unlink(report_path)
+    env = dict(os.environ)
+    env["TINYSQL_RACE_STRESS"] = "1"
+    env["TINYSQL_RACE_STRESS_REPORT"] = report_path
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if args.switch:
+        env["TINYSQL_RACE_STRESS_SWITCH"] = args.switch
+
+    cmd = [sys.executable, "-m", "pytest", *paths, "-q", "-m", "not slow",
+           "-p", "no:cacheprovider"]
+    print(f"race_stress: {' '.join(cmd)}")
+    rc = subprocess.call(cmd, cwd=REPO_ROOT, env=env)
+
+    if not os.path.exists(report_path):
+        print("race_stress: FAIL — no report written (conftest hook "
+              "did not run?)", file=sys.stderr)
+        return 1
+    with open(report_path, "r", encoding="utf-8") as f:
+        rep = json.load(f)
+
+    print(f"\nrace_stress report ({report_path})")
+    print(f"  switch interval : {rep['switch_interval']}")
+    print(f"  locks seen      : {rep['locks_instrumented']}")
+    print(f"  audited state   : {len(rep['audited_state'])} dict(s)")
+    print(f"  order edges     : {rep['lock_order_edges']}")
+    print("  top contended locks (site, acquires, contended, "
+          "wait_s, hold_max_s):")
+    for r in rep["locks"][:10]:
+        if not r["acquires"]:
+            continue
+        print(f"    {r['site']:<55} {r['acquires']:>8} "
+              f"{r['contended']:>6} {r['wait_s']:>9.4f} "
+              f"{r['hold_max_s']:>9.4f}")
+
+    bad = False
+    if rc != 0:
+        print(f"race_stress: FAIL — test subset exited {rc}")
+        bad = True
+    if rep["unguarded_write_count"]:
+        print(f"race_stress: FAIL — {rep['unguarded_write_count']} "
+              f"unguarded write(s) to audited shared state:")
+        for w in rep["unguarded_writes"][:20]:
+            print(f"    {w['state']} from thread {w['thread']} "
+                  f"at {w['stack'][-1] if w['stack'] else '?'}")
+        bad = True
+    if rep["lock_order_cycles"]:
+        print(f"race_stress: FAIL — dynamic lock-order cycle(s): "
+              f"{rep['lock_order_cycles']}")
+        bad = True
+    if not bad:
+        print("race_stress: OK — subset green, zero unguarded writes, "
+              "zero lock-order cycles")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
