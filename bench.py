@@ -473,6 +473,15 @@ def main():
     obs_overhead_frac = tsring.measure_overhead()["obs_overhead_frac"]
     print(f"[bench] obs_overhead_frac={obs_overhead_frac}",
           file=sys.stderr)
+    # continuous-profiler self-cost (ISSUE 13): one tick's live frame
+    # walk against THIS process, scaled to the default sampling rate —
+    # ONE shared definition with bench_serve (conprof.measure_overhead /
+    # live_overhead_frac for a server run)
+    from tinysql_tpu.obs import conprof
+    conprof_overhead = conprof.measure_overhead()
+    conprof_overhead_frac = conprof_overhead["conprof_overhead_frac"]
+    print(f"[bench] conprof_overhead_frac={conprof_overhead_frac} "
+          f"({conprof_overhead})", file=sys.stderr)
 
     q1_dev, q1_cpu, q1_lite, q1_ok = results["Q1"]
     # the metric NAME carries the tier that actually ran: an XLA:CPU run
@@ -496,6 +505,7 @@ def main():
         "param_reuse": param_reuse,
         "spill": spill_summary,
         "obs_overhead_frac": obs_overhead_frac,
+        "conprof_overhead_frac": conprof_overhead_frac,
         "link": link,
         "correct": all(ok for _, _, _, ok in results.values())
                    and all(e["match"] for e in op_results.values())

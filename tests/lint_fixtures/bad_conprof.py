@@ -1,0 +1,50 @@
+"""OB406 fixture: continuous-profiler fold/attribution writes outside
+obs/conprof.py.
+
+The statement-CPU counters (``cpu_s`` / ``cpu_samples``) carry
+SAMPLE-ESTIMATED on-thread time capped at the statement's wall — only
+the profiler's sampler tick may write them; and the profiler's window
+store may only be mutated by that same tick (rotation/eviction
+accounting).
+
+Every line marked OB406 below must fire the rule; the clean patterns at
+the bottom must stay silent.  Never imported — parsed by test_lint.py.
+"""
+from tinysql_tpu.obs import conprof
+from tinysql_tpu.obs import context as _obs
+from tinysql_tpu.obs.conprof import PROF, sample_once
+from tinysql_tpu.ops import kernels
+
+
+def fake_cpu_attribution(qobs, dt):
+    # un-sampled wall time laundered into the CPU-attribution counters
+    qobs.add_counter("cpu_s", dt)                      # OB406
+    qobs.add_counter("cpu_samples", 1)                 # OB406
+    kernels.stats_add("cpu_s", dt)                     # OB406
+    _obs.record("cpu_samples", 1)                      # OB406
+
+
+def fake_profile_tick():
+    # mutating the window store from outside the sampler corrupts the
+    # rotation/eviction accounting
+    conprof.PROF.sample_once(0.1)                      # OB406
+    PROF.reset()                                       # OB406
+    sample_once(0.1)                                   # OB406
+
+
+def clean_patterns():
+    # reads are fine anywhere — that is what the mem-table scan,
+    # /debug/conprof, and the benches do
+    rows = conprof.rows()
+    text = conprof.collapsed(window_s=60)
+    stats = conprof.stats_snapshot()
+    # unrelated counters route through the accumulators freely
+    kernels.stats_add("dispatches", 1)
+    _obs.record("d2h_bytes", 4096)
+    # an unrelated local reset/PROF is not conprof state
+    PROF_LOCAL = {"x": 1}
+
+    def reset():
+        PROF_LOCAL.clear()
+    reset()
+    return rows, text, stats

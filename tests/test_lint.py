@@ -221,8 +221,52 @@ def test_thread_root_discovery_covers_known_loops():
     for loop in ("StatementPool._worker_loop", "Sampler._loop",
                  "PrewarmWorker._loop", "BlockPipeline._run",
                  "CopClient._run_task", "ClientConn.run",
-                 "Server._accept_loop"):
+                 "Server._accept_loop", "ConprofSampler._loop"):
         assert loop in entries, sorted(entries)
+
+
+def test_thread_spawn_names_classify_to_conprof_roles():
+    """The thread-name sweep contract (ISSUE 13): every discovered
+    spawn site hands its thread a stable ``name=`` that the conprof
+    role vocabulary classifies — so continuous_profiling, race-stress
+    contention reports, and py-spy output all read the same words.  A
+    new spawn site with an out-of-vocabulary (or missing) name fails
+    here."""
+    from tinysql_tpu.obs.conprof import classify
+    for spawn_name, role in (
+            ("stmt-pool-0", "pool-worker"),      # StatementPool workers
+            ("conn-17", "conn"),                 # ClientConn.run threads
+            ("mysql-accept", "accept"),          # Server._accept_loop
+            ("devpipe-stage", "devpipe"),        # BlockPipeline._run
+            ("metrics-sampler", "tsring"),       # tsring Sampler._loop
+            ("conprof-sampler", "conprof"),      # ConprofSampler._loop
+            ("auto-prewarm", "prewarm"),         # PrewarmWorker._loop
+            ("distsql-cop_0", "distsql"),        # CopClient task pool
+            ("status-http", "http"),             # StatusServer
+            ("domain-reload-s1", "domain"),      # Domain ticker
+            ("ddl-owner-s1", "ddl"),             # Domain owner loop
+            ("range-gc_0", "kv"),                # kv/range_task pools
+            ("kv-commit_0", "kv"),               # 2PC commit pool
+            ("kv-lookup_0", "kv"),               # index lookup pool
+            ("kv-schema_0", "kv"),               # infoschema load pool
+            ("MainThread", "main")):
+        assert classify(spawn_name) == role, spawn_name
+    # the spawn sites actually USE those names: grep the source for the
+    # literal name= fragments so a rename cannot drift from this table
+    fragments = {
+        'name=f"stmt-pool-': "tinysql_tpu/server/pool.py",
+        'name=f"conn-': "tinysql_tpu/server/server.py",
+        'name="mysql-accept"': "tinysql_tpu/server/server.py",
+        'name="devpipe-stage"': "tinysql_tpu/executor/devpipe.py",
+        'name="metrics-sampler"': "tinysql_tpu/obs/tsring.py",
+        'name="conprof-sampler"': "tinysql_tpu/obs/conprof.py",
+        'name="auto-prewarm"': "tinysql_tpu/session/prewarm.py",
+        'thread_name_prefix="distsql-cop"': "tinysql_tpu/distsql/client.py",
+        'name="status-http"': "tinysql_tpu/server/http_status.py",
+    }
+    for frag, relpath in fragments.items():
+        with open(os.path.join(REPO, relpath)) as fh:
+            assert frag in fh.read(), (frag, relpath)
 
 
 def test_tree_concurrency_clean():
@@ -503,6 +547,46 @@ def test_ob405_other_keys_silent(tmp_path):
     assert lint_obs_discipline(SourceFile(str(p))) == []
 
 
+def test_conprof_fixture_fires_ob406():
+    sf = SourceFile(os.path.join(FIXDIR, "bad_conprof.py"))
+    diags = lint_obs_discipline(sf)
+    got = [d for d in diags if d.rule == "OB406"]
+    # 4 laundered cpu-key writes + 3 store mutations; the reads and
+    # the unrelated local reset/PROF stay silent
+    assert len(got) == 7, [d.format() for d in diags]
+    assert sum(1 for d in got if "cpu" in d.message) == 4
+    assert sum(1 for d in got if "store write" in d.message) == 3
+
+
+def test_ob406_owning_module_exempt(tmp_path):
+    # obs/conprof.py owns the fold/attribution state; a same-named file
+    # is exempt by basename like the OB401/OB405 contracts
+    p = tmp_path / "conprof.py"
+    p.write_text("def attribute(qobs, dt):\n"
+                 "    qobs.add_counter('cpu_s', dt)\n"
+                 "    qobs.add_counter('cpu_samples', 1)\n")
+    assert lint_obs_discipline(SourceFile(str(p))) == []
+
+
+def test_ob406_reads_and_unrelated_names_silent(tmp_path):
+    # reads are what the benches/mem-tables do, and an unrelated
+    # sample_once/reset (no provable conprof import) is not conprof
+    p = tmp_path / "elsewhere.py"
+    p.write_text("from tinysql_tpu.obs import conprof\n"
+                 "rows = conprof.rows()\n"
+                 "text = conprof.collapsed(window_s=60)\n"
+                 "stats = conprof.stats_snapshot()\n"
+                 "class Ring:\n"
+                 "    def sample_once(self):\n"
+                 "        pass\n"
+                 "r = Ring()\n"
+                 "r.sample_once()\n"
+                 "def reset():\n"
+                 "    pass\n"
+                 "reset()\n")
+    assert lint_obs_discipline(SourceFile(str(p))) == []
+
+
 def test_metric_fixture_fires_ob404():
     sf = SourceFile(os.path.join(FIXDIR, "bad_metric.py"))
     diags = lint_obs_discipline(sf)
@@ -595,6 +679,7 @@ def test_corpus_plans_clean():
     ("obs", "bad_summary.py"),
     ("obs", "bad_metric.py"),
     ("obs", "bad_devtime.py"),
+    ("obs", "bad_conprof.py"),
     ("conc", "bad_race.py"),
     ("conc", "bad_lockorder.py"),
     ("conc", "bad_blocking.py"),

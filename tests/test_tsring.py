@@ -439,7 +439,9 @@ EXPECTED_RULES = {"compile-storm", "progcache-hit-rate",
                   "prewarm-starvation",
                   # device-time truth (ISSUE 11)
                   "dispatch-storm", "transfer-bound",
-                  "recompile-churn", "slo-burn"}
+                  "recompile-churn", "slo-burn",
+                  # host-CPU truth (ISSUE 13)
+                  "cpu-saturation", "profiler-overhead"}
 
 
 def test_rule_catalogue_fully_covered():
@@ -694,6 +696,58 @@ def test_rule_slo_burn():
         assert not _findings(ring, "slo-burn")
     finally:
         oinspect.set_slo_p99_ms(0)
+
+
+def test_rule_cpu_saturation():
+    from tinysql_tpu.obs.conprof import role_metric
+    n = oinspect.CPU_SAT_MIN_BUSY_SAMPLES
+    # 90% of busy samples on pool workers while the queue held
+    # statements: critical, item names the dominant role
+    ring = _ring_with({role_metric("pool-worker"): n * 0.9,
+                       role_metric("main"): n * 0.1,
+                       "tinysql_pool_queued": 5})
+    f = _findings(ring, "cpu-saturation")
+    assert len(f) == 1 and f[0].severity == "critical"
+    assert f[0].item == "pool-worker"
+    assert f[0].metric == role_metric("pool-worker")
+    # dominant but below the critical share: warning
+    ring = _ring_with({role_metric("pool-worker"): n * 0.7,
+                       role_metric("main"): n * 0.3,
+                       "tinysql_pool_queued": 5})
+    assert _findings(ring, "cpu-saturation")[0].severity == "warning"
+    # same dominance with an EMPTY admission queue: silent (that is
+    # just the workload's shape, not a serving bottleneck)
+    ring = _ring_with({role_metric("pool-worker"): n * 0.9,
+                       role_metric("main"): n * 0.1})
+    assert not _findings(ring, "cpu-saturation")
+    # spread across roles: silent
+    ring = _ring_with({role_metric("pool-worker"): n * 0.4,
+                       role_metric("conn"): n * 0.3,
+                       role_metric("distsql"): n * 0.3,
+                       "tinysql_pool_queued": 5})
+    assert not _findings(ring, "cpu-saturation")
+    # too few busy samples to judge: silent
+    ring = _ring_with({role_metric("pool-worker"): n - 1,
+                       "tinysql_pool_queued": 5})
+    assert not _findings(ring, "cpu-saturation")
+
+
+def test_rule_profiler_overhead():
+    # the profiler spent 10% of one core on itself over a 20 s window
+    # (budget 3%): finding, details carry the live backoff divisor
+    ring = _ring_with({"tinysql_conprof_self_seconds_total": 2.0,
+                       "tinysql_conprof_backoff": 4})
+    f = _findings(ring, "profiler-overhead")
+    assert len(f) == 1 and f[0].severity == "warning"
+    assert f[0].metric == "tinysql_conprof_self_seconds_total"
+    assert "divisor 4" in f[0].details
+    # comfortably under budget: silent
+    ring = _ring_with({"tinysql_conprof_self_seconds_total": 0.1})
+    assert not _findings(ring, "profiler-overhead")
+    # no movement / too few points: silent
+    ring = MetricsRing()
+    ring.record({"tinysql_conprof_self_seconds_total": 5.0}, now=1000.0)
+    assert not _findings(ring, "profiler-overhead")
 
 
 def test_rule_pool_saturation_under_armed_failpoint_via_sql(storage):

@@ -33,6 +33,18 @@ Rules:
   ops/progcache.get.  A ``stats_add``/``record`` of those keys anywhere
   else would publish a host submit wall as device truth — the exact
   fiction ISSUE 11 removes.
+- **OB406**: continuous-profiler fold/attribution writes outside
+  ``obs/conprof.py``.  The statement CPU counters (``cpu_s`` /
+  ``cpu_samples``) are SAMPLE-ESTIMATED truth: only the profiler's
+  sampler tick — which walks ``sys._current_frames()``, resolves the
+  executing thread through the interrupt registry, and caps each
+  increment at the statement's elapsed wall — may write them.  Any
+  other writer would publish un-sampled wall time as CPU attribution
+  (breaking the ``sum_cpu_ms <= exec wall`` invariant), and any
+  out-of-module mutation of the profiler's window store
+  (``sample_once`` / ``reset`` on the module or its ``PROF``/
+  ``Profiler`` instances) would corrupt the rotation/eviction
+  accounting behind ``information_schema.continuous_profiling``.
 - **OB404**: metric-name drift.  In any module that touches the
   time-series ring (imports ``obs/tsring.py``, or IS it), every
   ``tinysql_*`` metric-name string literal must be declared in the
@@ -71,6 +83,10 @@ register_rules({
              "profiler/kernels/progcache modules — only a "
              "block_until_ready-closed dispatch or a timed program "
              "build may claim device/compile wall",
+    "OB406": "continuous-profiler fold/attribution write outside "
+             "obs/conprof.py — only the sampler tick may claim "
+             "statement CPU (cpu_s/cpu_samples) or mutate the "
+             "window store",
 })
 
 #: modules that own a STATS dict and its accessors (the serving layer's
@@ -98,6 +114,15 @@ DEVTIME_OWNING_MODULES = ("kernels.py", "profiler.py", "progcache.py")
 #: accumulator entry points a device-time key could ride through
 _DEVTIME_SINKS = {"stats_add", "stats_hwm", "record", "record_hwm",
                   "add_counter", "add_device"}
+
+#: statement-CPU attribution keys (OB406) and their owning module: the
+#: continuous profiler's sampler tick is the ONLY writer — these carry
+#: sample-estimated on-thread time capped at the statement's wall
+CPU_KEYS = {"cpu_s", "cpu_samples"}
+CONPROF_OWNING_MODULE = "conprof.py"
+
+#: mutating entry points on the profiler store / its module facade
+_CONPROF_WRITERS = {"sample_once", "reset"}
 
 
 def _is_stats_target(e: ast.expr) -> bool:
@@ -206,6 +231,89 @@ def _lint_devtime_writes(sf: SourceFile) -> List[Diagnostic]:
     return diags
 
 
+# ---- OB406: continuous-profiler write discipline --------------------------
+
+def _conprof_import_aliases(sf: SourceFile):
+    """(module aliases, writer names, profiler-instance names) bound by
+    any import of conprof — the OB403 matching contract: a name
+    READING as the module (bare ``conprof`` / any ``.conprof``
+    attribute) matches by naming convention, exactly like OB403's
+    ``stmtsummary``; the generic names (``reset`` / ``sample_once`` /
+    ``PROF``) qualify only when PROVABLY imported from conprof, so an
+    unrelated local ``reset`` helper or ``PROF`` global stays silent."""
+    modules, writers, profs = {"conprof"}, set(), set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.rsplit(".", 1)[-1] == "conprof" \
+                        and alias.asname:
+                    modules.add(alias.asname)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.rsplit(".", 1)[-1] == "conprof":
+                for alias in node.names:
+                    if alias.name in _CONPROF_WRITERS:
+                        writers.add(alias.asname or alias.name)
+                    elif alias.name in ("PROF", "Profiler"):
+                        profs.add(alias.asname or alias.name)
+            else:
+                for alias in node.names:
+                    if alias.name == "conprof":
+                        modules.add(alias.asname or alias.name)
+    return modules, writers, profs
+
+
+def _is_conprof_target(e: ast.expr, module_aliases: set,
+                       prof_aliases: set) -> bool:
+    """``conprof`` (under any alias) / ``obs.conprof`` /
+    ``conprof.PROF`` / a ``PROF`` imported FROM conprof."""
+    if isinstance(e, ast.Name):
+        return e.id in module_aliases or e.id in prof_aliases
+    if isinstance(e, ast.Attribute):
+        if e.attr == "conprof":
+            return True
+        return e.attr == "PROF" \
+            and _is_conprof_target(e.value, module_aliases, prof_aliases)
+    return False
+
+
+def _lint_conprof_writes(sf: SourceFile) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    module_aliases, writer_aliases, prof_aliases = \
+        _conprof_import_aliases(sf)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        # (a) a statement-CPU key laundered through an accumulator sink
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if name in _DEVTIME_SINKS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and arg.value in CPU_KEYS:
+                diags.append(Diagnostic(
+                    "OB406",
+                    f"`{name}({arg.value!r}, ...)` writes a statement-"
+                    "CPU counter outside obs/conprof.py — only the "
+                    "profiler's sampler tick may claim cpu_s/"
+                    "cpu_samples (sample-estimated, wall-capped)",
+                    sf.path, node.lineno))
+                continue
+        # (b) a mutating call on the profiler store itself
+        hit = (isinstance(f, ast.Attribute)
+               and f.attr in _CONPROF_WRITERS
+               and _is_conprof_target(f.value, module_aliases,
+                                      prof_aliases)) \
+            or (isinstance(f, ast.Name) and f.id in writer_aliases)
+        if hit:
+            diags.append(Diagnostic(
+                "OB406",
+                "continuous-profiler store write outside "
+                "obs/conprof.py — window rotation/eviction accounting "
+                "belongs to the sampler",
+                sf.path, node.lineno))
+    return diags
+
+
 # ---- OB404: metric-name registry discipline -------------------------------
 
 #: matches the exported metric naming convention; deliberately excludes
@@ -289,6 +397,8 @@ def lint_obs_discipline(sf: SourceFile) -> List[Diagnostic]:
         diags.extend(_lint_metric_names(sf))
     if base not in DEVTIME_OWNING_MODULES:
         diags.extend(_lint_devtime_writes(sf))
+    if base != CONPROF_OWNING_MODULE:
+        diags.extend(_lint_conprof_writes(sf))
     if base in OWNING_MODULES:
         return sf.filter(diags)
     for node in ast.walk(sf.tree):

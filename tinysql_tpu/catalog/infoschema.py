@@ -82,7 +82,8 @@ class InfoSchema:
                     t2.rollback()
             nw = min(8, len(dbs))
             tables = {}
-            with ThreadPoolExecutor(max_workers=nw) as ex:
+            with ThreadPoolExecutor(max_workers=nw,
+                                    thread_name_prefix="kv-schema") as ex:
                 for part in ex.map(fetch,
                                    [dbs[i::nw] for i in range(nw)]):
                     tables.update(part)

@@ -18,7 +18,10 @@ filter like any other source:
   (obs/inspect.py), evaluated over the ring at scan time;
 - ``compiled_programs``: the per-program catalog (ops/progcache.py) —
   dispatch counts, compile walls, measured device time, cost-analysis
-  flops/bytes, joinable with ``statements_summary`` on plan_digest.
+  flops/bytes, joinable with ``statements_summary`` on plan_digest;
+- ``continuous_profiling``: the continuous host profiler's windowed
+  folded stacks (obs/conprof.py) — per (window, thread role, stack)
+  sample counts and estimated cpu_ms.
 
 Rows are produced from the live InfoSchema / obs stores at query time.
 The catalog lists ITSELF: ``information_schema`` appears in SCHEMATA,
@@ -64,6 +67,11 @@ def _programs_cols():
     return list(CATALOG_COLUMNS)
 
 
+def _conprof_cols():
+    from ..obs.conprof import COLUMNS
+    return list(COLUMNS)
+
+
 # table name -> [(column name, kind)];  statements_summary's layout is
 # owned by obs/stmtsummary.COLUMNS (one definition for store + catalog)
 _TABLES = {
@@ -91,6 +99,7 @@ _TABLES = {
     "metrics_summary": _metrics_summary_cols,
     "inspection_result": _inspection_cols,
     "compiled_programs": _programs_cols,
+    "continuous_profiling": _conprof_cols,
     "processlist": [("id", "int"),
                     ("user", "str"),
                     ("db", "str"),
@@ -156,6 +165,12 @@ def memtable_rows(infoschema, table: str) -> List[list]:
         # — joinable against statements_summary on plan_digest
         from ..ops import progcache
         return progcache.catalog_rows()
+    if t == "continuous_profiling":
+        # the continuous host profiler's windowed folded stacks
+        # (obs/conprof.py): role, stack, samples, estimated cpu_ms —
+        # the SQL face of /debug/conprof
+        from ..obs import conprof
+        return conprof.rows()
     out: List[list] = []
     if t == "schemata":
         out.append(["def", DB_NAME])

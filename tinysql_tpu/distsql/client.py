@@ -111,7 +111,11 @@ class CopClient:
         # bounded in-flight window: at most `concurrency` region results
         # buffered (the reference copIterator's respChan backpressure)
         cancel = threading.Event()
-        pool = cf.ThreadPoolExecutor(max_workers=min(concurrency, len(tasks)))
+        # "distsql-cop" is the conprof role vocabulary
+        # (obs/conprof.ROLE_PREFIXES): cop workers classify as role
+        # `distsql` in continuous_profiling / race-stress / py-spy
+        pool = cf.ThreadPoolExecutor(max_workers=min(concurrency, len(tasks)),
+                                     thread_name_prefix="distsql-cop")
 
         def submit(task):
             region, s, e = task

@@ -165,9 +165,13 @@ class BlockPipeline:
             # stage spans/counters land on the query (and operator) that
             # built the pipeline (obs/context.py)
             cctx = contextvars.copy_context()
+            # "devpipe-stage" is the conprof role vocabulary
+            # (obs/conprof.ROLE_PREFIXES): the producer classifies as
+            # role `devpipe` in continuous_profiling / race-stress /
+            # py-spy output
             self._thread = threading.Thread(
                 target=cctx.run, args=(self._run,),
-                name="tinysql-pipe-stage", daemon=True)
+                name="devpipe-stage", daemon=True)
             self._thread.start()
 
     def _stage_timed(self, item):

@@ -642,7 +642,9 @@ class IndexLookUpExec(Executor):
         else:
             if self._pool is None:
                 import concurrent.futures as cf
-                self._pool = cf.ThreadPoolExecutor(max_workers=workers)
+                self._pool = cf.ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix="kv-lookup")
             step = (len(handles) + workers - 1) // workers
             spans = [(i, min(i + step, len(handles)))
                      for i in range(0, len(handles), step)]

@@ -222,7 +222,8 @@ class TwoPhaseCommitter:
         if len(batches) == 1:
             action(batches[0])
             return
-        with ThreadPoolExecutor(max_workers=COMMITTER_CONCURRENCY) as ex:
+        with ThreadPoolExecutor(max_workers=COMMITTER_CONCURRENCY,
+                                thread_name_prefix="kv-commit") as ex:
             futures = [ex.submit(action, b) for b in batches]
             for f in futures:
                 f.result()

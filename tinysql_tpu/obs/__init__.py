@@ -44,6 +44,17 @@ Three cooperating pieces:
   and the metric evidence window.  The serving path attributes each
   statement's queue/batch wait (server/pool.py measurement → spans,
   summary columns, slow-log fields, the ``queue`` phase histogram).
+- **host-CPU truth** (`conprof.py`, ISSUE 13): an always-on
+  continuous stack-sampling profiler — a background sampler walks
+  ``sys._current_frames()`` at ``tidb_conprof_rate`` Hz, classifies
+  threads by serving role (the stable thread-name vocabulary),
+  folds stacks into stmtsummary-style rotating windows
+  (``information_schema.continuous_profiling``, ``/debug/conprof``
+  collapsed text for flamegraph.pl/speedscope), and attributes
+  samples to the statement running on the sampled thread
+  (``statements_summary`` ``sum_cpu_ms``/``cpu_samples``, invariant
+  cpu <= exec wall; qlint OB406 guards the write path).  ``TRACE
+  <stmt>`` renders the span tracer's tree as rows over SQL.
 - **device-time truth** (ops/profiler.py + ops/progcache.py, ISSUE
   11): the default timings are host walls around ASYNC enqueues; the
   opt-in sampling profiler (``tidb_device_profile_rate``) closes

@@ -46,6 +46,13 @@ tests/test_tsring.py):
   histogram shows > 1% of windowed measurements over the armed
   ``tidb_slo_p99_ms`` — the p99 objective's error budget is burning.
   Fed by the ``slo`` ring source (:func:`slo_sample`).
+- **cpu-saturation** (ISSUE 13): one thread role dominates the busy
+  host-CPU samples (obs/conprof.py) while the admission queue is
+  non-empty — the serving tier's latency is host CPU in that role, and
+  /debug/conprof has the dominant stacks;
+- **profiler-overhead** (ISSUE 13): the continuous profiler's own
+  sampling cost ran past its budget share of one core — the rule
+  reports it while the sampler's backoff divisor absorbs it.
 
 Thresholds are module-level constants, deliberately conservative: an
 inspection finding is a diagnosis, so false positives cost trust.
@@ -99,6 +106,17 @@ RECOMPILE_MISSES_PER_EXEC = 1.5
 #: breach fraction that burns a p99 objective's error budget (1%)
 SLO_MIN_MEASUREMENTS = 20
 SLO_BURN_FRAC = 0.01
+#: cpu-saturation: minimum windowed BUSY profiler samples before the
+#: role-share ratio may judge, and the share at which one role reads
+#: window-dominant (only judged while the admission queue was non-empty
+#: — a dominant role with an empty queue is just the workload's shape)
+CPU_SAT_MIN_BUSY_SAMPLES = 50
+CPU_SAT_DOMINANT_SHARE = 0.6
+CPU_SAT_CRITICAL_SHARE = 0.85
+#: profiler-overhead: the sampler's self-cost share of one core beyond
+#: which the finding fires (obs/conprof.py backs its rate off at the
+#: same budget — the rule reports what the backoff is absorbing)
+PROFILER_OVERHEAD_BUDGET = 0.03
 
 
 class Finding:
@@ -480,6 +498,59 @@ def _rule_recompile_churn(ctx: InspectionContext) -> List[Finding]:
             "covering this family — constant variants are compiling "
             "instead of hitting", "tinysql_progcache_misses_total"))
     return out
+
+
+@rule("cpu-saturation")
+def _rule_cpu_saturation(ctx: InspectionContext) -> List[Finding]:
+    # judged only while the admission queue was non-empty in the
+    # window: host CPU concentrating in one role while statements WAIT
+    # is the serving tier's bottleneck signature (ROADMAP items 2/3)
+    queued = ctx.max_value("tinysql_pool_queued")
+    if queued <= 0:
+        return []
+    from .conprof import ROLES, role_metric
+    busy = {role: ctx.delta(role_metric(role)) for role in ROLES}
+    total = sum(busy.values())
+    if total < CPU_SAT_MIN_BUSY_SAMPLES:
+        return []
+    top_role = max(busy, key=lambda r: busy[r])
+    share = busy[top_role] / total
+    if share < CPU_SAT_DOMINANT_SHARE:
+        return []
+    sev = "critical" if share >= CPU_SAT_CRITICAL_SHARE else "warning"
+    return [ctx.evidence(
+        "cpu-saturation", top_role, sev,
+        f"{share:.0%} of {total:.0f} busy host-CPU samples landed on "
+        f"{top_role} threads while the admission queue held up to "
+        f"{queued:.0f} statement(s): the host tier is CPU-bound in one "
+        "role — check /debug/conprof for the dominant stacks before "
+        "raising pool size (more workers on a saturated role only adds "
+        "queue wait)", role_metric(top_role))]
+
+
+@rule("profiler-overhead")
+def _rule_profiler_overhead(ctx: InspectionContext) -> List[Finding]:
+    metric = "tinysql_conprof_self_seconds_total"
+    pts = ctx.series(metric)
+    if len(pts) < 2:
+        return []
+    span = pts[-1][0] - pts[0][0]
+    self_d = pts[-1][1] - pts[0][1]
+    if span <= 0 or self_d <= 0:
+        return []
+    frac = self_d / span
+    if frac <= PROFILER_OVERHEAD_BUDGET:
+        return []
+    backoff = ctx.last("tinysql_conprof_backoff") or 1
+    return [ctx.evidence(
+        "profiler-overhead", "conprof", "warning",
+        f"the continuous profiler spent {frac:.1%} of one core on its "
+        f"own sampling within the window (budget "
+        f"{PROFILER_OVERHEAD_BUDGET:.0%}); the sampler is backing off "
+        f"(current divisor {backoff:.0f} — effective rate = "
+        "tidb_conprof_rate / divisor).  Lower tidb_conprof_rate or "
+        "tidb_conprof_max_stacks if the backoff keeps climbing",
+        metric)]
 
 
 @rule("slo-burn")

@@ -186,6 +186,32 @@ METRICS: Dict[str, Tuple[str, str]] = {
                       "(ops/profiler.py, tidb_device_profile_rate)"),
     "tinysql_trace_ring_entries":
         ("gauge", "Query traces buffered for /debug/trace"),
+    # continuous host profiler (obs/conprof.py)
+    "tinysql_conprof_samples_total":
+        ("counter", "Thread-stack samples folded by the continuous "
+                    "host profiler"),
+    "tinysql_conprof_idle_samples_total":
+        ("counter", "Samples whose leaf frame was a blocking primitive "
+                    "(parked threads; excluded from busy-CPU shares)"),
+    "tinysql_conprof_attributed_samples_total":
+        ("counter", "Samples attributed to a running statement "
+                    "(statements_summary sum_cpu_ms/cpu_samples)"),
+    "tinysql_conprof_ticks_total":
+        ("counter", "Continuous-profiler sampling ticks"),
+    "tinysql_conprof_self_seconds_total":
+        ("counter", "Wall seconds the profiler spent walking/folding "
+                    "frames (its own overhead; the profiler-overhead "
+                    "rule's evidence)"),
+    "tinysql_conprof_evicted_total":
+        ("counter", "Folded stacks evicted into the (evicted) tombstone "
+                    "by the per-window tidb_conprof_max_stacks cap"),
+    "tinysql_conprof_backoff":
+        ("gauge", "Live overhead-backoff divisor (effective rate = "
+                  "tidb_conprof_rate / backoff; 1 = at full rate)"),
+    "tinysql_conprof_stacks":
+        ("gauge", "Distinct folded stacks in the current window"),
+    "tinysql_conprof_windows":
+        ("gauge", "Retained profile windows (current + rotated)"),
     # time-series sampler self-accounting (obs/tsring.py)
     "tinysql_metrics_samples_total":
         ("counter", "Time-series ring samples taken"),
@@ -216,6 +242,15 @@ for _k in ("cycles", "families_warmed", "bucket_programs", "errors",
            "skipped_cooldown", "skipped_budget", "skipped_satisfied"):
     METRICS[f"tinysql_prewarm_worker_{_k}_total"] = (
         "counter", f"Auto-prewarm worker {_k.replace('_', ' ')}")
+# per-role busy-sample counters (obs/conprof.py): the role catalogue is
+# closed and owned by conprof (one definition shared with the ring
+# source and the cpu-saturation rule), so every role's counter is a
+# registered name
+from .conprof import ROLES as _CONPROF_ROLES  # noqa: E402  (jax-free)
+from .conprof import role_metric as _conprof_role_metric  # noqa: E402
+for _r in _CONPROF_ROLES:
+    METRICS[_conprof_role_metric(_r)] = (
+        "counter", f"Busy (non-idle) stack samples on {_r} threads")
 
 
 def registered(name: str) -> bool:
@@ -462,6 +497,33 @@ def render_prometheus() -> str:
         emit("tinysql_batch_dispatch_seconds_total",
              METRICS["tinysql_batch_dispatch_seconds_total"][1],
              "counter", [((), bst.get("dispatch_s_sum", 0.0))])
+
+    # continuous host profiler (obs/conprof.py): samples, attribution,
+    # self-cost, and the per-role busy split — the host-CPU truth feed
+    try:
+        from . import conprof
+        cp = conprof.stats_snapshot()
+    except Exception:
+        cp = {}
+    if cp.get("ticks"):
+        for key, name in (("samples", "tinysql_conprof_samples_total"),
+                          ("idle_samples",
+                           "tinysql_conprof_idle_samples_total"),
+                          ("attributed",
+                           "tinysql_conprof_attributed_samples_total"),
+                          ("ticks", "tinysql_conprof_ticks_total"),
+                          ("self_s",
+                           "tinysql_conprof_self_seconds_total"),
+                          ("evicted", "tinysql_conprof_evicted_total")):
+            emit(name, METRICS[name][1], "counter", [((), cp.get(key, 0))])
+        for key, name in (("backoff", "tinysql_conprof_backoff"),
+                          ("stacks", "tinysql_conprof_stacks"),
+                          ("windows", "tinysql_conprof_windows")):
+            emit(name, METRICS[name][1], "gauge", [((), cp.get(key, 0))])
+        for role, n in sorted(cp.get("role_busy", {}).items()):
+            if n:
+                name = conprof.role_metric(role)
+                emit(name, METRICS[name][1], "counter", [((), n)])
 
     # time-series sampler self-accounting (obs/tsring.py): the cost of
     # observing is itself observable (bench obs_overhead_frac reads it)

@@ -234,6 +234,11 @@ class StmtRecord:
             round(float(d.get("device_s", 0.0)) * 1e3, 3),
             int(d.get("profiled_dispatches", 0)),
             round(float(d.get("compile_s", 0.0)) * 1e3, 3),
+            # host-CPU truth (ISSUE 13): sample-estimated on-thread ms
+            # attributed by the continuous profiler (obs/conprof.py; 0
+            # with tidb_conprof_rate=0 or no sampler running)
+            round(float(d.get("cpu_s", 0.0)) * 1e3, 3),
+            int(d.get("cpu_samples", 0)),
             int(d.get("pipe_blocks", 0)), self._overlap_frac(),
             int(d.get("coalesced", 0)),
             int(d.get("spill_bytes", 0)), self.max_spill_bytes,
@@ -274,6 +279,7 @@ COLUMNS = [
     ("compile_cache_hits", "int"), ("compile_cache_misses", "int"),
     ("sum_device_ms", "real"), ("profiled_dispatches", "int"),
     ("sum_compile_ms", "real"),
+    ("sum_cpu_ms", "real"), ("cpu_samples", "int"),
     ("pipe_blocks", "int"), ("pipe_overlap_frac", "real"),
     ("coalesced", "int"),
     ("sum_spill_bytes", "int"), ("max_spill_bytes", "int"),

@@ -32,7 +32,7 @@ _ids = itertools.count(1)
 
 class Span:
     __slots__ = ("sid", "name", "cat", "start_s", "dur_s", "tid",
-                 "parent", "args")
+                 "tname", "parent", "args")
 
     def __init__(self, name: str, cat: str, parent: Optional[int],
                  args: Optional[dict] = None):
@@ -42,6 +42,10 @@ class Span:
         self.start_s = time.perf_counter()
         self.dur_s = 0.0
         self.tid = threading.get_ident()
+        # the recording thread's NAME rides along so TRACE <stmt> and
+        # offline tooling can classify the span by serving role
+        # (obs/conprof.classify) without a live thread table
+        self.tname = threading.current_thread().name
         self.parent = parent
         self.args = args or {}
 
@@ -49,8 +53,8 @@ class Span:
         return {"id": self.sid, "name": self.name, "cat": self.cat,
                 "ts_us": round(self.start_s * 1e6, 1),
                 "dur_us": round(self.dur_s * 1e6, 1),
-                "tid": self.tid, "parent": self.parent,
-                "args": self.args}
+                "tid": self.tid, "thread": self.tname,
+                "parent": self.parent, "args": self.args}
 
 
 class Tracer:
@@ -125,6 +129,54 @@ def spans_to_events(spans: List[dict], pid: int = 0,
                          parent=sp.get("parent")),
         })
     return events
+
+
+# ---- TRACE <stmt> rendering -----------------------------------------------
+
+#: TRACE <stmt> result columns (session/_exec_trace)
+TRACE_COLUMNS = ("span", "parent", "start_offset_us", "duration_us",
+                 "thread_role")
+
+
+def trace_rows(spans: List[dict]) -> List[list]:
+    """Render recorded span dicts as the ``TRACE <stmt>`` resultset:
+    depth-indented span name (tree order: children by start time under
+    their parent), parent span name, start offset relative to the
+    earliest span (µs), duration (µs), and the recording thread's
+    serving role (obs/conprof.classify over the captured thread name —
+    a devpipe stage span reads ``devpipe`` even though it parents into
+    the statement's chain)."""
+    from .conprof import classify
+    if not spans:
+        return []
+    by_id = {sp["id"]: sp for sp in spans}
+    children: Dict[Optional[int], List[dict]] = {}
+    for sp in spans:
+        parent = sp.get("parent")
+        if parent is not None and parent not in by_id:
+            parent = None  # parent never ended (e.g. the outer execute)
+        children.setdefault(parent, []).append(sp)
+    for sibs in children.values():
+        sibs.sort(key=lambda s: s.get("ts_us", 0.0))
+    t0 = min(sp.get("ts_us", 0.0) for sp in spans)
+    out: List[list] = []
+
+    def render(sp: dict, depth: int) -> None:
+        parent = by_id.get(sp.get("parent"))
+        pname = ""
+        if parent is not None:
+            pname = str(parent.get("name", ""))
+        out.append(["  " * depth + str(sp.get("name", "?")),
+                    pname,
+                    round(sp.get("ts_us", 0.0) - t0, 1),
+                    round(sp.get("dur_us", 0.0), 1),
+                    classify(str(sp.get("thread", "")))])
+        for child in children.get(sp["id"], []):
+            render(child, depth + 1)
+
+    for root in children.get(None, []):
+        render(root, 0)
+    return out
 
 
 # ---- process-global ring of recent query traces (/debug/trace) ----------

@@ -532,6 +532,27 @@ def _src_slo() -> Dict[str, float]:
     return oinspect.slo_sample()
 
 
+def _src_conprof() -> Dict[str, float]:
+    # continuous host profiler (obs/conprof.py): the cpu-saturation and
+    # profiler-overhead inspection rules judge these windowed deltas
+    from . import conprof
+    s = conprof.stats_snapshot()
+    out = {"tinysql_conprof_samples_total": s.get("samples", 0),
+           "tinysql_conprof_idle_samples_total":
+               s.get("idle_samples", 0),
+           "tinysql_conprof_attributed_samples_total":
+               s.get("attributed", 0),
+           "tinysql_conprof_ticks_total": s.get("ticks", 0),
+           "tinysql_conprof_self_seconds_total": s.get("self_s", 0.0),
+           "tinysql_conprof_evicted_total": s.get("evicted", 0),
+           "tinysql_conprof_backoff": s.get("backoff", 1),
+           "tinysql_conprof_stacks": s.get("stacks", 0),
+           "tinysql_conprof_windows": s.get("windows", 0)}
+    for role, n in s.get("role_busy", {}).items():
+        out[conprof.role_metric(role)] = n
+    return out
+
+
 def _src_tsring() -> Dict[str, float]:
     s = stats_snapshot()
     return {"tinysql_metrics_samples_total": s.get("samples", 0),
@@ -549,5 +570,6 @@ for _name, _fn in (("queries", _src_queries), ("kernels", _src_kernels),
                    ("spill", _src_spill), ("degrade", _src_degrade),
                    ("failpoints", _src_failpoints),
                    ("prewarm", _src_prewarm), ("slo", _src_slo),
+                   ("conprof", _src_conprof),
                    ("tsring", _src_tsring)):
     register_source(_name, _fn)

@@ -368,6 +368,15 @@ class ExplainStmt(StmtNode):
 
 
 @dataclass
+class TraceStmt(StmtNode):
+    # TRACE [FORMAT = 'row'] <stmt>: execute the statement and return
+    # its recorded span tree as rows (obs/trace.py trace_rows) — span,
+    # parent, start offset, duration, thread role
+    stmt: StmtNode = None
+    format: str = "row"
+
+
+@dataclass
 class AnalyzeTableStmt(StmtNode):
     tables: List[TableName] = field(default_factory=list)
 

@@ -154,6 +154,8 @@ class Parser:
             return RollbackStmt()
         if kw in ("explain", "desc", "describe"):
             return self._explain_stmt()
+        if kw == "trace":
+            return self._trace_stmt()
         if kw == "analyze":
             self._advance()
             self._expect_kw("table")
@@ -760,6 +762,25 @@ class Parser:
             self._expect_kw("connection")
             return ExplainStmt(None, for_conn=self._uint_literal())
         return ExplainStmt(self._statement(), analyze=analyze)
+
+    def _trace_stmt(self) -> TraceStmt:
+        """TRACE [FORMAT = 'row'] <statement> (reference: TiDB's
+        executor/trace.go — execute the statement and return its span
+        tree as rows).  Only the 'row' format is supported."""
+        self._advance()
+        fmt = "row"
+        if self._accept_kw("format"):
+            self._expect_op("=")
+            t = self._cur()
+            if t.kind != T_STRING:
+                raise ParseError("TRACE FORMAT expects a string literal",
+                                 t.pos)
+            fmt = str(t.value).lower()
+            self._advance()
+            if fmt != "row":
+                raise ParseError(f"unsupported TRACE format {fmt!r}",
+                                 t.pos)
+        return TraceStmt(self._statement(), format=fmt)
 
     def _admin_stmt(self) -> AdminStmt:
         self._advance()

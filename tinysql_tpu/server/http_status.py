@@ -77,6 +77,19 @@ def _make_handler(server_ref):
                 self._send(200, json.dumps(
                     RING.summary_rows(), default=str).encode())
                 return
+            if parsed.path == "/debug/conprof":
+                # collapsed-stack text (flamegraph.pl / speedscope
+                # ingest it directly); ?window=N bounds to the last N
+                # seconds of retained windows (absent/0 = everything)
+                from ..obs.conprof import collapsed
+                qs = parse_qs(parsed.query)
+                try:
+                    window = float(qs.get("window", ["0"])[0]) or None
+                except ValueError:
+                    window = None
+                self._send(200, collapsed(window_s=window).encode(),
+                           "text/plain; charset=utf-8")
+                return
             if parsed.path == "/debug/programs":
                 from ..ops.progcache import catalog_snapshot
                 self._send(200, json.dumps(catalog_snapshot(),
@@ -120,6 +133,7 @@ def _make_handler(server_ref):
                            b'<a href="/debug/slowlog">slowlog</a> '
                            b'<a href="/debug/stmtsummary">stmtsummary</a> '
                            b'<a href="/debug/programs">programs</a> '
+                           b'<a href="/debug/conprof">conprof</a> '
                            b'<a href="/debug/prewarm">prewarm</a> '
                            b'<a href="/debug/inspection">inspection</a> '
                            b'<a href="/debug/metrics/summary">'

@@ -362,6 +362,14 @@ class Server:
         # inspection engine, paced by the GLOBAL tidb_metrics_interval
         from ..obs.tsring import Sampler
         self.metrics_sampler = Sampler(storage)
+        # continuous host profiler (obs/conprof.py): a background
+        # stack sampler walking sys._current_frames() at the GLOBAL
+        # tidb_conprof_rate (Hz, 0 = off), feeding
+        # information_schema.continuous_profiling, /debug/conprof,
+        # statements_summary CPU attribution, and the cpu-saturation /
+        # profiler-overhead inspection rules
+        from ..obs.conprof import ConprofSampler
+        self.conprof_sampler = ConprofSampler(storage)
         self.host = host
         self.port = port
         self.sock: Optional[socket.socket] = None
@@ -383,6 +391,7 @@ class Server:
         t.start()
         self.prewarm.start()
         self.metrics_sampler.start()
+        self.conprof_sampler.start()
         # device-time truth knobs are process-global module state applied
         # at SET time (session/session.py) — a fresh server re-applies
         # whatever GLOBAL scope the storage carries
@@ -444,6 +453,7 @@ class Server:
         self.pool.close()
         self.prewarm.close()
         self.metrics_sampler.close()
+        self.conprof_sampler.close()
         self.domain.close()
         if self.sock is not None:
             try:

@@ -9,6 +9,7 @@ sysvar get/set :464-523), executor/compiler.go, executor/adapter.go
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -163,6 +164,20 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # p99 latency objective in MILLISECONDS the slo-burn inspection rule
     # judges the exec-phase histogram against (0 = no SLO armed)
     "tidb_slo_p99_ms": 0,
+    # ---- continuous host profiler (obs/conprof.py; GLOBAL scope — the
+    # server's background stack sampler re-reads all four every tick) --
+    # sampling rate in Hz (0 = off; the sampler's own overhead backoff
+    # may stretch the effective period under load)
+    "tidb_conprof_rate": 10,
+    # seconds per aggregation window of
+    # information_schema.continuous_profiling (stmtsummary-style
+    # rotation into bounded history)
+    "tidb_conprof_window": 60,
+    # rotated windows retained
+    "tidb_conprof_history": 15,
+    # max distinct folded stacks per window; beyond it the
+    # least-recently-seen stack folds into the '(evicted)' tombstone
+    "tidb_conprof_max_stacks": 512,
 }
 
 
@@ -236,6 +251,12 @@ class Session:
         # always-installed per-statement MemTracker (quota 0 = track only)
         self.stmt_running = False
         self._stmt_mem = None
+        # the thread ident the current statement EXECUTES on (pool
+        # worker / conn thread / embedded caller) — the continuous
+        # profiler's statement-attribution key (obs/conprof.py): a
+        # stack sample landing on this thread while stmt_running is
+        # the statement's on-thread time
+        self.stmt_thread_ident = 0
         # statement-pool admission state (server/pool.py): "queued" while
         # waiting for a worker, with the pending SQL for processlist
         self.stmt_state = ""
@@ -603,6 +624,7 @@ class Session:
         self._stmt_mem = memory.MemTracker(quota if quota > 0 else 0,
                                            spill_watermark=wm)
         mtok = memory.activate(self._stmt_mem)
+        self.stmt_thread_ident = threading.get_ident()
         self.stmt_running = True
         try:
             return self._execute_stmt_guarded(stmt)
@@ -691,6 +713,8 @@ class Session:
             return self._exec_show(stmt)
         if isinstance(stmt, ast.ExplainStmt):
             return self._exec_explain(stmt)
+        if isinstance(stmt, ast.TraceStmt):
+            return self._exec_trace(stmt)
         if isinstance(stmt, ast.AnalyzeTableStmt):
             return self._exec_analyze(stmt)
         if isinstance(stmt, ast.AdminStmt):
@@ -1050,7 +1074,11 @@ class Session:
                      "tidb_metrics_retention",
                      "tidb_spill_partitions",
                      "tidb_spill_max_depth",
-                     "tidb_slo_p99_ms")
+                     "tidb_slo_p99_ms",
+                     "tidb_conprof_rate",
+                     "tidb_conprof_window",
+                     "tidb_conprof_history",
+                     "tidb_conprof_max_stacks")
 
     @staticmethod
     def _validate_uint_sysvar(name: str, v: Datum) -> int:
@@ -1271,6 +1299,33 @@ class Session:
         rows = explain_text(phys)
         self.last_plan_rows = rows
         return ResultSet(["id", "estRows", "task", "operator info"], rows)
+
+    # ---- TRACE (reference: executor/trace.go) ---------------------------
+    def _exec_trace(self, stmt: ast.TraceStmt) -> ResultSet:
+        """TRACE <stmt>: execute the statement FOR REAL inside the
+        current observability scope (the span tracer obs/trace.py was
+        already recording everything a render needs), then return the
+        span tree as rows — span (depth-indented), parent, start offset
+        + duration in µs, and the recording thread's serving role.  The
+        traced statement's own resultset is discarded (the trace IS the
+        result, TiDB semantics); its side effects are not."""
+        from ..obs import context as obs_context
+        from ..obs.trace import TRACE_COLUMNS, trace_rows
+        if stmt.stmt is None or isinstance(stmt.stmt, ast.TraceStmt):
+            raise SessionError("TRACE expects a statement")
+        qobs = obs_context.current()
+        before = len(qobs.tracer.spans()) if qobs is not None else 0
+        # the traced statement gets its own execute span (the outer
+        # TRACE's wrapper span is still open at render time, so this is
+        # what roots the rendered tree)
+        with obs_context.span("execute", kind=type(stmt.stmt).__name__):
+            self._dispatch(stmt.stmt)
+        if qobs is None:
+            return ResultSet(list(TRACE_COLUMNS), [])
+        # only the spans the traced statement recorded: a batch's
+        # earlier statements (or the pool's wait spans) stay out
+        return ResultSet(list(TRACE_COLUMNS),
+                         trace_rows(qobs.tracer.spans()[before:]))
 
     @property
     def last_trace(self):

@@ -148,6 +148,25 @@ def sessions():
     return out
 
 
+def executing_threads() -> Dict[int, object]:
+    """``thread ident -> session`` for sessions whose statement is
+    currently EXECUTING on that thread (``session.stmt_thread_ident``,
+    stamped when the statement is armed) — the continuous profiler's
+    attribution feed (obs/conprof.py): a stack sample landing on one of
+    these threads is on-thread time of that session's live statement.
+    Queued statements (no worker yet) and helper threads a statement
+    spawns (devpipe producer, distsql workers) are deliberately absent.
+    """
+    out: Dict[int, object] = {}
+    for _cid, sess in sessions():
+        if not getattr(sess, "stmt_running", False):
+            continue
+        tid = getattr(sess, "stmt_thread_ident", 0)
+        if tid:
+            out[tid] = sess
+    return out
+
+
 def kill(conn_id: int, query_only: bool = True) -> bool:
     """KILL [QUERY] <conn_id>.  Returns False when the id is unknown.
     ``query_only=False`` (plain KILL) also marks the session killed so

@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Continuous-profiler smoke (the CI ``conprof-smoke`` job).
+
+The ISSUE 13 host-CPU-truth loop end to end against a REAL server
+lifecycle:
+
+1. start a Server — its background conprof sampler (obs/conprof.py)
+   must tick at the GLOBAL ``tidb_conprof_rate`` and fold non-empty
+   stacks while wire clients drive load;
+2. ``/debug/conprof`` returns collapsed-stack text that the shared
+   parser (and flamegraph.pl) ingests, covering >= 3 thread roles;
+3. statement CPU attribution reaches SQL: the hot digest family shows
+   ``sum_cpu_ms > 0`` in ``information_schema.statements_summary``
+   with the ``cpu_ms <= exec wall`` invariant intact, joined on its
+   digest;
+4. ``information_schema.continuous_profiling`` serves the folded
+   stacks with roles from the closed vocabulary;
+5. an induced ``cpu-saturation`` finding: heavy statements saturate a
+   2-worker pool (queue non-empty) while pool workers dominate the
+   busy samples — ``information_schema.inspection_result`` must report
+   the rule.
+
+Exit 0 on success; prints one line per check.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from urllib.request import urlopen
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+sys.path.insert(0, os.path.join(REPO_ROOT, "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    print(f"[conprof-smoke] {'ok' if ok else 'FAIL'}: {name}"
+          f"{' — ' + detail if detail else ''}")
+    if not ok:
+        sys.exit(1)
+
+
+def main() -> int:
+    from test_server import MiniClient
+    from tinysql_tpu.kv import new_mock_storage
+    from tinysql_tpu.obs import conprof, stmtsummary, tsring
+    from tinysql_tpu.server.http_status import StatusServer
+    from tinysql_tpu.server.server import Server
+    from tinysql_tpu.session.session import Session
+
+    storage = new_mock_storage()
+    boot = Session(storage)
+    boot.execute("set global tidb_slow_log_threshold = 60000")
+    boot.execute("set global tidb_tpu_min_rows = 64")
+    boot.execute("set global tidb_metrics_interval = 1")
+    boot.execute("set global tidb_conprof_rate = 200")
+    boot.execute("set global tidb_stmt_pool_size = 1")
+    boot.execute("set global tidb_auto_prewarm = 0")
+    boot.execute("create database sm")
+    boot.execute("use sm")
+    boot.execute("create table t (a int primary key, b int, c int)")
+    for lo in range(0, 30_000, 10_000):
+        boot.execute("insert into t values " + ", ".join(
+            f"({i}, {i % 97}, {i % 13})"
+            for i in range(lo, lo + 10_000)))
+    stmtsummary.STORE.reset()
+    tsring.RING.reset()
+    conprof.reset()
+
+    heavy = ("select b, count(*), sum(c), max(a) from t "
+             "where b < 90 group by b order by b")
+
+    srv = Server(storage, port=0)
+    srv.start()
+    status = StatusServer(srv)
+    sport = status.start()
+    try:
+        # warm the program outside the measured load
+        warm = MiniClient(srv.port, db="sm")
+        warm.query(heavy)
+        tsring.RING.sample_once()  # ring baseline for the rule deltas
+
+        # 1. drive load: 5 clients x heavy aggregates through the
+        # 1-worker pool — the queue must go non-empty while the worker
+        # burns CPU (pool-worker dominates the busy samples)
+        errors = []
+
+        def client(cid: int) -> None:
+            try:
+                c = MiniClient(srv.port, db="sm")
+                for i in range(4):
+                    c.query(heavy.replace("< 90", f"< {85 + cid % 5}"))
+                c.close()
+            except Exception as e:
+                errors.append(f"c{cid}: {e!r}")
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True)
+                   for i in range(5)]
+        for t in threads:
+            t.start()
+        # mid-load ring samples bracketing a non-empty admission queue
+        from tinysql_tpu.server.pool import gauges
+        queued_seen = 0
+        last_sample = 0.0
+        deadline = time.monotonic() + 60
+        # parked via Event.wait, NOT time.sleep: a raw time.sleep is a
+        # C builtin, so the sampler would see THIS function as the leaf
+        # frame and read the smoke's own wait loop as busy "main" CPU —
+        # skewing the dominance ratio the induced finding asserts
+        # (threading.py wrappers classify idle; the engine's own
+        # threads all park the same way)
+        pause = threading.Event()
+        while any(t.is_alive() for t in threads) \
+                and time.monotonic() < deadline:
+            # throttled: bracket the non-empty queue in ring samples
+            # without turning the smoke's own main thread into a busy
+            # role (it would skew the dominance ratio it then asserts)
+            if gauges()["queued"] > 0 \
+                    and time.monotonic() - last_sample > 0.5:
+                queued_seen += 1
+                last_sample = time.monotonic()
+                tsring.RING.sample_once()
+            pause.wait(0.2)
+        for t in threads:
+            t.join(60)
+        tsring.RING.sample_once()
+        check("wire load completed with zero errors", not errors,
+              "; ".join(errors[:3]))
+        check("admission queue went non-empty under load",
+              queued_seen > 0, f"{queued_seen} sampled instants")
+
+        snap = conprof.stats_snapshot()
+        check("conprof sampler ticked under serve load",
+              snap["ticks"] > 0 and snap["samples"] > 0,
+              f"ticks={snap['ticks']} samples={snap['samples']}")
+
+        # 2. /debug/conprof: collapsed text, shared-parser round trip,
+        # >= 3 distinct thread roles
+        body = urlopen(f"http://127.0.0.1:{sport}/debug/conprof",
+                       timeout=10).read().decode()
+        parsed = conprof.parse_collapsed(body)
+        check("/debug/conprof returns non-empty collapsed stacks",
+              bool(parsed), f"{len(parsed)} stacks")
+        roles = {s.split(";", 1)[0] for s in parsed}
+        check("collapsed stacks cover >= 3 thread roles",
+              len(roles) >= 3, str(sorted(roles)))
+        check("every collapsed role is in the closed vocabulary",
+              roles <= set(conprof.ROLES), str(sorted(roles)))
+
+        # 3. statement CPU attribution over SQL, digest-joined
+        digest, _ = stmtsummary.normalize(heavy)
+        c = MiniClient(srv.port, db="sm")
+        _, rows = c.query(
+            "select digest, cpu_samples, sum_cpu_ms, sum_exec_ms "
+            "from information_schema.statements_summary "
+            f"where digest = '{digest}'")
+        check("hot digest family visible in statements_summary",
+              len(rows) == 1, str(rows))
+        _, cpu_samples, cpu_ms, exec_ms = rows[0]
+        check("sum_cpu_ms > 0 for the hot family over SQL",
+              int(cpu_samples) > 0 and float(cpu_ms) > 0,
+              f"samples={cpu_samples} cpu_ms={cpu_ms}")
+        check("cpu_ms <= exec wall invariant",
+              float(cpu_ms) <= float(exec_ms),
+              f"cpu={cpu_ms} exec={exec_ms}")
+
+        # 4. continuous_profiling over SQL
+        _, rows = c.query(
+            "select role, folded_stack, samples from "
+            "information_schema.continuous_profiling "
+            "where samples > 0")
+        check("continuous_profiling serves folded stacks over SQL",
+              len(rows) > 0, f"{len(rows)} rows")
+        check("continuous_profiling roles in vocabulary",
+              {r[0] for r in rows} <= set(conprof.ROLES))
+
+        # 5. the induced cpu-saturation finding over SQL + endpoint
+        _, rows = c.query(
+            "select rule, item, severity from "
+            "information_schema.inspection_result "
+            "where rule = 'cpu-saturation'")
+        check("cpu-saturation finding induced over SQL",
+              len(rows) >= 1, str(rows))
+        check("finding names a vocabulary role as the dominant item",
+              rows[0][1] in conprof.ROLES, str(rows[0]))
+        body = urlopen(
+            f"http://127.0.0.1:{sport}/debug/inspection?window=0",
+            timeout=10).read().decode()
+        check("cpu-saturation served by /debug/inspection",
+              "cpu-saturation" in body)
+        c.close()
+        warm.close()
+    finally:
+        status.close()
+        srv.close()
+    print("[conprof-smoke] all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
