@@ -23,6 +23,9 @@ bench.py's contract):
     {"metric": "conprof_overhead_frac", "value": ..., "unit": "frac"}
     {"metric": "serve_queue_wait_p99_share", "value": ..., "unit": "frac"}
     {"metric": "serve_dispatches_per_query", "value": ..., "unit": "dispatches"}
+    {"metric": "serve_storm_dispatches_per_query", "value": ..., "unit": "dispatches"}
+    {"metric": "serve_storm_qps", "value": ..., "unit": "qps"}
+    {"metric": "serve_stacked_occupancy_avg", "value": ..., "unit": "members"}
 
 obs_overhead_frac is the time-series sampler's steady-state cost (one
 sample's wall over the default interval, measured against the live
@@ -34,8 +37,10 @@ share splits the published p99 into wait vs execution from the
 "queue" phase histogram.
 
 Hard assertions (the serve-smoke CI gate): zero statement errors, at
-least one coalesced batch with occupancy > 1 in the storm, zero
-progcache misses across the storm, storm results == solo results,
+least one coalesced batch with occupancy > 1 in the storm, at least
+one STACKED round (one vmap-batched dispatch per group,
+tidb_batch_stack_max) with the storm's dispatches-per-query <= 0.6,
+zero progcache misses across the storm, storm results == solo results,
 /debug/conprof collapsed stacks from >= 3 thread roles, storm digest
 family carries sum_cpu_ms > 0 with cpu_ms <= exec wall, and both
 observability overhead fractions under 3%.
@@ -156,7 +161,13 @@ def main():
     t0 = time.time()
     for sql in (tpch.Q1, tpch.Q3, tpch.Q6, q6_variant(1), q1_variant(1)):
         warm.query(sql)
-    print(f"[serve] warm in {time.time() - t0:.1f}s", file=sys.stderr)
+    # B-bucketed stacked variants (ops/batching.py dispatch leg): warm
+    # them here like the auto-prewarm worker would, so the storm's
+    # stacked rounds are plain cache hits at every occupancy bucket —
+    # the 0-storm-compiles gate below covers the stacked path too
+    n_stacked = kernels.prewarm_stacked()
+    print(f"[serve] warm in {time.time() - t0:.1f}s "
+          f"({n_stacked} stacked variants)", file=sys.stderr)
 
     srv = Server(storage, port=0)
     srv.start()
@@ -288,6 +299,7 @@ def main():
         batch0 = batching.stats_snapshot()
         miss0 = progcache.stats_snapshot()["misses"]
         role0 = conprof.stats_snapshot()["role_busy"]
+        storm_disp0 = kernels.stats_snapshot()["dispatches"]
         t0 = time.time()
         threads = [threading.Thread(target=storm_client, args=(i, jobs[i]),
                                     daemon=True)
@@ -312,6 +324,8 @@ def main():
                      for r, n in sorted(role_d.items(), key=lambda kv:
                                         -kv[1]) if n > 0} \
             if busy_total else {}
+        storm_dispatches = kernels.stats_snapshot()["dispatches"] \
+            - storm_disp0
         storm = {
             "statements": n_storm, "wall_s": round(storm_wall, 3),
             "qps": round(n_storm / max(storm_wall, 1e-9), 1),
@@ -319,6 +333,15 @@ def main():
             - miss0,
             "attempts": attempt + 1,
             "cpu_busy_samples": busy_total, "cpu_share": cpu_share,
+            # the ROADMAP item 2(b) gate: one stacked dispatch serves a
+            # whole round, so the storm's dispatches-per-query drops
+            # UNDER 1 (was ~1.17 with back-to-back replays)
+            "dispatches": storm_dispatches,
+            "dispatches_per_query": round(
+                storm_dispatches / max(n_storm, 1), 3),
+            "stacked_occupancy_avg": round(
+                bd.get("stacked_occupancy_sum", 0)
+                / max(bd.get("stacked_rounds", 0), 1), 2),
             **bd,
         }
         if bd.get("batches", 0) >= 1 and bd.get("occupancy_sum", 0) \
@@ -405,6 +428,14 @@ def main():
     print(json.dumps({"metric": "serve_dispatches_per_query",
                       "value": dispatches_per_query,
                       "unit": "dispatches"}))
+    print(json.dumps({"metric": "serve_storm_dispatches_per_query",
+                      "value": storm["dispatches_per_query"],
+                      "unit": "dispatches"}))
+    print(json.dumps({"metric": "serve_storm_qps",
+                      "value": storm["qps"], "unit": "qps"}))
+    print(json.dumps({"metric": "serve_stacked_occupancy_avg",
+                      "value": storm["stacked_occupancy_avg"],
+                      "unit": "members"}))
 
     # ---- the serve-smoke gate -------------------------------------------
     assert not errors, errors[:5]
@@ -417,6 +448,16 @@ def main():
     assert storm["progcache_misses"] == 0, storm
     assert storm["batches"] >= 1 and storm["occupancy_sum"] \
         > storm["batches"], f"no coalesced batch with occupancy > 1: {storm}"
+    # ---- stacked-params gates (ISSUE 14 acceptance) ---------------------
+    # the storm formed at least one stacked round (ONE vmap-batched
+    # dispatch for a whole group) with zero compiles (asserted above —
+    # the B-bucket variants were prewarmed), and the storm phase's
+    # dispatches-per-query dropped to the stacked regime
+    assert storm.get("stacked_rounds", 0) >= 1, \
+        f"no stacked round formed: {storm}"
+    assert storm["dispatches_per_query"] <= 0.6, \
+        f"storm dispatches/query {storm['dispatches_per_query']} > 0.6: " \
+        f"{storm}"
     # the observability cost gate (ISSUE 8 acceptance): sampling the
     # whole counter surface must stay under 3% of one core at the
     # default interval

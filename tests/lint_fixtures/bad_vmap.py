@@ -1,0 +1,47 @@
+"""qlint fixture: vmapped kernel entry points are traced regions
+(TS1xx over batched programs, ISSUE 14).
+
+The stacked-params batch variants re-trace a fused kernel under
+``jax.vmap``; a host sync or a value-derived capture inside the batched
+region fires exactly like inside any jit region.  The kernels here are
+reached through an ASSIGNMENT ALIAS and through ``functools.partial``
+— the two shapes the root discovery must follow beyond bare names.
+Never imported, only parsed.
+"""
+import numpy as np
+
+from functools import partial
+
+import jax
+
+
+def make_batched(jn):
+    def kern(cols, pr):
+        # TS103: data-dependent Python control flow on a traced value
+        if pr[0][0] > 0:
+            # TS101: numpy over a traced value mid-trace
+            return np.asarray(cols[0])
+        return cols[0] * pr[0][0]
+    # the stacked-variant builder idiom: the factory-returned kernel is
+    # bound to a local before batching — the alias must not launder the
+    # traced-region root
+    fn = kern
+    return jax.vmap(fn, in_axes=(None, 0))
+
+
+def make_partial_batched(node, jn):
+    lo = node.value                 # the Constant.value extraction idiom
+
+    def pkern(arrs, pr):
+        # TS107: query constant baked into the batched closure — every
+        # distinct literal compiles its own B-stacked program
+        return arrs[0] * lo
+    return jax.vmap(partial(pkern), in_axes=(None, 0))
+
+
+def make_clean(jn):
+    def ckern(cols, pr):
+        # masking instead of control flow; jn ops only — clean
+        return jn.where(pr[0][0] > 0, cols[0], cols[0] * 2)
+    stacked = jax.vmap(ckern, in_axes=(None, 0))
+    return stacked

@@ -69,6 +69,42 @@ def test_literal_fixture_fires_ts107():
     assert all("const_fn" in d.message for d in got)
 
 
+def test_vmap_fixture_fires_through_alias_and_partial():
+    """ISSUE 14: vmapped (stacked-batch) kernels are traced regions even
+    when reached through an assignment alias or functools.partial."""
+    sf = SourceFile(os.path.join(FIXDIR, "bad_vmap.py"))
+    diags = lint_trace_safety(sf)
+    by_rule = {}
+    for d in diags:
+        by_rule.setdefault(d.rule, []).append(d)
+    # kern (via `fn = kern` then vmap(fn)): control flow + numpy sync
+    assert any("kern" in d.message for d in by_rule.get("TS103", [])), \
+        [d.format() for d in diags]
+    assert any("kern" in d.message for d in by_rule.get("TS101", []))
+    # pkern (via vmap(partial(pkern))): baked query constant
+    assert any("pkern" in d.message for d in by_rule.get("TS107", []))
+    # the masked/clean kernel stays silent
+    assert not any("ckern" in d.message for d in diags)
+
+
+def test_vmap_bare_alias_chain_resolved(tmp_path):
+    # a two-hop alias chain still roots the def; an unrelated def with
+    # the hazard but no jit/vmap reachability stays out of scope
+    src = ("import numpy as np\n\n\n"
+           "def kern(cols, pr):\n"
+           "    return np.asarray(cols[0])\n\n\n"
+           "def other(cols, pr):\n"
+           "    return np.asarray(cols[0])\n\n\n"
+           "a = kern\n"
+           "b = a\n"
+           "w = vmap(b, in_axes=(None, 0))\n")
+    p = tmp_path / "alias_chain.py"
+    p.write_text(src)
+    diags = lint_trace_safety(SourceFile(str(p)))
+    assert any(d.rule == "TS101" and "kern" in d.message for d in diags)
+    assert not any("other" in d.message for d in diags)
+
+
 def test_ts107_default_param_capture_not_flagged(tmp_path):
     # the slot-plumbing idiom: value-derived names bound as DEFAULT
     # parameters are runtime-operand plumbing, not a bake
@@ -675,6 +711,7 @@ def test_corpus_plans_clean():
     ("trace", "bad_suppress.py"),
     ("trace", "bad_pipeline.py"),
     ("trace", "bad_literal.py"),
+    ("trace", "bad_vmap.py"),
     ("obs", "bad_stats.py"),
     ("obs", "bad_summary.py"),
     ("obs", "bad_metric.py"),

@@ -261,7 +261,8 @@ def test_batched_equals_solo_byte_identical(server):
     digest, _ = stmtsummary.normalize(qs[0])
     assert batching.family_batchable(digest)
 
-    from tinysql_tpu.ops import progcache
+    from tinysql_tpu.ops import kernels, progcache
+    kernels.prewarm_stacked()  # B-bucket variants warm, like the worker
     st0 = batching.stats_snapshot()
     miss0 = progcache.stats_snapshot()["misses"]
     pool = StatementPool(server.storage)
@@ -276,10 +277,19 @@ def test_batched_equals_solo_byte_identical(server):
     assert st["batches"] == st0["batches"] + 1
     assert st["occupancy_sum"] == st0["occupancy_sum"] + len(qs)
     assert st["fallbacks"] == st0["fallbacks"]
+    # the whole round rode ONE stacked dispatch (6 members -> B=8)
+    assert st["stacked_rounds"] == st0["stacked_rounds"] + 1
+    assert st["stacked_occupancy_sum"] \
+        == st0["stacked_occupancy_sum"] + len(qs)
     assert progcache.stats_snapshot()["misses"] == miss0  # zero compiles
     for s in sessions:
         d = s.last_query_stats.device_totals()
-        assert d.get("coalesced") == 1 and d.get("dispatches", 0) >= 1
+        # occupancy-weighted share of the one stacked dispatch: the sum
+        # across members reconciles with the global counter
+        assert d.get("coalesced") == 1 and d.get("dispatches", 0) > 0
+    total = sum(s.last_query_stats.device_totals().get("dispatches", 0)
+                for s in sessions)
+    assert total == pytest.approx(1.0)
 
 
 def test_batch_duplicate_statements_share_round(server):

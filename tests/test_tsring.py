@@ -441,7 +441,9 @@ EXPECTED_RULES = {"compile-storm", "progcache-hit-rate",
                   "dispatch-storm", "transfer-bound",
                   "recompile-churn", "slo-burn",
                   # host-CPU truth (ISSUE 13)
-                  "cpu-saturation", "profiler-overhead"}
+                  "cpu-saturation", "profiler-overhead",
+                  # stacked-params batching (ISSUE 14)
+                  "batching-degraded"}
 
 
 def test_rule_catalogue_fully_covered():
@@ -643,6 +645,43 @@ def test_rule_recompile_churn():
                     if x.item == healthy]
     finally:
         stmtsummary.STORE.reset()
+
+
+def test_rule_batching_degraded():
+    n = oinspect.BATCH_DEGRADED_MIN_ATTEMPTS
+    # 30% of windowed replay attempts fell back to solo dispatch —
+    # past the 20% warning line
+    ring = _ring_with({"tinysql_batch_statements_total": n * 0.7,
+                       "tinysql_batch_fallbacks_total": n * 0.3})
+    f = _findings(ring, "batching-degraded")
+    assert len(f) == 1 and f[0].severity == "warning"
+    assert f[0].metric == "tinysql_batch_fallbacks_total"
+    # at/over 50%: critical
+    ring = _ring_with({"tinysql_batch_statements_total": n * 0.5,
+                       "tinysql_batch_fallbacks_total": n * 0.5})
+    assert _findings(ring, "batching-degraded")[0].severity == "critical"
+    # a healthy coalescer (sub-threshold fallback share): silent
+    ring = _ring_with({"tinysql_batch_statements_total": n,
+                       "tinysql_batch_fallbacks_total": n * 0.1})
+    assert not _findings(ring, "batching-degraded")
+    # too few attempts to judge: silent even at a 100% fallback share
+    ring = _ring_with({"tinysql_batch_fallbacks_total": n - 1})
+    assert not _findings(ring, "batching-degraded")
+    # the STACKED leg is judged separately in group units: groups that
+    # should have ridden one vmap-batched dispatch but fell back to
+    # back-to-back replays — even while every replay consume HITS
+    g = oinspect.BATCH_DEGRADED_MIN_GROUPS
+    ring = _ring_with({"tinysql_batch_statements_total": 4 * n,
+                       "tinysql_batch_stacked_rounds_total": g * 0.4,
+                       "tinysql_batch_stack_fallbacks_total": g * 0.6})
+    f = _findings(ring, "batching-degraded")
+    assert len(f) == 1 and f[0].severity == "critical"
+    assert f[0].item == "stacked"
+    assert f[0].metric == "tinysql_batch_stack_fallbacks_total"
+    # healthy stacking: silent
+    ring = _ring_with({"tinysql_batch_stacked_rounds_total": g,
+                       "tinysql_batch_stack_fallbacks_total": g * 0.1})
+    assert not _findings(ring, "batching-degraded")
 
 
 def test_rule_slo_burn():

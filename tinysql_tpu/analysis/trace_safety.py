@@ -5,7 +5,10 @@ region is (a) a function decorated with a jit-like wrapper, (b) a
 function named ``emit`` (the devpipe convention: the pure traced half of
 a prepare/emit node), or (c) a function whose name is passed to a
 jit-like call in the same module (``counted_jit(kernel)``,
-``shard_map(kernel, ...)``).
+``shard_map(kernel, ...)``, ``vmap(kernel, ...)``) — including names
+reached through simple assignment aliases (``fn = kernel`` then
+``vmap(fn)``, the stacked-variant builder idiom) and through a
+``functools.partial`` wrapper at the call site.
 
 Inside a traced region the pass taints the function's parameters (they
 are tracers at trace time) and propagates:
@@ -110,15 +113,47 @@ def _numpy_aliases(tree: ast.Module) -> Set[str]:
 
 
 def _jitted_names(tree: ast.Module) -> Set[str]:
-    """Function names passed (as bare names) to jit-like calls anywhere in
-    the module — those defs trace when the wrapper runs."""
+    """Function names passed to jit-like calls anywhere in the module —
+    those defs trace when the wrapper runs.  Coverage (ISSUE 14: the
+    vmap-batched kernel variants must fire like any jit region):
+
+    - bare names (``counted_jit(kernel)``, ``vmap(kernel)``);
+    - names reached through simple ASSIGNMENT ALIASES (``fn = kernel``
+      then ``vmap(fn, ...)`` — the stacked-variant builder idiom of
+      binding the factory-returned kernel before batching it);
+    - names wrapped in ``functools.partial`` at the call site
+      (``vmap(partial(kernel, ...))``).
+    """
+    alias: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Name):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    alias[t.id] = node.value.id
     out: Set[str] = set()
+
+    def add(name: str) -> None:
+        seen: Set[str] = set()
+        while name not in seen:
+            out.add(name)
+            seen.add(name)
+            nxt = alias.get(name)
+            if nxt is None:
+                break
+            name = nxt
+
     for node in ast.walk(tree):
         if isinstance(node, ast.Call) \
                 and _call_name(node.func) in _JIT_CALL_NAMES:
             for a in list(node.args) + [k.value for k in node.keywords]:
                 if isinstance(a, ast.Name):
-                    out.add(a.id)
+                    add(a.id)
+                elif isinstance(a, ast.Call) \
+                        and _call_name(a.func) == "partial":
+                    for pa in a.args:
+                        if isinstance(pa, ast.Name):
+                            add(pa.id)
     return out
 
 

@@ -29,6 +29,14 @@ from ..planner.physical import (PhysicalHashAgg, PhysicalHashJoin,
 from .executors import Executor, build_executor
 
 
+def _batching_active() -> bool:
+    """Is a batch round's collect/replay leg live on this context?
+    (ops/batching.active — lazy import so the executor stays importable
+    without the serving layer initialized)"""
+    from ..ops import batching
+    return batching.active()
+
+
 def _drain_chunk(ex: Executor, fields, soft: bool = False) -> Chunk:
     """``soft=True`` (spill-mode callers): the whole drain — child
     per-chunk allocations AND the accumulator growth — charges through
@@ -774,7 +782,13 @@ class TPUHashAggExec(Executor):
                         mesh, dev_cols, gid_dev, n_segments, specs, progs,
                         n, mask_spec, program_key=program_key,
                         params=params)
-            elif self._can_device_passthrough(plan, slots, key_layouts):
+            elif self._can_device_passthrough(plan, slots, key_layouts) \
+                    and not _batching_active():
+                # a live batch round prefers the batchable fused path
+                # below: members must park (collect) and consume
+                # (replay) along the SAME route, and the keep variant's
+                # per-member device assembly cannot ride a stacked
+                # dispatch
                 ids, live, out_aggs_d, np_, ob = \
                     kernels.fused_segment_aggregate_keep(
                         dev_cols, gid_dev, n_segments, specs, progs,

@@ -46,6 +46,10 @@ tests/test_tsring.py):
   histogram shows > 1% of windowed measurements over the armed
   ``tidb_slo_p99_ms`` — the p99 objective's error budget is burning.
   Fed by the ``slo`` ring source (:func:`slo_sample`).
+- **batching-degraded** (ISSUE 14): too many batched replay attempts
+  fell back to solo dispatch within the window (consume misses —
+  replica rotation, plan re-placement, param-layout churn): the
+  coalescer is paying its protocol cost without the one-dispatch win;
 - **cpu-saturation** (ISSUE 13): one thread role dominates the busy
   host-CPU samples (obs/conprof.py) while the admission queue is
   non-empty — the serving tier's latency is host CPU in that role, and
@@ -117,6 +121,17 @@ CPU_SAT_CRITICAL_SHARE = 0.85
 #: which the finding fires (obs/conprof.py backs its rate off at the
 #: same budget — the rule reports what the backoff is absorbing)
 PROFILER_OVERHEAD_BUDGET = 0.03
+#: batching-degraded: minimum windowed replay ATTEMPTS (replays +
+#: consume-miss fallbacks) / stacked-leg GROUPS (stacked rounds +
+#: stack fallbacks) before either degradation ratio may judge, and the
+#: degraded share at warning / critical (shared by both legs).  The
+#: stacked leg is judged separately in its own units — a group that
+#: fell back to back-to-back replays still coalesced and replays
+#: cleanly, but the one-dispatch win is gone
+BATCH_DEGRADED_MIN_ATTEMPTS = 10
+BATCH_DEGRADED_MIN_GROUPS = 5
+BATCH_DEGRADED_WARN = 0.20
+BATCH_DEGRADED_CRIT = 0.50
 
 
 class Finding:
@@ -497,6 +512,52 @@ def _rule_recompile_churn(ctx: InspectionContext) -> List[Finding]:
             "literal parameterization or shape bucketing stopped "
             "covering this family — constant variants are compiling "
             "instead of hitting", "tinysql_progcache_misses_total"))
+    return out
+
+
+@rule("batching-degraded")
+def _rule_batching_degraded(ctx: InspectionContext) -> List[Finding]:
+    out: List[Finding] = []
+
+    def sev_of(ratio: float) -> Optional[str]:
+        if ratio < BATCH_DEGRADED_WARN:
+            return None
+        return "critical" if ratio >= BATCH_DEGRADED_CRIT else "warning"
+
+    # replay leg: members served from round dispatches plus the
+    # consume misses that fell back to solo re-dispatch
+    replays = ctx.delta("tinysql_batch_statements_total")
+    misses = ctx.delta("tinysql_batch_fallbacks_total")
+    attempts = replays + misses
+    if attempts >= BATCH_DEGRADED_MIN_ATTEMPTS:
+        sev = sev_of(misses / attempts)
+        if sev:
+            out.append(ctx.evidence(
+                "batching-degraded", "replay", sev,
+                f"{misses / attempts:.0%} of {attempts:.0f} batched "
+                "replay attempts within the window fell back to solo "
+                f"dispatch (warning {BATCH_DEGRADED_WARN:.0%} / critical "
+                f"{BATCH_DEGRADED_CRIT:.0%}): replica rotation or plan "
+                "re-placement is defeating the coalescer — batching "
+                "pays its collect/replay cost without the win",
+                "tinysql_batch_fallbacks_total"))
+    # stacked leg, in its own units: groups that should have ridden ONE
+    # vmap-batched dispatch but fell back to back-to-back replays
+    rounds = ctx.delta("tinysql_batch_stacked_rounds_total")
+    stack_falls = ctx.delta("tinysql_batch_stack_fallbacks_total")
+    groups = rounds + stack_falls
+    if groups >= BATCH_DEGRADED_MIN_GROUPS:
+        sev = sev_of(stack_falls / groups)
+        if sev:
+            out.append(ctx.evidence(
+                "batching-degraded", "stacked", sev,
+                f"{stack_falls / groups:.0%} of {groups:.0f} stackable "
+                "batch groups within the window fell back to the legacy "
+                f"back-to-back leg (warning {BATCH_DEGRADED_WARN:.0%} / "
+                f"critical {BATCH_DEGRADED_CRIT:.0%}): param-layout "
+                "churn or a missing stacking recipe is costing the "
+                "one-dispatch-per-round win (results stay correct)",
+                "tinysql_batch_stack_fallbacks_total"))
     return out
 
 

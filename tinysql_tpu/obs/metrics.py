@@ -171,6 +171,17 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "tinysql_batch_fallbacks_total":
         ("counter", "Replay consume misses that fell back to solo "
                     "dispatch"),
+    "tinysql_batch_stacked_rounds_total":
+        ("counter", "Batch groups served by ONE stacked-params "
+                    "vmap-batched dispatch (tidb_batch_stack_max)"),
+    "tinysql_batch_stacked_occupancy_sum":
+        ("counter", "Summed stacked-group occupancy (divide by stacked "
+                    "rounds for the average members per stacked "
+                    "dispatch)"),
+    "tinysql_batch_stack_fallbacks_total":
+        ("counter", "Batch groups that fell back from the stacked leg "
+                    "to back-to-back replays (layout mismatch, missing "
+                    "stacking recipe, stacked dispatch error)"),
     "tinysql_batch_dispatch_seconds_total":
         ("counter", "Wall seconds spent inside batch-round device "
                     "dispatch legs"),
@@ -238,7 +249,8 @@ for _k, (_name, _help) in _DEVICE_METRICS.items():
     METRICS[_name] = ("gauge" if _k in HWM_STATS_KEYS else "counter",
                       _help)
 # auto-prewarm worker counters (session/prewarm.py PREWARM_STATS keys)
-for _k in ("cycles", "families_warmed", "bucket_programs", "errors",
+for _k in ("cycles", "families_warmed", "bucket_programs",
+           "stacked_programs", "errors",
            "skipped_cooldown", "skipped_budget", "skipped_satisfied"):
     METRICS[f"tinysql_prewarm_worker_{_k}_total"] = (
         "counter", f"Auto-prewarm worker {_k.replace('_', ' ')}")
@@ -494,6 +506,15 @@ def render_prometheus() -> str:
         emit("tinysql_batch_fallbacks_total",
              "Replay consume misses that fell back to solo dispatch",
              "counter", [((), bst.get("fallbacks", 0))])
+        emit("tinysql_batch_stacked_rounds_total",
+             METRICS["tinysql_batch_stacked_rounds_total"][1],
+             "counter", [((), bst.get("stacked_rounds", 0))])
+        emit("tinysql_batch_stacked_occupancy_sum",
+             METRICS["tinysql_batch_stacked_occupancy_sum"][1],
+             "counter", [((), bst.get("stacked_occupancy_sum", 0))])
+        emit("tinysql_batch_stack_fallbacks_total",
+             METRICS["tinysql_batch_stack_fallbacks_total"][1],
+             "counter", [((), bst.get("stack_fallbacks", 0))])
         emit("tinysql_batch_dispatch_seconds_total",
              METRICS["tinysql_batch_dispatch_seconds_total"][1],
              "counter", [((), bst.get("dispatch_s_sum", 0.0))])

@@ -478,6 +478,12 @@ def _src_batching() -> Dict[str, float]:
                 b.get("batched_statements", 0),
             "tinysql_batch_occupancy_sum": b.get("occupancy_sum", 0),
             "tinysql_batch_fallbacks_total": b.get("fallbacks", 0),
+            "tinysql_batch_stacked_rounds_total":
+                b.get("stacked_rounds", 0),
+            "tinysql_batch_stacked_occupancy_sum":
+                b.get("stacked_occupancy_sum", 0),
+            "tinysql_batch_stack_fallbacks_total":
+                b.get("stack_fallbacks", 0),
             "tinysql_batch_dispatch_seconds_total":
                 b.get("dispatch_s_sum", 0.0)}
 

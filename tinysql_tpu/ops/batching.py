@@ -23,9 +23,24 @@ sequentially on it):
    statements never reach a batchable dispatch (host paths, cold
    programs, non-SELECTs) simply COMPLETE during collect: transparent
    solo fallback.
-2. **dispatch** — the round pushes every parked member's ParamTable
-   through the captured compiled program back-to-back (one device
-   round, zero host work in between, zero compiles by construction).
+2. **dispatch** — the round groups parked members by (program key,
+   staged-array identity) and, when ``tidb_batch_stack_max`` allows it
+   and >= 2 members' ParamTables share a slot layout, STACKS them on a
+   leading batch axis (exprjit.ParamTable.stack) and runs ONE
+   ``jax.vmap``-batched program variant (kernels.stacked_variant,
+   registered under the base key extended with a power-of-two occupancy
+   bucket B — occupancy 3 rides the B=4 program with an inert padding
+   row): the whole group costs one XLA dispatch, and packed outputs
+   download in one transfer.  Groups that cannot stack (stacking off,
+   layout mismatch, no stacking recipe on the program, singleton
+   leftovers) run the legacy back-to-back leg — one ParamTable replay
+   per member (zero compiles either way: park only happens on warm
+   programs).  Each dispatch leg runs inside a CAPTURE observability
+   scope; its device counters (dispatches, device_s, transfer bytes)
+   are split across the members it served — occupancy-weighted for a
+   stacked group, exact for a solo replay — so statements_summary and
+   EXPLAIN ANALYZE stay truthful and member shares sum to the global
+   counters.
 3. **replay** — each parked member re-executes; at the same boundary it
    *consumes* its precomputed device output (matched by program key +
    the identity of the staged device arrays + its own param bytes) and
@@ -46,9 +61,12 @@ module).
 """
 from __future__ import annotations
 
+import contextlib
 import contextvars
 import threading
 from typing import Dict, List, Optional
+
+from ..obs import context as _obs
 
 #: process-total coalescing counters (exported to /metrics and the
 #: serve bench): batches = rounds that dispatched >= 1 parked member,
@@ -57,9 +75,16 @@ from typing import Dict, List, Optional
 #: the protocol legs, fallbacks = replay consume misses (solo re-dispatch)
 #: dispatch_s_sum accumulates wall seconds inside round dispatch legs
 #: (exported as tinysql_batch_dispatch_seconds_total: the device-side
-#: half of a batched member's wait attribution)
+#: half of a batched member's wait attribution).  The stacked leg:
+#: stacked_rounds = groups served by ONE vmap-batched dispatch,
+#: stacked_statements = members inside them, stacked_occupancy_sum /
+#: stacked_rounds = average stacked occupancy, stack_fallbacks = groups
+#: that fell back to the legacy back-to-back leg (layout mismatch, no
+#: stacking recipe, stacked dispatch error)
 STATS = {"batches": 0, "batched_statements": 0, "occupancy_sum": 0,
-         "parks": 0, "replays": 0, "fallbacks": 0, "dispatch_s_sum": 0.0}
+         "parks": 0, "replays": 0, "fallbacks": 0, "dispatch_s_sum": 0.0,
+         "stacked_rounds": 0, "stacked_statements": 0,
+         "stacked_occupancy_sum": 0, "stack_fallbacks": 0}
 _stats_mu = threading.Lock()
 
 
@@ -89,7 +114,7 @@ class Parked(Exception):
 
 class _ParkedDispatch:
     __slots__ = ("key", "fn", "args", "arg_ids", "params_key", "params",
-                 "out")
+                 "out", "share")
 
     def __init__(self, key, fn, args, params):
         self.key = key
@@ -98,7 +123,8 @@ class _ParkedDispatch:
         self.arg_ids = _leaf_ids(args)
         self.params = params        # the member's (pi, pf) host vectors
         self.params_key = _params_key(params)
-        self.out = None
+        self.out = None             # ("dev"|"host", payload) once served
+        self.share = None           # this member's device-counter share
 
 
 def _params_key(params) -> bytes:
@@ -127,17 +153,37 @@ def _leaf_ids(x) -> tuple:
     return (id(x),)
 
 
+@contextlib.contextmanager
+def _capture_scope():
+    """A throwaway QueryObs installed around one round dispatch leg:
+    counted_jit / d2h / h2d report into it like into any statement
+    scope, and the collected totals become the served members'
+    attribution shares (the replay-side consume records them into each
+    member's own scope).  Without it the whole round's device_s and
+    transfer bytes would land on no statement at all — the pool worker
+    drives the dispatch leg outside every member context."""
+    cap = _obs.QueryObs()
+    tok = _obs.activate(cap)
+    try:
+        yield cap
+    finally:
+        _obs.deactivate(tok)
+
+
 class BatchRound:
     """One coalesced group's shared state across collect/dispatch/replay.
     Used from the single pool worker thread driving the group (members
     run sequentially), so no internal locking is needed beyond the
-    global counters."""
+    global counters.  ``stack_max`` is the live ``tidb_batch_stack_max``
+    value (0/1 = legacy back-to-back only; >= 2 caps how many members
+    one stacked dispatch may carry)."""
 
-    def __init__(self):
+    def __init__(self, stack_max: int = 0):
         self.collecting = False
         self.replaying = False
+        self.stack_max = max(int(stack_max), 0)
         self._parked: List[_ParkedDispatch] = []
-        #: (key, arg_ids, params_key) -> [device outputs]: a LIST because
+        #: (key, arg_ids, params_key) -> [(out, share)]: a LIST because
         #: concurrent clients legitimately submit IDENTICAL statements —
         #: each member consumes one stored output
         self._results: Dict[tuple, list] = {}
@@ -156,26 +202,39 @@ class BatchRound:
 
     # ---- dispatch --------------------------------------------------------
     def dispatch(self) -> int:
-        """Run every parked ParamTable through its captured compiled
-        program back-to-back; returns the round's occupancy (parked
-        member count).  Zero compiles by construction — park only
-        happens on progcache-warm programs.  A member whose dispatch
+        """Serve every parked member: same-program/same-data groups of
+        >= 2 layout-compatible members go through ONE stacked-params
+        vmap dispatch (``stack_max`` permitting), everything else
+        replays back-to-back through the captured solo program.
+        Returns the round's occupancy (members served).  Zero compiles
+        by construction on warm paths — park only happens on
+        progcache-warm programs, and the stacked variants are
+        prewarmable (kernels.prewarm_stacked).  A member whose dispatch
         raises (device loss, injected fault) simply has no stored
         result: its replay consume misses and the solo re-dispatch
         surfaces the error through the statement's own degradation
         path."""
         import time as _time
-        from . import kernels
         t0 = _time.perf_counter()
-        occ = 0
+        groups: Dict[tuple, list] = {}
+        order: List[tuple] = []
         for p in self._parked:
-            try:
-                p.out = p.fn(*p.args, kernels._params_dev(p.params))
-            except Exception:
-                continue
-            self._results.setdefault(
-                (p.key, p.arg_ids, p.params_key), []).append(p.out)
-            occ += 1
+            k = (p.key, p.arg_ids)
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append(p)
+        occ = 0
+        for k in order:
+            members = groups[k]
+            while members:
+                chunk = members[: max(self.stack_max, 1)]
+                members = members[len(chunk):]
+                if len(chunk) >= 2 and self._dispatch_stacked(chunk):
+                    occ += len(chunk)
+                    continue
+                for p in chunk:
+                    occ += self._dispatch_solo(p)
         if occ:
             _stat_add("batches")
             _stat_add("batched_statements", occ)
@@ -183,16 +242,96 @@ class BatchRound:
             _stat_add("dispatch_s_sum", _time.perf_counter() - t0)
         return occ
 
+    def _store(self, p: _ParkedDispatch, out, share: dict) -> None:
+        p.out = out
+        p.share = share
+        self._results.setdefault(
+            (p.key, p.arg_ids, p.params_key), []).append((out, share))
+
+    def _dispatch_solo(self, p: _ParkedDispatch) -> int:
+        """Legacy back-to-back leg: one ParamTable replay through the
+        member's captured solo program.  The capture scope's totals are
+        this member's EXACT attribution (the whole dispatch served only
+        it) — including any sampled device_s, so the profiler's measured
+        time lands on the member that caused it, not on whoever
+        dispatched the round."""
+        from . import kernels
+        try:
+            with _capture_scope() as cap:
+                out = p.fn(*p.args, kernels._params_dev(p.params))
+        except Exception:
+            return 0
+        self._store(p, ("dev", out), cap.device_totals())
+        return 1
+
+    def _dispatch_stacked(self, chunk: List[_ParkedDispatch]) -> bool:
+        """ONE dispatch for the whole chunk: stack the members'
+        ParamTables on a leading batch axis padded to the occupancy
+        bucket, run the B-stacked program variant, and split the output
+        per member — packed outputs download as one [B, L] transfer
+        here (host rows, no further d2h at replay), tree outputs slice
+        off axis 0 on device.  The capture scope's totals are divided
+        by the chunk's occupancy: each member's share of the one
+        dispatch.  Any failure (layout mismatch, no stacking recipe,
+        dispatch error) returns False and the chunk falls back to the
+        legacy leg — stacking is an optimization, never a correctness
+        dependency."""
+        from . import kernels
+        from .exprjit import ParamTable
+        p0 = chunk[0]
+        n = len(chunk)
+        try:
+            ent = kernels.stacked_variant(
+                p0.key, p0.fn, kernels.occupancy_bucket(n))
+            if ent is None:
+                _stat_add("stack_fallbacks")
+                return False
+            vfn, kind, schema = ent
+            stacked = ParamTable.stack(
+                [p.params for p in chunk], kernels.occupancy_bucket(n))
+        except Exception:
+            _stat_add("stack_fallbacks")
+            return False
+        try:
+            with _capture_scope() as cap:
+                res = vfn(*p0.args, kernels._params_dev(stacked))
+                if kind == "packed":
+                    rows = kernels.d2h_many(list(res))
+        except Exception:
+            _stat_add("stack_fallbacks")
+            return False
+        totals = cap.device_totals()
+        share = {key: v / n for key, v in totals.items()}
+        tree_map = kernels.jax().tree_util.tree_map
+        for i, p in enumerate(chunk):
+            if kind == "packed":
+                out = ("host", (rows[0][i], rows[1][i]))
+            else:
+                out = ("dev", tree_map(lambda x, i=i: x[i], res))
+            self._store(p, out, share)
+        _stat_add("stacked_rounds")
+        _stat_add("stacked_statements", n)
+        _stat_add("stacked_occupancy_sum", n)
+        return True
+
     # ---- replay ----------------------------------------------------------
     def consume(self, key, args, params):
-        """The replay-side lookup: this member's precomputed device
-        output, or None when the capture no longer matches (fall back to
-        a solo dispatch)."""
+        """The replay-side lookup: this member's precomputed
+        ``(tag, output)``, or None when the capture no longer matches
+        (fall back to a solo dispatch).  A hit records the member's
+        attribution share — its occupancy-weighted slice of the round
+        dispatch's device counters — into the member's own live scope,
+        so summing statements_summary across members reconciles with
+        the global counters."""
         outs = self._results.get(
             (key, _leaf_ids(args), _params_key(params)))
         if outs:
             _stat_add("replays")
-            return outs.pop()
+            out, share = outs.pop()
+            for k, v in share.items():
+                _obs.record(k, v)
+            _obs.record("coalesced", 1)
+            return out
         _stat_add("fallbacks")
         return None
 
@@ -211,6 +350,15 @@ def deactivate(token) -> None:
 
 def current() -> Optional[BatchRound]:
     return _ROUND.get()
+
+
+def active() -> bool:
+    """True while a batch round's collect or replay leg drives THIS
+    context — executors use it to prefer the batchable fused paths over
+    per-member-only variants (device passthrough) so a round's members
+    park and consume along the same route."""
+    rnd = _ROUND.get()
+    return rnd is not None and (rnd.collecting or rnd.replaying)
 
 
 # ---- family registry (learned batch eligibility) --------------------------

@@ -566,17 +566,10 @@ def pack_arrays(schema: list, arrays) -> tuple:
             jn.concatenate(floats) if floats else zf)
 
 
-def unpack_flat(pair, schema: list) -> List[np.ndarray]:
-    """ONE D2H pull (both streams batch through d2h_many when a result
-    spans int64 and float64), then split per the recorded schema."""
-    dev_i, dev_f = pair
-    need_i = any(s == "i" for _, _, s in schema)
-    need_f = any(s == "f" for _, _, s in schema)
-    if need_i and need_f:
-        flat_i, flat_f = d2h_many([dev_i, dev_f])
-    else:
-        flat_i = d2h(dev_i) if need_i else None
-        flat_f = d2h(dev_f) if need_f else None
+def _split_flat(flat_i, flat_f, schema: list) -> List[np.ndarray]:
+    """Split the two host streams back into typed arrays per the
+    recorded schema (shared by :func:`unpack_flat` and
+    :func:`unpack_host`)."""
     out = []
     pi = pf = 0
     for dt, ln, stream in schema:
@@ -593,6 +586,30 @@ def unpack_flat(pair, schema: list) -> List[np.ndarray]:
             else:
                 out.append(seg.astype(np.dtype(dt)))
     return out
+
+
+def unpack_flat(pair, schema: list) -> List[np.ndarray]:
+    """ONE D2H pull (both streams batch through d2h_many when a result
+    spans int64 and float64), then split per the recorded schema."""
+    dev_i, dev_f = pair
+    need_i = any(s == "i" for _, _, s in schema)
+    need_f = any(s == "f" for _, _, s in schema)
+    if need_i and need_f:
+        flat_i, flat_f = d2h_many([dev_i, dev_f])
+    else:
+        flat_i = d2h(dev_i) if need_i else None
+        flat_f = d2h(dev_f) if need_f else None
+    return _split_flat(flat_i, flat_f, schema)
+
+
+def unpack_host(pair, schema: list) -> List[np.ndarray]:
+    """``unpack_flat`` for a stacked batch round's already-downloaded
+    member rows: the round's dispatch leg pulled the WHOLE stacked
+    output in one packed transfer (ops/batching.py), so the member's
+    row pair is host memory here — splitting must not count (or pay
+    for) another download."""
+    host_i, host_f = pair
+    return _split_flat(host_i, host_f, schema)
 
 
 def bucket(n: int) -> int:
@@ -1117,6 +1134,90 @@ def _batch_round(mask, params, batchable: bool):
     return batching.current()
 
 
+# ---- stacked-params batch execution (ops/batching.py dispatch leg) --------
+# A batch round's parked members share one compiled program and one set
+# of replica-memoized data columns; only their ~100-byte ParamTables
+# differ.  Stacking those on a leading batch axis (exprjit
+# ParamTable.stack) and dispatching ONE jax.vmap-batched program variant
+# makes an entire round cost one XLA dispatch instead of N back-to-back
+# replays.  Variants register in progcache under the base key extended
+# with a power-of-two OCCUPANCY BUCKET (occupancy 3 rides the B=4
+# program with an inert padding row) — no key explosion, prewarmable
+# like any program family (prewarm_stacked).
+
+def _stackable_jit(kernel, kind: str, n_data: int, make_kernel):
+    """counted_jit + the stacking recipe (`stack_info`) the batched
+    variant builder reads: ``kind`` is the output protocol ("packed" =
+    one downloadable [B, L] pair, "tree" = per-member device slices),
+    ``n_data`` the shared data operands before the vmapped params
+    operand, ``make_kernel`` a factory yielding a FRESH (kernel,
+    schema) pair for the vmap re-trace."""
+    w = counted_jit(kernel)  # qlint: disable=TS104 -- factory: returned straight to the progcache builder, which owns caching
+    w.stack_info = (kind, n_data, make_kernel)
+    return w
+
+
+def occupancy_bucket(n: int) -> int:
+    """Power-of-two batch bucket for a stacked round (min 2 — a solo
+    member never stacks)."""
+    b = 2
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _stacked_key(key: tuple, b: int) -> tuple:
+    return key + (("stacked", b),)
+
+
+def is_stacked_key(key: tuple) -> bool:
+    """Is this registry key a B-stacked variant of a batchable program?"""
+    return bool(key) and isinstance(key[-1], tuple) and len(key[-1]) == 2 \
+        and key[-1][0] == "stacked"
+
+
+def stacked_variant(key: tuple, base_fn, b: int):
+    """The B-stacked variant of a batchable fused program: the base
+    kernel re-traced under ``jax.vmap`` over the params operand (shared
+    data columns stay unmapped), registered under the base key extended
+    with the occupancy bucket ``b``.  Returns ``(jitted fn, kind,
+    schema)`` — kind ``"packed"`` outputs download as one ``[B, L]``
+    pair, kind ``"tree"`` outputs slice per member on device — or None
+    when the base program carries no stacking recipe (legacy entries,
+    non-fused programs)."""
+    info = getattr(base_fn, "stack_info", None)
+    if info is None:
+        return None
+    kind, n_data, make_kernel = info
+
+    def build():
+        kern, schema = make_kernel()
+        axes = tuple([None] * n_data + [0])
+        vk = jax().vmap(kern, in_axes=axes)
+        return counted_jit(vk), kind, schema
+    return progcache.get(_stacked_key(key, b), build)
+
+
+def prewarm_stacked(buckets=(2, 4, 8, 16)) -> int:
+    """AOT-build the B-bucketed stacked variants of every registered
+    batchable fused program (the auto-prewarm worker calls this inside
+    its prewarm scope; bench_serve/batch_smoke call it so the storm's
+    first stacked round is a plain cache hit).  Returns the number of
+    variants now registered."""
+    n = 0
+    for key in progcache.keys("scalar") + progcache.keys("seg"):
+        if is_stacked_key(key):
+            continue
+        ent = progcache.peek(key)
+        fn = ent[0] if isinstance(ent, tuple) else ent
+        if getattr(fn, "stack_info", None) is None:
+            continue
+        for b in buckets:
+            if stacked_variant(key, fn, int(b)) is not None:
+                n += 1
+    return n
+
+
 def _fused_segment_raw(dev_cols, gid_dev, n_segments: int,
                        agg_specs, arg_exprs, mask,
                        program_key: tuple = (), params=None,
@@ -1140,31 +1241,36 @@ def _fused_segment_raw(dev_cols, gid_dev, n_segments: int,
     def build():
         arg_fns = [_lower_arg(e) for e in arg_exprs]
 
-        def kernel(cols, gid, mask_in, pr):
-            if mask_fn is not None:
-                valid = mask_fn(cols, pr, jn.arange(nb))
-            else:
-                valid = mask_in  # covers filter AND padding rows
-            seg = _SegReduce(j, jn, gid, valid, ns)
-            presence, first_orig = seg.presence_first()
-            first_orig = jn.minimum(first_orig, gid.shape[0] - 1)
-            ident = lambda x: x
-            outs = _fused_agg_outs(j, jn, agg_specs, arg_fns, cols, gid,
-                                   valid, ns, presence, ident, ident, ident,
-                                   seg=seg, pr=pr)
-            n_present = jn.sum((presence > 0).astype(jn.int64))
-            return presence, first_orig, outs, n_present
-        return counted_jit(kernel)
+        def make_kernel():
+            def kernel(cols, gid, mask_in, pr):
+                if mask_fn is not None:
+                    valid = mask_fn(cols, pr, jn.arange(nb))
+                else:
+                    valid = mask_in  # covers filter AND padding rows
+                seg = _SegReduce(j, jn, gid, valid, ns)
+                presence, first_orig = seg.presence_first()
+                first_orig = jn.minimum(first_orig, gid.shape[0] - 1)
+                ident = lambda x: x
+                outs = _fused_agg_outs(j, jn, agg_specs, arg_fns, cols,
+                                       gid, valid, ns, presence, ident,
+                                       ident, ident, seg=seg, pr=pr)
+                n_present = jn.sum((presence > 0).astype(jn.int64))
+                return presence, first_orig, outs, n_present
+            return kernel, None
+
+        kernel, _ = make_kernel()
+        # tree output: member rows slice off axis 0 and flow into
+        # _present_extract in the member's own scope
+        return _stackable_jit(kernel, "tree", 3, make_kernel)
     fn = progcache.get(key, build)
     if rnd is not None and rnd.replaying:
         got = rnd.consume(key, (dev_cols, gid_dev, mask_arr), params)
         if got is not None:
-            # the member's share of the round dispatch, attributed to
-            # its own scope (the global counter accrued at dispatch time
-            # through counted_jit on the pool worker)
-            _obs.record("dispatches", 1)
-            _obs.record("coalesced", 1)
-            presence, first_orig, outs, n_present = got
+            # consume attributed the member's occupancy-weighted share
+            # of the round dispatch into this scope (the global counter
+            # accrued at dispatch time through counted_jit on the pool
+            # worker)
+            _tag, (presence, first_orig, outs, n_present) = got
             return presence, first_orig, outs, n_present, ns
     presence, first_orig, outs, n_present = fn(dev_cols, gid_dev,
                                                mask_arr,
@@ -1201,6 +1307,14 @@ def fused_segment_aggregate_keep(dev_cols, gid_dev, n_segments: int,
     [0:n_present) are live (presence ids ascend out of nonzero); padding
     rows carry id=ns and live=False."""
     jn = jnp()
+    if mask[0] == "dev" and params is not None:
+        # family-eligibility marker only (the session close hook feeds
+        # batching.note_family from it): the keep path itself never
+        # parks — its per-member device assembly cannot ride a stacked
+        # dispatch — but a later batch ROUND re-routes this plan through
+        # the batchable fused_segment path (tpu_executors skips the
+        # passthrough while a round is live)
+        _obs.record("batchable", 1)
     presence, _first, outs, n_present, ns = _fused_segment_raw(
         dev_cols, gid_dev, n_segments, agg_specs, arg_exprs, mask,
         program_key=program_key, params=params)
@@ -1240,57 +1354,70 @@ def fused_scalar_aggregate(dev_cols, agg_specs, arg_exprs, n_rows: int,
 
     def build():
         arg_fns = [_lower_arg(e) for e in arg_exprs]
-        kernel_schema: list = []
 
-        def kernel(cols, mask_in, pr):
-            if mask_fn is not None:
-                valid = mask_fn(cols, pr, jn.arange(nb))
-            else:
-                valid = mask_in
-            outs = []
-            for (func, has_arg), af in zip(agg_specs, arg_fns):
-                av = an = None
-                if has_arg and af is not None:
-                    av, an = af(cols, pr)
-                if func == "count_star":
-                    outs.append((jn.sum(valid.astype(jn.int64))[None],
-                                 jn.zeros(1, dtype=bool)))
-                    continue
-                live = valid & ~an
-                if func == "count":
-                    outs.append((jn.sum(live.astype(jn.int64))[None],
-                                 jn.zeros(1, dtype=bool)))
-                elif func in ("sum", "sum0"):
-                    total = jn.sum(jn.where(live, av, 0))[None]
-                    cnt = jn.sum(live.astype(jn.int64))
-                    outs.append((total, jn.zeros(1, dtype=bool)
-                                 if func == "sum0" else (cnt == 0)[None]))
-                elif func in ("min", "max"):
-                    if av.dtype == jn.int64:
-                        fill = (jn.iinfo(jn.int64).max if func == "min"
-                                else jn.iinfo(jn.int64).min)
-                    else:
-                        fill = jn.inf if func == "min" else -jn.inf
-                    red = jn.min if func == "min" else jn.max
-                    r = red(jn.where(live, av, fill))[None]
-                    cnt = jn.sum(live.astype(jn.int64))
-                    outs.append((r, (cnt == 0)[None]))
-                else:  # pragma: no cover
-                    raise ValueError(func)
-            n_valid = jn.sum(valid.astype(jn.int64))
-            first_orig = jn.argmax(valid)[None]
-            items = [n_valid[None], first_orig]
-            for v, m in outs:
-                items += [v, m]
-            return pack_arrays(kernel_schema, items)
-        return counted_jit(kernel), kernel_schema
+        def make_kernel():
+            # a FRESH (kernel, schema) pair per call: the stacked-variant
+            # builder (stacked_variant) re-traces the kernel under
+            # jax.vmap, and pack_arrays rewrites its captured schema at
+            # trace time — sharing one list with live solo consumers
+            # would expose them to a transiently-cleared schema
+            kernel_schema: list = []
+
+            def kernel(cols, mask_in, pr):
+                if mask_fn is not None:
+                    valid = mask_fn(cols, pr, jn.arange(nb))
+                else:
+                    valid = mask_in
+                outs = []
+                for (func, has_arg), af in zip(agg_specs, arg_fns):
+                    av = an = None
+                    if has_arg and af is not None:
+                        av, an = af(cols, pr)
+                    if func == "count_star":
+                        outs.append((jn.sum(valid.astype(jn.int64))[None],
+                                     jn.zeros(1, dtype=bool)))
+                        continue
+                    live = valid & ~an
+                    if func == "count":
+                        outs.append((jn.sum(live.astype(jn.int64))[None],
+                                     jn.zeros(1, dtype=bool)))
+                    elif func in ("sum", "sum0"):
+                        total = jn.sum(jn.where(live, av, 0))[None]
+                        cnt = jn.sum(live.astype(jn.int64))
+                        outs.append((total, jn.zeros(1, dtype=bool)
+                                     if func == "sum0"
+                                     else (cnt == 0)[None]))
+                    elif func in ("min", "max"):
+                        if av.dtype == jn.int64:
+                            fill = (jn.iinfo(jn.int64).max if func == "min"
+                                    else jn.iinfo(jn.int64).min)
+                        else:
+                            fill = jn.inf if func == "min" else -jn.inf
+                        red = jn.min if func == "min" else jn.max
+                        r = red(jn.where(live, av, fill))[None]
+                        cnt = jn.sum(live.astype(jn.int64))
+                        outs.append((r, (cnt == 0)[None]))
+                    else:  # pragma: no cover
+                        raise ValueError(func)
+                n_valid = jn.sum(valid.astype(jn.int64))
+                first_orig = jn.argmax(valid)[None]
+                items = [n_valid[None], first_orig]
+                for v, m in outs:
+                    items += [v, m]
+                return pack_arrays(kernel_schema, items)
+            return kernel, kernel_schema
+
+        kernel, kernel_schema = make_kernel()
+        return _stackable_jit(kernel, "packed", 2, make_kernel), \
+            kernel_schema
     fn, schema = progcache.get(key, build)
     if rnd is not None and rnd.replaying:
         got = rnd.consume(key, (dev_cols, mask_arr), params)
         if got is not None:
-            _obs.record("dispatches", 1)
-            _obs.record("coalesced", 1)
-            return _unpack_scalar_agg(unpack_flat(got, schema))
+            tag, val = got
+            vals = unpack_host(val, schema) if tag == "host" \
+                else unpack_flat(val, schema)
+            return _unpack_scalar_agg(vals)
     return _unpack_scalar_agg(unpack_flat(
         fn(dev_cols, mask_arr, _params_dev(params)), schema))
 

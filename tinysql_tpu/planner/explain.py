@@ -171,6 +171,15 @@ def _exec_info(st) -> str:
             f"loops:{st.loops}")
 
 
+def _fmt_count(v) -> str:
+    """Counter cell: integers render bare; the occupancy-weighted
+    FRACTIONAL shares a stacked batch member carries (its 1/B slice of
+    the round's one dispatch — ops/batching.py) keep two decimals
+    instead of truncating to a misleading 0."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() else f"{f:.2f}"
+
+
 def _device_info(st) -> str:
     """Device-economics cell: program dispatches, packed D2H transfers/
     bytes, program-cache hits/misses, and the pipeline stage/dispatch/
@@ -178,20 +187,20 @@ def _device_info(st) -> str:
     d = st.device
     parts = []
     if d.get("dispatches"):
-        parts.append(f"dispatches:{int(d['dispatches'])}")
+        parts.append(f"dispatches:{_fmt_count(d['dispatches'])}")
     if d.get("device_s"):
         # MEASURED device busy time (sampling profiler,
         # tidb_device_profile_rate) — distinct from the host wall in
         # execution info, which on a real device times the async submit
         parts.append(f"device:{d['device_s'] * 1e3:.1f}ms"
-                     f"/{int(d.get('profiled_dispatches', 0))}smp")
+                     f"/{_fmt_count(d.get('profiled_dispatches', 0))}smp")
     if d.get("compile_s"):
         parts.append(f"compile:{d['compile_s'] * 1e3:.1f}ms")
     if d.get("d2h_transfers"):
-        parts.append(f"d2h:{int(d['d2h_transfers'])}/"
+        parts.append(f"d2h:{_fmt_count(d['d2h_transfers'])}/"
                      f"{_fmt_bytes(d.get('d2h_bytes', 0))}")
     if d.get("h2d_transfers"):
-        parts.append(f"h2d:{int(d['h2d_transfers'])}/"
+        parts.append(f"h2d:{_fmt_count(d['h2d_transfers'])}/"
                      f"{_fmt_bytes(d.get('h2d_bytes', 0))}")
     hits, misses = d.get("progcache_hits", 0), d.get("progcache_misses", 0)
     if hits or misses:

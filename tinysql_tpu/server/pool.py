@@ -32,8 +32,10 @@ that executed a params-compiled fused dispatch), it pulls every
 same-digest statement already waiting (up to ``tidb_batch_max_size``,
 topping up within ``tidb_batch_window_ms``) and drives the group
 through one batch round: collect (park each member's ParamTable at the
-warm program boundary), dispatch (all ParamTables through the ONE
-compiled program back-to-back), replay (each member consumes its
+warm program boundary), dispatch (stacked — all ParamTables on a
+leading batch axis through ONE vmap-batched program when
+``tidb_batch_stack_max`` >= 2 and the layouts agree; back-to-back
+through the solo program otherwise), replay (each member consumes its
 precomputed output and finishes normally).  Members that never reach a
 batchable dispatch complete solo during collect — fallback is
 transparent.
@@ -388,7 +390,8 @@ class StatementPool:
         """Drive one coalesced group through collect / dispatch / replay
         (module docstring; ops/batching.py has the protocol contract)."""
         from ..ops import batching
-        rnd = batching.BatchRound()
+        rnd = batching.BatchRound(
+            stack_max=self._gvar("tidb_batch_stack_max", 16))
         pending: List[_Entry] = []
         for e in group:
             sess = e.session

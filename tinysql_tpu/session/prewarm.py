@@ -55,6 +55,7 @@ log = logging.getLogger("tinysql_tpu.prewarm")
 #: worker counters for /metrics (tinysql_prewarm_*) and /debug/prewarm
 PREWARM_STATS: Dict[str, int] = {
     "cycles": 0, "families_warmed": 0, "bucket_programs": 0,
+    "stacked_programs": 0,
     "errors": 0, "skipped_cooldown": 0, "skipped_budget": 0,
     "skipped_satisfied": 0,
 }
@@ -286,6 +287,18 @@ class PrewarmWorker:
             for nb in sorted(buckets):
                 _bump("bucket_programs", kernels.prewarm_bucket(nb))
             s.query(sql)
+            # B-bucketed stacked variants of whatever batchable fused
+            # programs the sample just traced (ops/batching.py stacked
+            # dispatch leg): a storm's first multi-member round is then
+            # a plain cache hit at every occupancy bucket up to
+            # tidb_batch_stack_max
+            stack_max = self._int_sysvar("tidb_batch_stack_max", 16)
+            if stack_max >= 2:
+                bs, b = [], 2
+                while b <= kernels.occupancy_bucket(stack_max):
+                    bs.append(b)
+                    b <<= 1
+                _bump("stacked_programs", kernels.prewarm_stacked(bs))
 
     def _ensure_session(self):
         from .session import DEFAULT_SYSVARS, Session

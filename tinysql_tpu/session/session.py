@@ -146,6 +146,11 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # long a worker tops up a forming batch from the queue
     "tidb_batch_max_size": 16,
     "tidb_batch_window_ms": 2,
+    # stacked-params batch execution: max parked members one
+    # vmap-batched dispatch may carry (rounds stack on a leading batch
+    # axis padded to a power-of-two occupancy bucket; 0/1 = legacy
+    # back-to-back ParamTable replays)
+    "tidb_batch_stack_max": 16,
     # ---- time-series metrics ring (obs/tsring.py; GLOBAL scope — the
     # server's background sampler re-reads both every tick) -------------
     # seconds between ring samples (0 pauses the sampler without
@@ -1070,6 +1075,7 @@ class Session:
                      "tidb_admission_mem_limit",
                      "tidb_batch_max_size",
                      "tidb_batch_window_ms",
+                     "tidb_batch_stack_max",
                      "tidb_metrics_interval",
                      "tidb_metrics_retention",
                      "tidb_spill_partitions",

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Stacked-batching smoke (the CI ``batch-smoke`` job, ISSUE 14).
+
+End-to-end assertion chain over a live wire server:
+
+1. warm a same-digest constant-variant family + the B-bucketed stacked
+   program variants (kernels.prewarm_stacked — the auto-prewarm
+   worker's form);
+2. storm the server with concurrent same-digest variants over REAL
+   MySQL-protocol connections until the coalescer forms at least one
+   STACKED round (one vmap-batched dispatch per group);
+3. assert the stacked regime: ``stacked_rounds > 0`` with zero
+   progcache misses across the storm, the storm's dispatches-per-query
+   strictly UNDER 1.0 (the one-dispatch-per-N payoff), and storm
+   results equal to solo execution;
+4. the observability surface: ``tinysql_batch_stacked_rounds_total`` /
+   ``tinysql_batch_stacked_occupancy_sum`` on /metrics, and an induced
+   ``batching-degraded`` finding over a synthetic fallback-heavy ring.
+
+Exit 0 on success; prints one line per check.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+sys.path.insert(0, os.path.join(REPO_ROOT, "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    print(f"[batch-smoke] {'ok' if ok else 'FAIL'}: {name}"
+          f"{' — ' + detail if detail else ''}")
+    if not ok:
+        sys.exit(1)
+
+
+def main() -> int:
+    from test_server import MiniClient
+    from tinysql_tpu.kv import new_mock_storage
+    from tinysql_tpu.ops import batching, kernels, progcache
+    from tinysql_tpu.server.server import Server
+    from tinysql_tpu.session.session import Session
+
+    storage = new_mock_storage()
+    srv = Server(storage, port=0)
+    srv.start()
+    boot = Session(storage)
+    boot.execute("create database bs")
+    boot.execute("use bs")
+    boot.execute("create table t (a int primary key, b int, c double)")
+    boot.execute("insert into t values " + ", ".join(
+        f"({i}, {i % 37}, {i * 0.75})" for i in range(5000)))
+    boot.execute("set global tidb_tpu_min_rows = 16")
+    boot.execute("set global tidb_batch_window_ms = 25")
+    boot.execute("set global tidb_stmt_pool_size = 2")
+    boot.execute("select a, b, c from t")  # hydrate the replica
+
+    qs = [f"select sum(c), count(*) from t where b < {4 + i}"
+          for i in range(12)]
+    solo = {}
+    warm = Session(storage)
+    warm.execute("use bs")
+    for q in qs:
+        solo[q] = warm.query(q).rows  # warm + teach the family
+    n_var = kernels.prewarm_stacked()
+    check("stacked variants prewarmed", n_var > 0, f"{n_var} programs")
+    digest_ok = batching.have_families()
+    check("digest family learned", digest_ok)
+
+    # ---- storm over the wire -------------------------------------------
+    errs, mismatches = [], []
+    done = [0]
+    mu = threading.Lock()
+
+    def client(jobs):
+        try:
+            c = MiniClient(srv.port, db="bs")
+            for q in jobs:
+                _, rows = c.query(q)
+                want = [[f"{float(v):.12g}" for v in r] for r in solo[q]]
+                got = [[f"{float(v):.12g}" for v in r] for r in rows]
+                if want != got:
+                    mismatches.append((q, want, got))
+                with mu:
+                    done[0] += 1
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errs.append(repr(e))
+
+    st0 = batching.stats_snapshot()
+    stacked = False
+    for _attempt in range(4):
+        miss0 = progcache.stats_snapshot()["misses"]
+        disp0 = kernels.stats_snapshot()["dispatches"]
+        n0 = done[0]
+        threads = [threading.Thread(
+            target=client, args=([qs[(i + j * 5) % len(qs)]
+                                  for j in range(3)],))
+            for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        st = batching.stats_snapshot()
+        misses = progcache.stats_snapshot()["misses"] - miss0
+        dispatches = kernels.stats_snapshot()["dispatches"] - disp0
+        statements = done[0] - n0
+        if st["stacked_rounds"] > st0["stacked_rounds"]:
+            stacked = True
+            break
+        print(f"[batch-smoke] attempt {_attempt + 1}: no stacked round "
+              f"yet, retrying", file=sys.stderr)
+    check("no client errors", not errs, "; ".join(errs[:3]))
+    check("storm == solo results", not mismatches, str(mismatches[:1]))
+    check("stacked round formed", stacked, str(st))
+    check("zero storm compiles", misses == 0, f"{misses} misses")
+    dpq = dispatches / max(statements, 1)
+    check("storm dispatches/query < 1.0", dpq < 1.0,
+          f"{dispatches} dispatches / {statements} statements = {dpq:.3f}")
+    occ = (st["stacked_occupancy_sum"] - st0["stacked_occupancy_sum"]) \
+        / max(st["stacked_rounds"] - st0["stacked_rounds"], 1)
+    check("stacked occupancy > 1", occ > 1, f"avg {occ:.2f}")
+
+    # ---- /metrics render ------------------------------------------------
+    from tinysql_tpu.obs.metrics import render_prometheus
+    text = render_prometheus()
+    for name in ("tinysql_batch_stacked_rounds_total",
+                 "tinysql_batch_stacked_occupancy_sum"):
+        check(f"{name} on /metrics", name in text)
+
+    # ---- induced batching-degraded finding ------------------------------
+    from tinysql_tpu.obs import inspect as oinspect
+    from tinysql_tpu.obs.tsring import MetricsRing
+    ring = MetricsRing()
+    n = oinspect.BATCH_DEGRADED_MIN_ATTEMPTS
+    for i in range(3):
+        ring.record({"tinysql_batch_statements_total": n * 0.5 * i / 2,
+                     "tinysql_batch_fallbacks_total": n * 0.5 * i / 2},
+                    now=1000.0 + 10 * i)
+    findings = [f for f in oinspect.run(ring=ring)
+                if f.rule == "batching-degraded"]
+    check("batching-degraded induced", len(findings) == 1
+          and findings[0].severity == "critical",
+          str([f.to_dict() for f in findings]))
+
+    srv.close()
+    print("[batch-smoke] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
