@@ -257,7 +257,9 @@ def test_thread_root_discovery_covers_known_loops():
     for loop in ("StatementPool._worker_loop", "Sampler._loop",
                  "PrewarmWorker._loop", "BlockPipeline._run",
                  "CopClient._run_task", "ClientConn.run",
-                 "Server._accept_loop", "ConprofSampler._loop"):
+                 "Server._accept_loop", "ConprofSampler._loop",
+                 # the C10k event loop (ISSUE 15): server/aio.py
+                 "_Loop._run"):
         assert loop in entries, sorted(entries)
 
 
@@ -273,6 +275,7 @@ def test_thread_spawn_names_classify_to_conprof_roles():
             ("stmt-pool-0", "pool-worker"),      # StatementPool workers
             ("conn-17", "conn"),                 # ClientConn.run threads
             ("mysql-accept", "accept"),          # Server._accept_loop
+            ("aio-loop-0", "aio"),               # aio.py event loops
             ("devpipe-stage", "devpipe"),        # BlockPipeline._run
             ("metrics-sampler", "tsring"),       # tsring Sampler._loop
             ("conprof-sampler", "conprof"),      # ConprofSampler._loop
@@ -293,6 +296,7 @@ def test_thread_spawn_names_classify_to_conprof_roles():
         'name=f"stmt-pool-': "tinysql_tpu/server/pool.py",
         'name=f"conn-': "tinysql_tpu/server/server.py",
         'name="mysql-accept"': "tinysql_tpu/server/server.py",
+        'name=f"aio-loop-': "tinysql_tpu/server/aio.py",
         'name="devpipe-stage"': "tinysql_tpu/executor/devpipe.py",
         'name="metrics-sampler"': "tinysql_tpu/obs/tsring.py",
         'name="conprof-sampler"': "tinysql_tpu/obs/conprof.py",

@@ -443,7 +443,9 @@ EXPECTED_RULES = {"compile-storm", "progcache-hit-rate",
                   # host-CPU truth (ISSUE 13)
                   "cpu-saturation", "profiler-overhead",
                   # stacked-params batching (ISSUE 14)
-                  "batching-degraded"}
+                  "batching-degraded",
+                  # C10k wire front end (ISSUE 15)
+                  "connection-pressure"}
 
 
 def test_rule_catalogue_fully_covered():
@@ -645,6 +647,29 @@ def test_rule_recompile_churn():
                     if x.item == healthy]
     finally:
         stmtsummary.STORE.reset()
+
+
+def test_rule_connection_pressure():
+    n = oinspect.CONN_SHEDS_WARN
+    # some connects refused while most were admitted: warning
+    ring = _ring_with({"tinysql_conn_sheds_total": n,
+                       "tinysql_conn_accepts_total": n * 10})
+    f = _findings(ring, "connection-pressure")
+    assert len(f) == 1 and f[0].severity == "warning"
+    assert f[0].metric == "tinysql_conn_sheds_total"
+    # the window shed MORE than it admitted: critical
+    ring = _ring_with({"tinysql_conn_sheds_total": n * 6,
+                       "tinysql_conn_accepts_total": n * 2})
+    assert _findings(ring, "connection-pressure")[0].severity \
+        == "critical"
+    # under the floor: silent (one refused connect is a retry loop
+    # against a small cap, not pressure)
+    ring = _ring_with({"tinysql_conn_sheds_total": n - 1,
+                       "tinysql_conn_accepts_total": 0})
+    assert not _findings(ring, "connection-pressure")
+    # no sheds at all: silent
+    ring = _ring_with({"tinysql_conn_accepts_total": 50})
+    assert not _findings(ring, "connection-pressure")
 
 
 def test_rule_batching_degraded():

@@ -107,6 +107,7 @@ ROLE_PREFIXES: Tuple[Tuple[str, str], ...] = (
     ("stmt-pool-", "pool-worker"),      # server/pool.py workers
     ("conn-", "conn"),                  # server/server.py per-connection
     ("mysql-accept", "accept"),         # server/server.py accept loop
+    ("aio-loop-", "aio"),               # server/aio.py event loops
     ("devpipe-stage", "devpipe"),       # executor/devpipe.py producer
     ("metrics-sampler", "tsring"),      # obs/tsring.py Sampler
     ("conprof-sampler", "conprof"),     # this module's own sampler

@@ -50,6 +50,9 @@ tests/test_tsring.py):
   fell back to solo dispatch within the window (consume misses —
   replica rotation, plan re-placement, param-layout churn): the
   coalescer is paying its protocol cost without the one-dispatch win;
+- **connection-pressure** (ISSUE 15): the accept gate is refusing
+  connects with MySQL 1040 (``tinysql_conn_sheds_total``); critical
+  when a window sheds more connections than it admits;
 - **cpu-saturation** (ISSUE 13): one thread role dominates the busy
   host-CPU samples (obs/conprof.py) while the admission queue is
   non-empty — the serving tier's latency is host CPU in that role, and
@@ -132,6 +135,11 @@ BATCH_DEGRADED_MIN_ATTEMPTS = 10
 BATCH_DEGRADED_MIN_GROUPS = 5
 BATCH_DEGRADED_WARN = 0.20
 BATCH_DEGRADED_CRIT = 0.50
+
+#: connection-pressure (ISSUE 15): minimum windowed 1040 sheds before
+#: the rule speaks at all — one refused connect is a client retrying
+#: against a deliberately small cap, not pressure
+CONN_SHEDS_WARN = 2
 
 
 class Finding:
@@ -354,6 +362,26 @@ def _rule_pool_saturation(ctx: InspectionContext) -> List[Finding]:
             f"{POOL_QUEUED_WARN}) without shedding: latency is queue "
             "wait, not execution", "tinysql_pool_queued"))
     return out
+
+
+@rule("connection-pressure")
+def _rule_connection_pressure(ctx: InspectionContext) -> List[Finding]:
+    """Sustained 1040 sheds at the accept gate (ISSUE 15): warning
+    while some connects are refused, critical when the window shed MORE
+    connects than it admitted — the wire tier is turning away the
+    majority of new clients."""
+    sheds = ctx.delta("tinysql_conn_sheds_total")
+    if sheds < CONN_SHEDS_WARN:
+        return []
+    accepts = ctx.delta("tinysql_conn_accepts_total")
+    sev = "critical" if sheds > accepts else "warning"
+    return [ctx.evidence(
+        "connection-pressure", "wire", sev,
+        f"{sheds:.0f} connection(s) refused with MySQL 1040 within the "
+        f"window ({accepts:.0f} admitted): tidb_max_server_connections "
+        "is actively shedding connects — raise the cap (the aio front "
+        "end holds idle connections at ~zero thread cost) or add "
+        "serving capacity", "tinysql_conn_sheds_total")]
 
 
 @rule("cooldown-flapping")

@@ -188,6 +188,24 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "tinysql_stmt_mem_inflight_bytes":
         ("gauge", "Aggregate live MemTracker bytes held by RUNNING "
                   "statements (the admission gate's pressure signal)"),
+    # wire front end (server/server.py accept gate + server/aio.py):
+    # the connection-pressure inspection rule's evidence
+    "tinysql_conn_open":
+        ("gauge", "Open wire connections across live servers (both "
+                  "wire modes)"),
+    "tinysql_conn_idle":
+        ("gauge", "Open connections with no statement executing or "
+                  "queued (parked aio file objects / blocked legacy "
+                  "readers)"),
+    "tinysql_conn_active":
+        ("gauge", "Open connections with a statement executing or "
+                  "queued"),
+    "tinysql_conn_accepts_total":
+        ("counter", "Connections admitted at accept (handed to a wire "
+                    "front end)"),
+    "tinysql_conn_sheds_total":
+        ("counter", "Connects refused with MySQL 1040 at accept "
+                    "(tidb_max_server_connections)"),
     # histograms / debug surfaces
     "tinysql_stmt_phase_seconds":
         ("histogram", "Statement latency by phase (statement summary "
@@ -478,6 +496,27 @@ def render_prometheus() -> str:
                  [((), aggregate_stmt_mem())])
         except Exception:
             pass
+    # wire-layer connection economics: the 1040 accept gate's verdicts
+    # (server/admission.py CONN_STATS) + open/idle/active across live
+    # servers — the C10k front end's parked connections are visible here
+    try:
+        from ..server.admission import conn_stats_snapshot
+        from ..server.server import conn_gauges
+        cst = conn_stats_snapshot()
+        cg = conn_gauges()
+    except Exception:
+        cst, cg = {}, None
+    if cst.get("accepts") or cst.get("sheds"):
+        emit("tinysql_conn_accepts_total",
+             METRICS["tinysql_conn_accepts_total"][1], "counter",
+             [((), cst.get("accepts", 0))])
+        emit("tinysql_conn_sheds_total",
+             METRICS["tinysql_conn_sheds_total"][1], "counter",
+             [((), cst.get("sheds", 0))])
+    if cg is not None and cg["open"]:
+        for key in ("open", "idle", "active"):
+            name = f"tinysql_conn_{key}"
+            emit(name, METRICS[name][1], "gauge", [((), cg[key])])
     try:
         from ..server.pool import gauges as pool_gauges
         pg = pool_gauges()

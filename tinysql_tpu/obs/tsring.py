@@ -459,6 +459,18 @@ def _src_pool() -> Dict[str, float]:
             "tinysql_pool_running": g["running"]}
 
 
+def _src_conn() -> Dict[str, float]:
+    from ..server.admission import conn_stats_snapshot
+    from ..server.server import conn_gauges
+    g = conn_gauges()
+    a = conn_stats_snapshot()
+    return {"tinysql_conn_open": g["open"],
+            "tinysql_conn_idle": g["idle"],
+            "tinysql_conn_active": g["active"],
+            "tinysql_conn_accepts_total": a.get("accepts", 0),
+            "tinysql_conn_sheds_total": a.get("sheds", 0)}
+
+
 def _src_admission() -> Dict[str, float]:
     from ..server.admission import aggregate_stmt_mem, stats_snapshot
     a = stats_snapshot()
@@ -571,7 +583,7 @@ def _src_tsring() -> Dict[str, float]:
 
 for _name, _fn in (("queries", _src_queries), ("kernels", _src_kernels),
                    ("progcache", _src_progcache), ("pool", _src_pool),
-                   ("admission", _src_admission),
+                   ("conn", _src_conn), ("admission", _src_admission),
                    ("batching", _src_batching), ("memory", _src_memory),
                    ("spill", _src_spill), ("degrade", _src_degrade),
                    ("failpoints", _src_failpoints),

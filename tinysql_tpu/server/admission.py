@@ -44,6 +44,13 @@ from .. import fail
 #: serving tier's own accounting over any metrics_history window
 STATS = {"admitted": 0, "queued": 0, "rejected": 0,
          "queue_wait_s_sum": 0.0}
+
+#: process-total CONNECTION admission verdicts (the 1040 gate at
+#: accept, both wire modes): accepts = connections handed to a front
+#: end, sheds = connects refused with ERR 1040 before any handshake.
+#: The ``tinysql_conn_accepts/sheds_total`` ring metrics read this —
+#: the connection-pressure inspection rule's evidence.
+CONN_STATS = {"accepts": 0, "sheds": 0}
 _mu = threading.Lock()
 
 
@@ -52,9 +59,19 @@ def _count(key: str, n: int = 1) -> None:
         STATS[key] = STATS.get(key, 0) + n
 
 
+def _count_conn(key: str, n: int = 1) -> None:
+    with _mu:
+        CONN_STATS[key] = CONN_STATS.get(key, 0) + n
+
+
 def stats_snapshot() -> Dict[str, int]:
     with _mu:
         return dict(STATS)
+
+
+def conn_stats_snapshot() -> Dict[str, int]:
+    with _mu:
+        return dict(CONN_STATS)
 
 
 def reset_stats() -> None:
@@ -62,6 +79,8 @@ def reset_stats() -> None:
     with _mu:
         for k in STATS:
             STATS[k] = 0
+        for k in CONN_STATS:
+            CONN_STATS[k] = 0
 
 
 class AdmissionRejected(Exception):
@@ -123,6 +142,18 @@ def check_admit(queue_len: int, queue_cap: int,
             raise AdmissionRejected(
                 f"statement memory pressure: {used} bytes in flight, "
                 f"tidb_admission_mem_limit {mem_limit}")
+
+
+def check_connect(open_count: int, cap: int) -> bool:
+    """The CONNECTION-admission verdict at accept time (both wire
+    modes): True admits (counted), False means the accept loop must
+    refuse with ERR 1040 as the first packet (counted as a shed).
+    ``cap`` is ``tidb_max_server_connections`` (0 = unlimited)."""
+    if cap > 0 and open_count >= cap:
+        _count_conn("sheds")
+        return False
+    _count_conn("accepts")
+    return True
 
 
 def count_admitted() -> None:

@@ -79,6 +79,15 @@ def read_global_int(storage, name: str, default: int) -> int:
         return default
 
 
+def read_global_str(storage, name: str, default: str) -> str:
+    """GLOBAL-scope sysvar as a string (the ``tidb_wire_mode`` read in
+    the accept loop)."""
+    from ..session.session import DEFAULT_SYSVARS
+    g = getattr(storage, "_global_vars", {})
+    v = g.get(name, DEFAULT_SYSVARS.get(name, default))
+    return default if v is None else str(v)
+
+
 def gauges() -> dict:
     """Aggregate queued/running across every live pool (the /metrics
     feed)."""
@@ -110,10 +119,16 @@ class PoolClosed(Exception):
 class _Entry:
     __slots__ = ("session", "stmt", "label", "digest", "done", "result",
                  "error", "state", "queued_at", "batchable", "ctx",
-                 "queued_mono", "claimed_at", "queue_wait_s", "verdict")
+                 "queued_mono", "claimed_at", "queue_wait_s", "verdict",
+                 "on_done")
 
     def __init__(self, session, stmt, label: str, digest: str,
-                 batchable: bool):
+                 batchable: bool, on_done=None):
+        # completion callback for async submitters (the aio front end):
+        # invoked exactly once from complete(), on whatever thread
+        # completed the entry (pool worker, canceller, closer).  It must
+        # only ENQUEUE — socket writes stay on the event loop.
+        self.on_done = on_done
         self.session = session
         self.stmt = stmt
         self.label = label
@@ -158,6 +173,11 @@ class _Entry:
         self.error = error
         self.state = "done"
         self.done.set()
+        if self.on_done is not None:
+            try:
+                self.on_done(self)
+            except Exception:  # a callback bug must not kill the worker
+                log.warning("entry on_done callback failed", exc_info=True)
 
 
 class StatementPool:
@@ -176,14 +196,31 @@ class StatementPool:
     def _gvar(self, name: str, default: int) -> int:
         return read_global_int(self.storage, name, default)
 
-    # ---- submit (connection threads) ------------------------------------
+    # ---- submit (connection threads / event loops) ----------------------
+    def routes_to_pool(self, stmt) -> bool:
+        """Does this statement execute on pool workers?  Control
+        statements (and everything while pooling is off) run directly on
+        the calling thread — the aio front end uses this to decide
+        between async submission and inline execution."""
+        return self._gvar("tidb_stmt_pool_size", 4) > 0 \
+            and isinstance(stmt, _POOLED_STMTS)
+
     def run(self, session, stmt, label: str):
         """Execute one statement with admission control; blocks the
         calling connection thread until the pool completes it.  Control
         statements bypass the pool entirely."""
-        size = self._gvar("tidb_stmt_pool_size", 4)
-        if size <= 0 or not isinstance(stmt, _POOLED_STMTS):
+        if not self.routes_to_pool(stmt):
             return session.execute_stmt(stmt, label)
+        return self._wait(self.submit(session, stmt, label))
+
+    def submit(self, session, stmt, label: str, on_done=None) -> _Entry:
+        """Enqueue one POOLED statement and return its entry without
+        waiting (the aio front end's async half; ``run`` is submit +
+        ``_wait``).  Admission control runs here — a shed statement
+        raises :class:`~.admission.AdmissionRejected` and no entry is
+        ever queued.  ``on_done`` fires exactly once at completion, on
+        the completing thread."""
+        size = self._gvar("tidb_stmt_pool_size", 4)
         digest = ""
         batchable = False
         if isinstance(stmt, ast.SelectStmt) \
@@ -199,7 +236,8 @@ class StatementPool:
                 digest, _ = stmtsummary.normalize(
                     getattr(stmt, "src", "") or label)
                 batchable = batching.family_batchable(digest)
-        entry = _Entry(session, stmt, label, digest, batchable)
+        entry = _Entry(session, stmt, label, digest, batchable,
+                       on_done=on_done)
         with self._cv:
             if self._closed:
                 raise PoolClosed()
@@ -219,7 +257,27 @@ class StatementPool:
             session.queue_ts = entry.queued_at
             self._ensure_workers(size)
             self._cv.notify()
-        return self._wait(entry)
+        return entry
+
+    def cancel_if_queued(self, entry: _Entry,
+                         err: BaseException) -> bool:
+        """KILL / shutdown path for async submitters: remove a
+        still-queued entry and fail it with ``err`` so no worker ever
+        touches it (the aio twin of ``_wait``'s poll-cancel).  Returns
+        False when a worker already claimed the entry — it then finishes
+        through the statement's own interrupt checks."""
+        with self._cv:
+            if entry.state != "queued":
+                return False
+            try:
+                self._queue.remove(entry)
+            except ValueError:
+                return False  # a worker grabbed it between checks
+        # complete OUTSIDE the pool lock: on_done may hand the result to
+        # an event loop (its own lock + wake pipe) — keep the lock order
+        # one-way (pool only ever acquires loop-side state lock-free)
+        self._fail_entry(entry, err)
+        return True
 
     def _wait(self, entry: _Entry):
         """Poll-wait so KILL / shutdown reach a QUEUED statement without

@@ -11,6 +11,7 @@ from __future__ import annotations
 import logging
 import socket
 import threading
+import weakref
 from typing import Dict, Optional
 
 from ..session.session import ResultSet, Session
@@ -18,6 +19,35 @@ from . import protocol as p
 from .packetio import PacketIO
 
 log = logging.getLogger("tinysql_tpu.server")
+
+#: live servers (weak — registry dies with the server): the
+#: ``tinysql_conn_*`` gauges aggregate open/idle/active connections
+#: across every live server the same way pool gauges do for pools.
+_SERVERS: "weakref.WeakSet" = weakref.WeakSet()
+_SERVERS_MU = threading.Lock()
+
+
+def conn_gauges() -> dict:
+    """Aggregate connection gauges across every live server (the
+    ``tinysql_conn_open/idle/active`` ring-metric feed).  A connection
+    is *active* while its session has a statement executing or queued;
+    everything else — parked aio file objects and legacy threads
+    blocked in read alike — is *idle*."""
+    out = {"open": 0, "idle": 0, "active": 0}
+    with _SERVERS_MU:
+        servers = list(_SERVERS)
+    for srv in servers:
+        with srv._mu:
+            ccs = list(srv.conns.values())
+        for cc in ccs:
+            sess = cc.session
+            out["open"] += 1
+            if getattr(sess, "stmt_running", False) or \
+                    getattr(sess, "stmt_state", "") == "queued":
+                out["active"] += 1
+            else:
+                out["idle"] += 1
+    return out
 
 
 def _err_packet_for(e: Exception) -> bytes:
@@ -46,16 +76,31 @@ class ClientConn:
 
     # ---- handshake (reference: conn.go:117,418 — with the scramble
     # verification full TiDB does and tinysql stripped) -------------------
-    def handshake(self) -> bool:
-        import struct
-        from . import auth
-        salt = p.new_salt()
+    def greeting_caps(self) -> int:
         caps = p.SERVER_CAPS
         if self.server.ssl_ctx is not None:
             caps |= p.CLIENT_SSL
-        self.io.write_packet(p.handshake_v10(self.conn_id, salt, caps))
+        return caps
+
+    def handshake(self) -> bool:
+        salt = p.new_salt()
+        self.io.write_packet(p.handshake_v10(self.conn_id, salt,
+                                             self.greeting_caps()))
         try:
             payload = self.io.read_packet()
+        except (ConnectionError, OSError):
+            return False
+        return self.finish_handshake(salt, payload)
+
+    def finish_handshake(self, salt: bytes, payload: bytes) -> bool:
+        """Everything after the greeting round-trip: optional TLS
+        upgrade, response parse, scramble verification, initial USE.
+        Split out so the aio front end (which frames the first response
+        itself, nonblocking) shares one auth path with the legacy
+        blocking read above."""
+        import struct
+        from . import auth
+        try:
             # SSLRequest (reference: conn.go:448-455 readOptionalSSLRequest
             # + upgradeToTLS :1070): the protocol-41 SSLRequest is the
             # 32-byte response prefix (caps, max-packet, charset, filler)
@@ -101,9 +146,41 @@ class ClientConn:
         return True
 
     # ---- command loop (reference: conn.go:541,667) ----------------------
-    def run(self) -> None:
+    def dispatch_command(self, cmd: int, payload: bytes) -> None:
+        """One non-QUIT command's dispatch + response, shared by the
+        legacy thread loop below and the aio front end (which frames
+        commands itself and intercepts COM_QUERY for async pool
+        submission before ever calling here)."""
+        if cmd == p.COM_PING:
+            self.io.write_packet(p.ok_packet())
+        elif cmd == p.COM_INIT_DB:
+            db = payload.decode("utf-8", "replace")
+            self._run_sql(f"use `{db}`")
+        elif cmd == p.COM_QUERY:
+            self._run_sql(payload.decode("utf-8", "replace"))
+        elif cmd == p.COM_FIELD_LIST:
+            self._handle_field_list(payload)
+        elif cmd == p.COM_STMT_PREPARE:
+            self._handle_stmt_prepare(payload)
+        elif cmd == p.COM_STMT_EXECUTE:
+            self._handle_stmt_execute(payload)
+        elif cmd == p.COM_STMT_CLOSE:
+            import struct
+            self._stmts.pop(
+                struct.unpack_from("<I", payload, 0)[0], None)
+            # COM_STMT_CLOSE sends no response
+        else:
+            self.io.write_packet(
+                p.err_packet(1047, f"unknown command {cmd}"))
+
+    def run(self, pre=None) -> None:
+        """The per-connection thread body.  ``pre=(salt, payload)``
+        resumes a handshake whose greeting round-trip already happened
+        on the event loop (the aio front end's TLS handoff)."""
         try:
-            if not self.handshake():
+            ok = self.finish_handshake(*pre) if pre is not None \
+                else self.handshake()
+            if not ok:
                 return
             while self.alive:
                 self.io.reset_sequence()
@@ -117,27 +194,7 @@ class ClientConn:
                 if cmd == p.COM_QUIT:
                     return
                 try:
-                    if cmd == p.COM_PING:
-                        self.io.write_packet(p.ok_packet())
-                    elif cmd == p.COM_INIT_DB:
-                        db = payload.decode("utf-8", "replace")
-                        self._run_sql(f"use `{db}`")
-                    elif cmd == p.COM_QUERY:
-                        self._run_sql(payload.decode("utf-8", "replace"))
-                    elif cmd == p.COM_FIELD_LIST:
-                        self._handle_field_list(payload)
-                    elif cmd == p.COM_STMT_PREPARE:
-                        self._handle_stmt_prepare(payload)
-                    elif cmd == p.COM_STMT_EXECUTE:
-                        self._handle_stmt_execute(payload)
-                    elif cmd == p.COM_STMT_CLOSE:
-                        import struct
-                        self._stmts.pop(
-                            struct.unpack_from("<I", payload, 0)[0], None)
-                        # COM_STMT_CLOSE sends no response
-                    else:
-                        self.io.write_packet(
-                            p.err_packet(1047, f"unknown command {cmd}"))
+                    self.dispatch_command(cmd, payload)
                 except ConnectionError:
                     return
                 except Exception as e:  # one bad command != dead conn
@@ -376,6 +433,12 @@ class Server:
         self.conns: Dict[int, ClientConn] = {}
         self._mu = threading.Lock()
         self._closed = threading.Event()
+        # event-loop front end (server/aio.py): created lazily on the
+        # first connection accepted while tidb_wire_mode = 'aio', so a
+        # legacy-mode server spawns zero aio threads
+        self._aio = None
+        with _SERVERS_MU:
+            _SERVERS.add(self)
 
     def start(self) -> int:
         """Bind + accept loop in a background thread; returns bound port."""
@@ -414,7 +477,27 @@ class Server:
         return read_global_int(self.storage,
                                "tidb_max_server_connections", 0)
 
+    def wire_mode(self) -> str:
+        """The live GLOBAL ``tidb_wire_mode``: ``legacy`` =
+        thread-per-connection, ``aio`` = event-loop front end.  Read
+        per accepted connection, so a mid-server flip applies to every
+        NEW connection while established ones keep their mode."""
+        from .pool import read_global_str
+        return read_global_str(self.storage, "tidb_wire_mode",
+                               "legacy").strip().lower()
+
+    def aio_frontend(self):
+        """The event-loop front end, started on first use."""
+        with self._mu:
+            fe = self._aio
+            if fe is None:
+                from .aio import AioFrontEnd
+                fe = self._aio = AioFrontEnd(self)
+        fe.start()
+        return fe
+
     def _accept_loop(self) -> None:
+        from . import admission
         while not self._closed.is_set():
             try:
                 conn, addr = self.sock.accept()
@@ -422,8 +505,12 @@ class Server:
                 return
             cap = self._max_connections()
             with self._mu:
-                over_cap = cap > 0 and len(self.conns) >= cap
-            if over_cap:
+                n_open = len(self.conns)
+            # the connection-admission gate (server/admission.py): the
+            # 1040 verdict and its accept/shed accounting live with the
+            # 1041 statement gate, and run AT ACCEPT — before any
+            # handshake work — in both wire modes
+            if not admission.check_connect(n_open, cap):
                 # MySQL refuses over-cap connects with ERR 1040 as the
                 # FIRST packet (no handshake) — the unbounded accept
                 # loop was a trivial DoS before this gate
@@ -440,8 +527,13 @@ class Server:
             cc = ClientConn(self, conn)
             with self._mu:
                 self.conns[cc.conn_id] = cc
-            threading.Thread(target=cc.run, daemon=True,
-                             name=f"conn-{cc.conn_id}").start()
+            if self.wire_mode() == "aio":
+                # event-loop front end: the connection parks as a
+                # registered file object — no thread is ever spawned
+                self.aio_frontend().adopt(cc)
+            else:
+                threading.Thread(target=cc.run, daemon=True,
+                                 name=f"conn-{cc.conn_id}").start()
 
     def remove_conn(self, cid: int) -> None:
         with self._mu:
@@ -450,6 +542,10 @@ class Server:
     def close(self) -> None:
         """Graceful drain (reference: server.go:155-283)."""
         self._closed.set()
+        with self._mu:
+            fe = self._aio
+        if fe is not None:
+            fe.close()
         self.pool.close()
         self.prewarm.close()
         self.metrics_sampler.close()

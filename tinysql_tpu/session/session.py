@@ -129,6 +129,19 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # accept-loop connection cap: further connects get MySQL 1040
     # "Too many connections" before the handshake (0 = unlimited)
     "tidb_max_server_connections": 0,
+    # wire front end for NEW connections (server/server.py reads it per
+    # accept): 'legacy' = thread-per-connection, 'aio' = the event-loop
+    # front end (server/aio.py) parking idle connections as registered
+    # file objects — the C10k path.  Flippable mid-server; established
+    # connections keep the mode they were accepted under
+    "tidb_wire_mode": "legacy",
+    # event-loop thread count for the aio front end (>= 1; read once at
+    # front-end start — the first aio-mode accept)
+    "tidb_aio_loops": 1,
+    # slowloris guard: a connection stalled mid-handshake or mid-frame
+    # (partial packet buffered) longer than this is closed (0 = off).
+    # Parked IDLE connections — no partial frame — never time out
+    "tidb_aio_frame_timeout_ms": 10000,
     # statement-execution pool: worker-thread count for pooled
     # statements (SELECT/INSERT/DELETE over the wire; 0 = pooling off,
     # statements run on their connection thread unbounded)
@@ -1070,6 +1083,8 @@ class Session:
                      "tidb_auto_prewarm_budget_ms",
                      "tidb_auto_prewarm_cooldown",
                      "tidb_max_server_connections",
+                     "tidb_aio_loops",
+                     "tidb_aio_frame_timeout_ms",
                      "tidb_stmt_pool_size",
                      "tidb_stmt_pool_queue_depth",
                      "tidb_admission_mem_limit",
@@ -1134,6 +1149,17 @@ class Session:
                         f"Variable '{name}' can't be set to the value "
                         f"of '{v}'", mysql_code=1231, sqlstate="42000")
                 v = fv
+            if name == "tidb_wire_mode":
+                # enum validated at SET time (reference: sysvar type
+                # validation): the accept loop reads this per connection
+                # and must never see a junk mode
+                mv = str(v).strip().lower() if v is not None else ""
+                if mv not in ("legacy", "aio"):
+                    raise SessionError(
+                        f"Variable 'tidb_wire_mode' can't be set to the "
+                        f"value of '{v}'", mysql_code=1231,
+                        sqlstate="42000")
+                v = mv
             if name == "tidb_failpoints":
                 # validate + apply atomically BEFORE storing: a bad spec
                 # must fail the SET and leave the armed set unchanged

@@ -167,6 +167,30 @@ def executing_threads() -> Dict[int, object]:
     return out
 
 
+#: kill observers (the aio front end's wake hook): a parked idle
+#: connection has NO blocked reader thread to notice ``session.killed``,
+#: so the event loop registers a callback here and :func:`kill` invokes
+#: it AFTER the flags flip — the loop's self-pipe then closes the victim
+#: within one tick.  Callbacks must only enqueue/wake, never block.
+_KILL_OBSERVERS: list = []
+_obs_mu = threading.Lock()
+
+
+def add_kill_observer(fn) -> None:
+    """Register ``fn(conn_id, query_only)`` to run after every kill."""
+    with _obs_mu:
+        if fn not in _KILL_OBSERVERS:
+            _KILL_OBSERVERS.append(fn)
+
+
+def remove_kill_observer(fn) -> None:
+    with _obs_mu:
+        try:
+            _KILL_OBSERVERS.remove(fn)
+        except ValueError:
+            pass
+
+
 def kill(conn_id: int, query_only: bool = True) -> bool:
     """KILL [QUERY] <conn_id>.  Returns False when the id is unknown.
     ``query_only=False`` (plain KILL) also marks the session killed so
@@ -179,4 +203,11 @@ def kill(conn_id: int, query_only: bool = True) -> bool:
         guard.kill()
     if not query_only:
         sess.killed = True
+    with _obs_mu:
+        observers = list(_KILL_OBSERVERS)
+    for fn in observers:
+        try:
+            fn(conn_id, query_only)
+        except Exception:  # a wake-hook bug must not fail the KILL
+            pass
     return True

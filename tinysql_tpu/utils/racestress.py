@@ -253,6 +253,7 @@ AUDIT_CATALOG = [
     ("tinysql_tpu.ops.progcache", "_REG", "_mu"),
     ("tinysql_tpu.ops.progcache", "_CATALOG", "_mu"),
     ("tinysql_tpu.server.admission", "STATS", "_mu"),
+    ("tinysql_tpu.server.admission", "CONN_STATS", "_mu"),
     ("tinysql_tpu.session.prewarm", "PREWARM_STATS", "_STATS_MU"),
     ("tinysql_tpu.obs.tsring", "_SOURCES", "_src_mu"),
     ("tinysql_tpu.fail", "_ACTIVE", "_mu"),

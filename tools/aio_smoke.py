@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""C10k front-end smoke (the CI ``aio-smoke`` job, ISSUE 15).
+
+End-to-end assertion chain over a live wire server in
+``tidb_wire_mode = 'aio'``:
+
+1. park a batch of mostly-idle connections on the event loop and prove
+   the C10k property: server thread count does NOT scale with
+   connection count (no ``conn-<id>`` readers exist);
+2. serve query round-trips and a multi-statement COM_QUERY through the
+   async loop->pool driver;
+3. the serving invariants over the wire: parked connections as
+   processlist Sleep rows, an over-cap connect refused with a typed
+   1040 FIRST packet, a wedged pool shedding 1041 + retry hint while
+   the control plane (SET/KILL through the loop) keeps answering;
+4. KILL on a parked IDLE connection closes its socket within one loop
+   tick (the self-pipe wake — no reader thread exists to notice);
+5. a statement split across tiny writes reassembles (partial-frame
+   pump) and a half-open peer is reaped by the slowloris timeout;
+6. the observability surface: ``tinysql_conn_*`` gauges/counters on
+   /metrics and the ``aio`` role in the conprof vocabulary.
+
+Exit 0 on success; prints one line per check.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+sys.path.insert(0, os.path.join(REPO_ROOT, "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_CONNS = int(os.environ.get("AIO_SMOKE_CONNS", "128"))
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    print(f"[aio-smoke] {'ok' if ok else 'FAIL'}: {name}"
+          f"{' — ' + detail if detail else ''}")
+    if not ok:
+        sys.exit(1)
+
+
+def main() -> int:
+    from test_server import MiniClient
+    from tinysql_tpu import fail
+    from tinysql_tpu.kv import new_mock_storage
+    from tinysql_tpu.server.packetio import PacketIO
+    from tinysql_tpu.server.server import Server
+    from tinysql_tpu.session.session import Session
+
+    storage = new_mock_storage()
+    srv = Server(storage, port=0)
+    srv.start()
+    boot = Session(storage)
+    boot.execute("set global tidb_wire_mode = 'aio'")
+    boot.execute("create database if not exists sm")
+    boot.execute("use sm")
+    boot.execute("create table t (a int primary key, b int)")
+    boot.execute("insert into t values " + ", ".join(
+        f"({i}, {i % 7})" for i in range(500)))
+
+    # 1. the C10k property: N parked connections, ~zero extra threads
+    before = threading.active_count()
+    conns = [MiniClient(srv.port, db="sm") for _ in range(N_CONNS)]
+    held = threading.active_count()
+    conn_threads = [t.name for t in threading.enumerate()
+                    if t.name.startswith("conn-")]
+    check("bounded threads", held - before <= 4 and not conn_threads,
+          f"{N_CONNS} conns: {before} -> {held} threads, "
+          f"conn readers: {conn_threads}")
+
+    # 2. round-trips through the async driver
+    cols, rows = conns[0].query("select count(*) from t where b = 3")
+    check("query round-trip", rows == [["71"]], f"{cols} {rows}")
+    check("dml round-trip",
+          conns[1].query("insert into t values (1000, 1)") == 1)
+
+    # 3a. parked connections are processlist citizens
+    _, pl = conns[2].query(
+        "select id, command from information_schema.processlist")
+    sleeping = sum(1 for r in pl if r[1] == "Sleep")
+    check("processlist parked rows", sleeping >= N_CONNS - 2,
+          f"{sleeping} Sleep rows of {len(pl)}")
+
+    # 3b. over-cap connect -> typed 1040 first packet
+    boot.execute(
+        f"set global tidb_max_server_connections = {len(srv.conns)}")
+    s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+    d = PacketIO(s).read_packet()
+    s.close()
+    boot.execute("set global tidb_max_server_connections = 0")
+    check("1040 at accept",
+          d[0] == 0xFF and struct.unpack_from("<H", d, 1)[0] == 1040,
+          repr(d[:16]))
+
+    # 3c. wedged pool: 1041 shed over the loop, control plane alive
+    boot.execute("set global tidb_stmt_pool_size = 1")
+    boot.execute("set global tidb_stmt_pool_queue_depth = 1")
+    fail.arm("admissionDelay", sleep=0.8, times=2)
+    box = []
+    ts = [threading.Thread(
+        target=lambda c=c: box.append(c.query("select count(*) from t")))
+        for c in conns[3:5]]
+    ts[0].start()
+    time.sleep(0.2)
+    ts[1].start()
+    time.sleep(0.2)
+    shed = ""
+    try:
+        conns[5].query("select count(*) from t")
+    except RuntimeError as e:
+        shed = str(e)
+    check("1041 + retry hint over the loop",
+          "1041" in shed and "retry" in shed, shed)
+    # the control plane answers while the pool is wedged
+    check("control plane alive under wedge",
+          conns[6].query("show databases")[1] is not None)
+    for t in ts:
+        t.join(30)
+    fail.disarm("admissionDelay")
+    boot.execute("set global tidb_stmt_pool_size = 4")
+    boot.execute("set global tidb_stmt_pool_queue_depth = 64")
+
+    # 4. KILL on a parked idle connection closes within one tick
+    victim = conns.pop()
+    victim.query("select 1")
+    victim_id = max(srv.conns)
+    t0 = time.monotonic()
+    conns[0].query(f"kill {victim_id}")
+    victim.sock.settimeout(3)
+    try:
+        data = victim.sock.recv(1)
+    except OSError:
+        data = b""
+    check("KILL-idle closes promptly",
+          data == b"" and time.monotonic() - t0 < 1.0,
+          f"{time.monotonic() - t0:.3f}s")
+
+    # 5a. partial-frame reassembly: drip-fed statement answers
+    c = conns[1]
+    sql = b"\x03" + b"select 41 + 1"
+    frame = struct.pack("<I", len(sql))[:3] + b"\x00" + sql
+    for i in range(0, len(frame), 3):
+        c.sock.sendall(frame[i:i + 3])
+        time.sleep(0.01)
+    first = c.io.read_packet()
+    c.io.read_packet()
+    c.io.read_packet()
+    row = c.io.read_packet()
+    c.io.read_packet()
+    check("partial-frame reassembly", b"42" in row, repr(row))
+
+    # 5b. slowloris: a half-open peer is reaped on the frame timeout
+    boot.execute("set global tidb_aio_frame_timeout_ms = 300")
+    s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+    PacketIO(s).read_packet()  # greeting, then silence
+    s.settimeout(3)
+    t0 = time.monotonic()
+    try:
+        reaped = s.recv(1) == b""
+    except OSError:
+        reaped = False
+    check("slowloris reap", reaped and time.monotonic() - t0 < 2.5,
+          f"{time.monotonic() - t0:.3f}s")
+    s.close()
+    boot.execute("set global tidb_aio_frame_timeout_ms = 10000")
+
+    # 6. observability: tinysql_conn_* on /metrics, aio conprof role
+    from tinysql_tpu.obs.metrics import render_prometheus
+    text = render_prometheus()
+    check("conn metrics exported",
+          "tinysql_conn_open" in text
+          and "tinysql_conn_accepts_total" in text
+          and "tinysql_conn_sheds_total" in text)
+    from tinysql_tpu.obs.conprof import classify
+    check("aio conprof role", classify("aio-loop-0") == "aio")
+
+    for c in conns:
+        try:
+            c.close()
+        except Exception:
+            pass
+    srv.close()
+    print("[aio-smoke] OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

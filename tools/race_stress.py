@@ -1,9 +1,10 @@
 #!/usr/bin/env python
 """race_stress — the dynamic verifier behind qlint's CC7xx pass.
 
-Replays the concurrency-heavy test subset (chaos + serve + spill, the
-suites that exercise the statement pool, devpipe producers, the tsring
-sampler, spill eviction, and the failpoint ladder) in
+Replays the concurrency-heavy test subset (chaos + serve + spill +
+aio — the suites that exercise the statement pool, devpipe producers,
+the tsring sampler, spill eviction, the failpoint ladder, and the
+event-loop wire front end's loop->pool handoff) in
 ``TINYSQL_RACE_STRESS`` mode:
 
 - ``sys.setswitchinterval`` shrunk ~250x (preemption every few hundred
@@ -38,6 +39,7 @@ SUBSETS = {
     "chaos": "tests/test_chaos.py",
     "serve": "tests/test_serve.py",
     "spill": "tests/test_spill.py",
+    "aio": "tests/test_aio.py",
 }
 
 
@@ -45,8 +47,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="race_stress", description=__doc__)
     ap.add_argument("tests", nargs="*",
                     help="explicit test paths (override --subset)")
-    ap.add_argument("--subset", default="chaos,serve,spill",
-                    help="named subsets to replay (default: all three)")
+    ap.add_argument("--subset", default="chaos,serve,spill,aio",
+                    help="named subsets to replay (default: all four)")
     ap.add_argument("--report", default="race_stress_report.json",
                     help="where to write the JSON report")
     ap.add_argument("--switch", default=None,
