@@ -43,6 +43,26 @@ if _RACE_STRESS:
     _racestress.install()
     _racestress.audit_known()
 
+# TINYSQL_XFER_AUDIT: arm the dynamic transfer verifier BEFORE any
+# tinysql_tpu module is imported — the interposed jnp.asarray/device_get
+# must be in place when kernels first resolves them (tools/
+# transfer_audit.py drives this; utils/xferaudit.py implements it).
+# Same file-path load as racestress: a package import would construct
+# engine module state before install() runs.
+_XFER_AUDIT = os.environ.get("TINYSQL_XFER_AUDIT")
+if _XFER_AUDIT:
+    import importlib.util as _ilu
+    import sys as _sys
+    _xa_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tinysql_tpu", "utils", "xferaudit.py")
+    _spec = _ilu.spec_from_file_location(
+        "tinysql_tpu.utils.xferaudit", _xa_path)
+    _xferaudit = _ilu.module_from_spec(_spec)
+    _sys.modules["tinysql_tpu.utils.xferaudit"] = _xferaudit
+    _spec.loader.exec_module(_xferaudit)
+    _xferaudit.install()
+
 
 import threading as _threading
 import time as _time
@@ -57,6 +77,10 @@ def pytest_sessionfinish(session, exitstatus):
         path = os.environ.get("TINYSQL_RACE_STRESS_REPORT")
         if path:
             _racestress.write_report(path)
+    if _XFER_AUDIT:
+        path = os.environ.get("TINYSQL_XFER_AUDIT_REPORT")
+        if path:
+            _xferaudit.write_report(path)
 
 
 @_pytest.fixture(autouse=True, scope="module")

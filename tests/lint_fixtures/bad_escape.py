@@ -1,0 +1,20 @@
+"""qlint DF804 fixture: device arrays escaping into module-level
+containers outside the registered cache owners — HBM pinned for the
+process lifetime.  The function-local container twin stays clean."""
+import numpy as np
+
+from tinysql_tpu.ops import kernels
+
+_STASH = {}
+_HISTORY = []
+
+
+def remember(name, vals):
+    _STASH[name] = kernels.h2d(np.array(vals))    # DF804: keyed escape
+    _HISTORY.append(kernels.h2d(np.array(vals)))  # DF804: append escape
+
+
+def local_ok(vals):
+    tmp = {}
+    tmp["x"] = kernels.h2d(np.array(vals))  # local scope: clean
+    return tmp

@@ -1,0 +1,21 @@
+"""qlint DF803 fixture: a value-derived (non-shape) scalar minted into
+a progcache key — every distinct literal compiles a fresh program.  The
+bucketed twin launders the value through ``kernels.bucket`` and stays
+clean (the sanctioned two-phase idiom)."""
+from tinysql_tpu.ops import kernels, progcache
+
+
+def _build():
+    return None
+
+
+def compile_for_literal(expr):
+    lo = expr.value                       # value-derived, not shape
+    key = ("filter_lit", int(lo))
+    return progcache.get(key, _build)     # DF803: per-literal mint
+
+
+def compile_bucketed(n_rows):
+    nb = kernels.bucket(int(n_rows))      # bucketing -> shape-stable
+    key = ("filter_bucket", nb)
+    return progcache.get(key, _build)     # clean twin

@@ -1,0 +1,28 @@
+"""qlint DF801 fixture: hidden host syncs on device-tainted values
+inside a dispatch-hot region (an executor ``next`` loop).  The cold
+helper performs the SAME raw sync outside any hot root and stays clean,
+and the counted-d2h twin inside the hot loop stays clean too."""
+import numpy as np
+
+from tinysql_tpu.ops import kernels
+
+
+class HotExec:
+    def next(self):
+        dev = kernels.h2d(np.arange(8))
+        rows = np.asarray(dev)      # DF801: uncounted blocking download
+        total = float(dev.sum())    # DF801: hidden scalar sync
+        tail = dev.tolist()         # DF801: hidden sync
+        return rows, total, tail
+
+
+class CleanExec:
+    def next(self):
+        dev = kernels.h2d(np.arange(8))
+        return kernels.d2h(dev)     # counted + span-attributed: clean
+
+
+def cold_report():
+    # same raw sync OUTSIDE the dispatch-hot set: DF801 stays silent
+    dev = kernels.h2d(np.arange(8))
+    return np.asarray(dev)

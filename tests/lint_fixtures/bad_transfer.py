@@ -1,0 +1,18 @@
+"""qlint DF802 fixture: raw device-upload entry points outside
+ops/kernels.py — transfers the h2d counters never see.  The counted
+twin stays clean."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tinysql_tpu.ops import kernels
+
+
+def upload_raw(vals):
+    a = jnp.asarray(np.array(vals))       # DF802: implicit upload
+    b = jax.device_put(np.array(vals))    # DF802: raw device_put
+    return a, b
+
+
+def upload_counted(vals):
+    return kernels.h2d(np.array(vals))    # counted wrapper: clean

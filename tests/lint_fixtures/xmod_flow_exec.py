@@ -1,0 +1,11 @@
+"""qlint DF8xx cross-module fixture, half 2: the executor loop that
+makes the OTHER module's helper dispatch-hot.  Alone this file is clean
+(``helper.pull``'s body is invisible); with both files in the batch the
+raw sync inside the helper is reachable from ``next`` and DF801 fires
+THERE — in the other module."""
+import xmod_flow_helper as helper
+
+
+class Exec:
+    def next(self):
+        return helper.pull()

@@ -1,0 +1,17 @@
+"""qlint DF8xx cross-module fixture, half 1: a helper module whose
+device-returning function performs a raw host sync.  THIS FILE ALONE IS
+CLEAN — ``pull`` only becomes dispatch-hot once some executor's ``next``
+loop calls it, and that loop lives in xmod_flow_exec.py.  The union
+flagging what each half hides is what proves DF8xx is whole-program."""
+import numpy as np
+
+from tinysql_tpu.ops import kernels
+
+
+def make_dev():
+    return kernels.h2d(np.arange(16))
+
+
+def pull():
+    dev = make_dev()          # device taint via the helper's RETURN
+    return np.asarray(dev)    # DF801 — but only when pull is hot

@@ -132,3 +132,26 @@ def test_statement_rollback_keeps_counts_exact(tk):
     rows = _explain(tk, "select * from t")
     scan = [r for r in rows if r[0].strip().startswith("TableScan")][0]
     assert scan[1] == "20.00", rows
+
+
+def test_device_info_cell_renders_fractional_transfer_shares():
+    """A stacked-round member carries 1/B shares of the round's one
+    dispatch AND of its transfer counters (ops/batching.py splits by
+    occupancy): the h2d:/d2h: cells must render those fractions — the
+    old int() truncation at the B unit turned a 170.67B share into
+    170B, so member cells no longer summed to the round's total."""
+    from types import SimpleNamespace
+
+    from tinysql_tpu.planner.explain import _device_info, _fmt_bytes
+
+    st = SimpleNamespace(device={
+        "dispatches": 1 / 3,
+        "h2d_transfers": 1 / 3, "h2d_bytes": 512 / 3,
+        "d2h_transfers": 1 / 3, "d2h_bytes": 64.0})
+    cell = _device_info(st)
+    assert "dispatches:0.33" in cell, cell
+    assert "h2d:0.33/170.67B" in cell, cell
+    assert "d2h:0.33/64B" in cell, cell  # integer bytes stay bare
+    # the unit ladder above the byte tier is unchanged
+    assert _fmt_bytes(2048) == "2.0KB"
+    assert _fmt_bytes(3.5 * 1024 * 1024) == "3.5MB"

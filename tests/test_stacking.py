@@ -187,6 +187,16 @@ def test_device_time_attribution_conserved_stacked(server):
     assert all(t.get("device_s", 0.0) > 0 for t in totals)
     shares = {round(t["device_s"], 12) for t in totals}
     assert len(shares) == 1  # occupancy-weighted: equal splits
+    # transfer counters conserve the same way (ISSUE 16): the round's
+    # uploads/downloads are split across members, never duplicated or
+    # dropped — the static DF802 pass guarantees every transfer goes
+    # through the counted wrappers, THIS asserts the attribution side
+    for key in ("h2d_transfers", "h2d_bytes",
+                "d2h_transfers", "d2h_bytes"):
+        delta = d1[key] - d0[key]
+        assert delta > 0, key  # a round moves real data both ways
+        assert sum(t.get(key, 0) for t in totals) \
+            == pytest.approx(delta, rel=1e-9), key
 
 
 def test_device_time_attribution_conserved_legacy(server):
@@ -204,6 +214,15 @@ def test_device_time_attribution_conserved_legacy(server):
         == pytest.approx(dev_delta, rel=1e-9)
     st = batching.stats_snapshot()
     assert all(t.get("dispatches") == 1 for t in totals)
+    # the legacy leg must conserve transfers too — each member's solo
+    # replay owns whole (integer) transfer counts rather than stacked
+    # fractional shares, but the sum-to-global-delta contract is shared
+    for key in ("h2d_transfers", "h2d_bytes",
+                "d2h_transfers", "d2h_bytes"):
+        delta = d1[key] - d0[key]
+        assert delta > 0, key
+        assert sum(t.get(key, 0) for t in totals) \
+            == pytest.approx(delta, rel=1e-9), key
 
 
 # =========================================================================

@@ -32,6 +32,14 @@ Three passes over the invariants nothing else checks mechanically:
   per-class maps), lock-order deadlock cycles (CC702),
   blocking-under-lock (CC703), and context-hop discipline for thread
   spawns (CC704).  Its dynamic twin is ``tools/race_stress.py``.
+- **device-flow** (`device_flow.py`, DF8xx): the second WHOLE-PROGRAM
+  pass — interprocedural device-array taint from the counted-wrapper
+  birth sites, enforcing hidden-host-sync (DF801), uncounted-transfer
+  (DF802), progcache-key retrace-hazard (DF803), and device-buffer-
+  escape (DF804) discipline over the dispatch-hot reachability set.
+  Its dynamic twin is ``tools/transfer_audit.py`` (utils/xferaudit.py
+  interposes jax's transfer entry points and reconciles observed
+  transfers against the kernels.STATS counters).
 
 Every pass honors inline suppressions with REQUIRED justification text:
 
@@ -40,6 +48,7 @@ Every pass honors inline suppressions with REQUIRED justification text:
 See docs/LINT.md and tools/lint.py.
 """
 from .concurrency import lint_concurrency, thread_roots
+from .device_flow import lint_device_flow
 from .diag import (Diagnostic, Severity, SourceFile, format_diagnostics,
                    gather_sources)
 from .fail_discipline import lint_fail_discipline
@@ -52,5 +61,6 @@ __all__ = [
     "Diagnostic", "Severity", "SourceFile", "format_diagnostics",
     "gather_sources", "lint_trace_safety", "lint_lock_discipline",
     "lint_obs_discipline", "lint_fail_discipline", "lint_concurrency",
-    "thread_roots", "check_plan", "verify_plan", "PlanDeviceError",
+    "lint_device_flow", "thread_roots", "check_plan", "verify_plan",
+    "PlanDeviceError",
 ]

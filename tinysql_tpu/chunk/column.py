@@ -290,8 +290,12 @@ class DeviceColumn(Column):
 
     def _ensure_host(self) -> None:
         if self._data is None:
-            v = np.asarray(self._dev_v)[:self._len]
-            m = np.asarray(self._dev_n)[:self._len]
+            # one COUNTED pull for both streams (values + null mask) —
+            # raw np.asarray here was a hidden uncounted d2h (DF801)
+            from ..ops import kernels
+            v, m = kernels.d2h_many([self._dev_v, self._dev_n])
+            v = v[:self._len]
+            m = m[:self._len]
             dt = _np_dtype(self.ft.eval_type)
             self._data = np.ascontiguousarray(v, dtype=dt)
             self._null = np.asarray(m, dtype=bool).copy()
@@ -303,10 +307,9 @@ class DeviceColumn(Column):
         k values, not n."""
         if self._data is not None:
             return super().take(idx)
-        import jax.numpy as jnp
-        di = jnp.asarray(np.asarray(idx, dtype=np.int64))
-        v = np.asarray(self._dev_v[di])
-        m = np.asarray(self._dev_n[di])
+        from ..ops import kernels
+        di = kernels.h2d(np.asarray(idx, dtype=np.int64))
+        v, m = kernels.d2h_many([self._dev_v[di], self._dev_n[di]])
         dt = _np_dtype(self.ft.eval_type)
         return Column.from_numpy(
             self.ft, np.ascontiguousarray(v, dtype=dt),

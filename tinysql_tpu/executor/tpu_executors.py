@@ -2127,10 +2127,16 @@ class TPUProjectionExec(Executor):
             return Chunk.from_columns(cols)
         cols_dev = _marshal(chk)
         outs = self._compiled()(cols_dev, tuple(self._params))
+        # ONE counted pull for every output stream — per-pair np.asarray
+        # was 2N hidden uncounted downloads (transfer-audit find)
+        flat = []
+        for v, m in outs:
+            flat.extend((v, m))
+        host = kernels.d2h_many(flat) if flat else []
         out_cols = []
-        for (v, m), oc in zip(outs, self.plan.schema.columns):
-            out_cols.append(CCol.from_numpy(oc.ret_type, np.asarray(v),
-                                            np.asarray(m)))
+        for i, oc in enumerate(self.plan.schema.columns):
+            out_cols.append(CCol.from_numpy(oc.ret_type, host[2 * i],
+                                            host[2 * i + 1]))
         return Chunk.from_columns(out_cols)
 
 
@@ -2181,7 +2187,9 @@ class TPUSelectionExec(Executor):
             if not chk.columns:
                 mask = vectorized_filter(self.plan.conditions, chk)
             else:
-                mask = np.asarray(
+                # counted pull: raw np.asarray here was a hidden
+                # uncounted d2h on the hot filter loop (DF801)
+                mask = kernels.d2h(
                     self._compiled()(_marshal(chk), tuple(self._params)))
             if not mask.any():
                 continue
@@ -2198,11 +2206,12 @@ def _marshal(chk: Chunk):
     n = chk.num_rows()
     for c in chk.columns:
         v = c.values()
+        # uploads count (DF802): raw jnp.asarray bypassed h2d_transfers
         if v.dtype == object:
             out.append((jnp.zeros(n, dtype=jnp.int64),
-                        jnp.asarray(c.null_mask())))
+                        kernels.h2d(c.null_mask())))
         else:
-            out.append((jnp.asarray(v), jnp.asarray(c.null_mask())))
+            out.append((kernels.h2d(v), kernels.h2d(c.null_mask())))
     return out
 
 

@@ -161,7 +161,15 @@ def _fmt_bytes(n: float) -> str:
     n = float(n)
     for unit in ("B", "KB", "MB", "GB"):
         if n < 1024 or unit == "GB":
-            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+            if unit == "B":
+                # integer byte counts render bare; the occupancy-weighted
+                # fractional shares a stacked member carries (its 1/B
+                # slice of the round's h2d/d2h bytes) keep two decimals —
+                # int() truncation rendered a 170.67B share as 170B and
+                # broke the shares-sum-to-round-total readback
+                return (f"{int(n)}B" if n.is_integer()
+                        else f"{n:.2f}B")
+            return f"{n:.1f}{unit}"
         n /= 1024.0
     return f"{n:.1f}GB"
 

@@ -308,11 +308,15 @@ class _Loop:
         except (KeyError, ValueError, OSError):
             pass
         self.conns.pop(conn.cc.conn_id, None)
+        # deregister BEFORE the peer-visible close: the moment close()
+        # sends FIN a client can observe the drop and ask the server
+        # about this conn_id (processlist, the KILL-idle acceptance
+        # test) — a registry row outliving its socket reads as a leak
+        self.fe.server.remove_conn(conn.cc.conn_id)
         try:
             conn.sock.close()
         except OSError:
             pass
-        self.fe.server.remove_conn(conn.cc.conn_id)
         entry = conn.entry
         if entry is not None:
             # an in-flight statement still owns the session (a pool

@@ -1,0 +1,282 @@
+"""TINYSQL_XFER_AUDIT — the dynamic half of qlint's DF8xx device-dataflow
+pass (tools/transfer_audit.py is the CLI; tests/conftest.py arms this
+when the env var is set), built in the racestress mold.
+
+The static pass proves no SOURCE LINE performs an uncounted transfer;
+this module proves no RUNTIME transfer escapes the counters, closing the
+gap the AST cannot see (dynamic dispatch, jax-internal fallbacks, code
+the batch didn't include):
+
+- :func:`install` interposes jax's transfer entry points —
+  ``jax.device_put`` / ``jax.device_get``, the implicit-upload
+  ``jax.numpy.asarray`` / ``jax.numpy.array`` (host operand, outside a
+  trace), and ``ArrayImpl.__array__`` (every ``np.asarray(dev)``
+  download lands there) — recording one EVENT per observed transfer
+  with a stack-derived attribution:
+
+  * **sanctioned** — a ``kernels.h2d`` / ``h2d_pad`` / ``d2h`` /
+    ``d2h_many`` frame is on the stack: the transfer is counted.
+  * **engine** — a ``tinysql_tpu/`` frame is on the stack but no
+    sanctioned wrapper: an UNCOUNTED transfer (the DF801/DF802 runtime
+    twin).  Any such event is a divergence.
+  * **harness** — only test/driver frames: tests poking device arrays
+    directly; tallied, excluded from divergence.
+
+- A lazily-attached shadow of ``kernels.stats_add`` accumulates every
+  ``h2d_transfers`` / ``d2h_transfers`` increment (reset-proof, unlike
+  reading STATS at the end).  Conservation: the sanctioned event count
+  must equal the counter increments EXACTLY — each wrapper performs one
+  real transfer per bump.
+- :func:`report` / :func:`write_report` publish events, the uncounted
+  list (with stack signatures), the counter shadow, and the divergence
+  verdict — the transfer-audit CI job uploads it as an artifact.
+
+Arm BEFORE importing tinysql_tpu (conftest does) so the kernels module
+resolves ``jnp().asarray`` to the interposed functions at call time.
+Recording is deliberately cheap-but-locked: transfer frequency is
+orders below lock frequency, so a mutex here cannot serialize anything
+the race-stress mode cares about.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import traceback
+from typing import Dict, List, Optional
+
+_STATE = {"installed": False, "attached": False}
+_MU = threading.Lock()
+_TLS = threading.local()
+
+#: observed transfer events (bounded detail; totals are exact)
+_EVENTS: List[dict] = []
+_EVENT_DETAIL_CAP = 400
+#: kind -> {"sanctioned": n, "engine": n, "harness": n}
+_TOTALS: Dict[str, Dict[str, int]] = {
+    "h2d": {"sanctioned": 0, "engine": 0, "harness": 0},
+    "d2h": {"sanctioned": 0, "engine": 0, "harness": 0},
+}
+#: shadow of every stats_add increment on the transfer counters
+_COUNTED: Dict[str, float] = {"h2d_transfers": 0, "d2h_transfers": 0,
+                              "h2d_bytes": 0, "d2h_bytes": 0}
+
+#: the counted-wrapper frames that sanction an observed transfer
+_SANCTIONED_FNS = {"h2d", "h2d_pad", "d2h", "d2h_many"}
+_KERNELS_FILE = os.sep + os.path.join("ops", "kernels.py")
+_PKG_DIR = os.sep + "tinysql_tpu" + os.sep
+_SELF_FILE = "xferaudit.py"
+
+
+def _depth() -> int:
+    return getattr(_TLS, "depth", 0)
+
+
+class _reenter:
+    """Nested interposed calls (asarray -> device_put) record once."""
+
+    def __enter__(self):
+        _TLS.depth = _depth() + 1
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.depth = _depth() - 1
+        return False
+
+
+def _classify() -> tuple:
+    """(attribution, site) from the current stack: sanctioned / engine /
+    harness, plus the innermost attributable frame."""
+    site = "<unknown>"
+    engine = False
+    frames = traceback.extract_stack()
+    for f in frames[::-1]:
+        fn = f.filename
+        if _SELF_FILE in fn:
+            continue
+        if fn.endswith(_KERNELS_FILE) and f.name in _SANCTIONED_FNS:
+            parts = fn.split(os.sep)
+            return "sanctioned", "/".join(parts[-3:]) + f":{f.lineno}"
+        if _PKG_DIR in fn and not engine:
+            engine = True
+            parts = fn.split(os.sep)
+            site = "/".join(parts[-3:]) + f":{f.lineno}"
+    if engine:
+        return "engine", site
+    for f in frames[::-1]:
+        fn = f.filename
+        if _SELF_FILE in fn or os.sep + "jax" in fn \
+                or os.sep + "numpy" in fn:
+            continue
+        parts = fn.split(os.sep)
+        site = "/".join(parts[-3:]) + f":{f.lineno}"
+        break
+    return "harness", site
+
+
+def _record(kind: str, nbytes: int) -> None:
+    _ensure_attached()
+    attr, site = _classify()
+    with _MU:
+        _TOTALS[kind][attr] += 1
+        if len(_EVENTS) < _EVENT_DETAIL_CAP or attr == "engine":
+            ev = {"kind": kind, "attr": attr, "site": site,
+                  "bytes": int(nbytes)}
+            if attr == "engine":
+                ev["stack"] = [
+                    "/".join(f.filename.split(os.sep)[-3:]) + f":{f.lineno}"
+                    for f in traceback.extract_stack()[-10:-3]]
+            _EVENTS.append(ev)
+
+
+def _nbytes(x) -> int:
+    try:
+        return int(getattr(x, "nbytes", 0))
+    except Exception:
+        return 0
+
+
+def _is_device_value(x) -> bool:
+    import jax
+    return isinstance(x, jax.Array)
+
+
+def install() -> None:
+    """Interpose the jax transfer entry points (idempotent).  Safe to
+    call before any tinysql_tpu import — only jax is touched here."""
+    if _STATE["installed"]:
+        return
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax._src.array import ArrayImpl
+
+    real_asarray = jnp.asarray
+    real_array = jnp.array
+    real_device_put = jax.device_put
+    real_device_get = jax.device_get
+    real_dunder_array = ArrayImpl.__array__
+    real_np_asarray = np.asarray
+    real_np_array = np.array
+
+    def _traced() -> bool:
+        try:
+            return not jax.core.trace_state_clean()
+        except Exception:
+            return False
+
+    def _upload_wrapper(real):
+        def wrapped(a, *args, **kwargs):
+            if _depth() == 0 and not _traced() and not _is_device_value(a):
+                _record("h2d", _nbytes(a))
+            with _reenter():
+                return real(a, *args, **kwargs)
+        wrapped.__name__ = real.__name__
+        return wrapped
+
+    def device_put(x, *args, **kwargs):
+        if _depth() == 0 and not _traced():
+            _record("h2d", _nbytes(x))
+        with _reenter():
+            return real_device_put(x, *args, **kwargs)
+
+    def device_get(x, *args, **kwargs):
+        if _depth() == 0:
+            _record("d2h", 0)  # bytes land on the host side afterward
+        with _reenter():
+            return real_device_get(x, *args, **kwargs)
+
+    def dunder_array(self, *args, **kwargs):
+        if _depth() == 0 and not _traced():
+            _record("d2h", _nbytes(self))
+        with _reenter():
+            return real_dunder_array(self, *args, **kwargs)
+
+    def _download_wrapper(real):
+        # on CPU jax, numpy converts ArrayImpl via the C buffer protocol
+        # — __array__ never fires — so np.asarray(dev) downloads must be
+        # caught at the numpy MODULE attribute (python call sites only;
+        # C-internal conversions like np.ascontiguousarray(dev) stay
+        # invisible, which is why kernels.d2h is the sanctioned spelling)
+        def wrapped(a, *args, **kwargs):
+            if _depth() == 0 and isinstance(a, jax.Array) \
+                    and not isinstance(a, jax.core.Tracer):
+                _record("d2h", _nbytes(a))
+            with _reenter():
+                return real(a, *args, **kwargs)
+        wrapped.__name__ = real.__name__
+        return wrapped
+
+    jnp.asarray = _upload_wrapper(real_asarray)
+    jnp.array = _upload_wrapper(real_array)
+    jax.device_put = device_put
+    jax.device_get = device_get
+    ArrayImpl.__array__ = dunder_array
+    np.asarray = _download_wrapper(real_np_asarray)
+    np.array = _download_wrapper(real_np_array)
+    _STATE["installed"] = True
+
+
+def _ensure_attached() -> None:
+    """Shadow kernels.stats_add once the module exists (it is imported
+    AFTER install() arms — conftest order), so every transfer-counter
+    increment is mirrored reset-proof."""
+    if _STATE["attached"]:
+        return
+    import sys
+    kernels = sys.modules.get("tinysql_tpu.ops.kernels")
+    if kernels is None:
+        return
+    with _MU:
+        if _STATE["attached"]:
+            return
+        real_stats_add = kernels.stats_add
+
+        def stats_add(key, n=1):
+            if key in _COUNTED:
+                with _MU:
+                    _COUNTED[key] += n
+            return real_stats_add(key, n)
+
+        kernels.stats_add = stats_add
+        _STATE["attached"] = True
+
+
+def report() -> dict:
+    """The full audit (JSON-able) with the divergence verdict:
+
+    - any ENGINE-attributed event is an uncounted transfer -> diverged;
+    - sanctioned event counts must equal the counter-increment shadow
+      (one real transfer per bump) -> any mismatch diverged.
+    """
+    with _MU:
+        totals = {k: dict(v) for k, v in _TOTALS.items()}
+        counted = dict(_COUNTED)
+        uncounted = [e for e in _EVENTS if e["attr"] == "engine"]
+        events = list(_EVENTS[:_EVENT_DETAIL_CAP])
+    reasons: List[str] = []
+    if totals["h2d"]["engine"] or totals["d2h"]["engine"]:
+        reasons.append(
+            f"uncounted engine transfers: "
+            f"h2d={totals['h2d']['engine']} d2h={totals['d2h']['engine']}")
+    for kind, key in (("h2d", "h2d_transfers"), ("d2h", "d2h_transfers")):
+        if totals[kind]["sanctioned"] != int(counted[key]):
+            reasons.append(
+                f"{key} counter ({int(counted[key])}) != observed "
+                f"sanctioned {kind} events ({totals[kind]['sanctioned']})")
+    return {
+        "installed": _STATE["installed"],
+        "attached": _STATE["attached"],
+        "observed": totals,
+        "counted": {k: int(v) for k, v in counted.items()},
+        "uncounted_transfers": uncounted[:200],
+        "uncounted_count": len(uncounted),
+        "events_detail": events,
+        "divergence": bool(reasons),
+        "divergence_reasons": reasons,
+    }
+
+
+def write_report(path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report(), f, indent=2, sort_keys=True)

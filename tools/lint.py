@@ -3,14 +3,14 @@
 
 Usage:
     python tools/lint.py [--strict] [--json]
-                         [--pass trace|locks|obs|fail|conc|plans|all]
+                         [--pass trace|locks|obs|fail|conc|devflow|plans|all]
                          [--rules] [--fuzz-n N] [paths...]
 
 - `--strict` (the CI entry point): run every pass over its default scope
   and exit non-zero on any violation.
 - `--pass trace|locks|...` over explicit paths: lint just those files.
-  `conc` is WHOLE-PROGRAM: all given paths form one analysis batch
-  (default: the entire package).
+  `conc` and `devflow` are WHOLE-PROGRAM: all given paths form one
+  analysis batch (default: the entire package).
 - `--pass plans`: plan the SQL corpus (tests/test_sql.py statement
   replay + tests/test_sqlite_diff.py's seeded generator) with the TPU
   tier enabled and check every placed plan's device invariants.
@@ -123,6 +123,21 @@ def run_conc(paths):
     return diags
 
 
+def run_devflow(paths):
+    """Whole-program DF8xx: one batch, like conc — device taint crosses
+    modules (a helper returning a device array taints its callers) and
+    the dispatch-hot set is a reachability closure over the union."""
+    from tinysql_tpu.analysis import gather_sources, lint_device_flow
+    batch = []
+    for p in paths:
+        batch.extend(gather_sources(p))
+    diags = []
+    for sf in batch:
+        diags.extend(sf.check_suppression_syntax())
+    diags.extend(lint_device_flow(batch))
+    return diags
+
+
 def run_plans(fuzz_n=None):
     _force_cpu_backend()
     from tinysql_tpu.analysis.plan_device import check_corpus
@@ -151,7 +166,7 @@ def main(argv=None) -> int:
                     help="run all passes over their default scopes")
     ap.add_argument("--pass", dest="passes", action="append",
                     choices=["trace", "locks", "obs", "fail", "conc",
-                             "plans", "all"],
+                             "devflow", "plans", "all"],
                     help="which pass(es) to run (default: trace+locks+obs"
                          "+fail+conc over paths; all under --strict)")
     ap.add_argument("--json", action="store_true",
@@ -173,7 +188,8 @@ def main(argv=None) -> int:
 
     passes = set(args.passes or [])
     if args.strict or "all" in passes:
-        passes = {"trace", "locks", "obs", "fail", "conc", "plans"}
+        passes = {"trace", "locks", "obs", "fail", "conc", "devflow",
+                  "plans"}
     elif not passes:
         passes = {"trace", "locks", "obs", "fail", "conc"}
 
@@ -200,6 +216,8 @@ def main(argv=None) -> int:
             diags.extend(run_fail(fail_paths))
         if "conc" in passes:
             diags.extend(run_conc(paths))
+        if "devflow" in passes:
+            diags.extend(run_devflow(paths))
         if "plans" in passes:
             diags.extend(run_plans(args.fuzz_n))
     except Exception as e:  # the linter itself broke: exit 2, not 1

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""transfer_audit — the dynamic verifier behind qlint's DF8xx pass.
+
+Replays the transfer-heavy test subsets (serve + spill + batching — the
+suites that exercise the statement pool's dispatch legs, the spill
+partition reload path, and the stacked-batching round trip) in
+``TINYSQL_XFER_AUDIT`` mode: jax's transfer entry points
+(``device_put`` / ``device_get`` / implicit ``jnp.asarray`` uploads /
+``ArrayImpl.__array__`` downloads) are interposed BEFORE the engine
+imports, every observed transfer is attributed by stack walk
+(sanctioned counted wrapper / engine / test harness), and a shadow of
+``kernels.stats_add`` mirrors every transfer-counter increment.
+
+The audit DIVERGES — and this tool exits 1 — when either:
+
+- any engine-attributed transfer happened outside the sanctioned
+  ``kernels.h2d``/``h2d_pad``/``d2h``/``d2h_many`` wrappers (a runtime
+  DF801/DF802: traffic the EXPLAIN ANALYZE / bench / tsring counters
+  never saw), or
+- the sanctioned event counts do not EXACTLY match the counter
+  increments (a wrapper bumped a counter without moving bytes, or
+  moved bytes twice per bump).
+
+Exit status: 0 = subset green AND zero divergence; 1 otherwise.  The
+JSON report (default ``transfer_audit_report.json``) is the CI
+artifact.
+
+Usage:
+    python tools/transfer_audit.py [--report PATH]
+                                   [--subset serve,spill,batching]
+                                   [tests...]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SUBSETS = {
+    "serve": "tests/test_serve.py",
+    "spill": "tests/test_spill.py",
+    "batching": "tests/test_stacking.py",
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="transfer_audit",
+                                 description=__doc__)
+    ap.add_argument("tests", nargs="*",
+                    help="explicit test paths (override --subset)")
+    ap.add_argument("--subset", default="serve,spill,batching",
+                    help="named subsets to replay (default: all three)")
+    ap.add_argument("--report", default="transfer_audit_report.json",
+                    help="where to write the JSON report")
+    args = ap.parse_args(argv)
+
+    paths = args.tests
+    if not paths:
+        paths = []
+        for name in args.subset.split(","):
+            name = name.strip()
+            if name not in SUBSETS:
+                print(f"transfer_audit: unknown subset {name!r} "
+                      f"(have: {', '.join(sorted(SUBSETS))})",
+                      file=sys.stderr)
+                return 1
+            paths.append(SUBSETS[name])
+
+    report_path = os.path.abspath(args.report)
+    if os.path.exists(report_path):
+        os.unlink(report_path)
+    env = dict(os.environ)
+    env["TINYSQL_XFER_AUDIT"] = "1"
+    env["TINYSQL_XFER_AUDIT_REPORT"] = report_path
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    cmd = [sys.executable, "-m", "pytest", *paths, "-q", "-m", "not slow",
+           "-p", "no:cacheprovider"]
+    print(f"transfer_audit: {' '.join(cmd)}")
+    rc = subprocess.call(cmd, cwd=REPO_ROOT, env=env)
+
+    if not os.path.exists(report_path):
+        print("transfer_audit: FAIL — no report written (conftest hook "
+              "did not run?)", file=sys.stderr)
+        return 1
+    with open(report_path, "r", encoding="utf-8") as f:
+        rep = json.load(f)
+
+    obs, cnt = rep["observed"], rep["counted"]
+    print(f"\ntransfer_audit report ({report_path})")
+    print("  observed (events)  sanctioned   engine  harness")
+    for kind in ("h2d", "d2h"):
+        t = obs[kind]
+        print(f"    {kind:<15} {t['sanctioned']:>10} {t['engine']:>8} "
+              f"{t['harness']:>8}")
+    print(f"  counted increments : h2d_transfers={cnt['h2d_transfers']} "
+          f"d2h_transfers={cnt['d2h_transfers']}")
+    print(f"  counted bytes      : h2d={cnt['h2d_bytes']} "
+          f"d2h={cnt['d2h_bytes']}")
+
+    bad = False
+    if rc != 0:
+        print(f"transfer_audit: FAIL — test subset exited {rc}")
+        bad = True
+    if rep["divergence"]:
+        print("transfer_audit: FAIL — observed transfers diverge from "
+              "kernels.STATS counters:")
+        for r in rep["divergence_reasons"]:
+            print(f"    {r}")
+        for e in rep["uncounted_transfers"][:20]:
+            stack = e.get("stack") or []
+            print(f"    uncounted {e['kind']} at {e['site']} "
+                  f"({e['bytes']}B) via {stack[-1] if stack else '?'}")
+        bad = True
+    if not bad:
+        print("transfer_audit: OK — subset green, every observed "
+              "transfer counted, counters conserve")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
