@@ -466,6 +466,20 @@ def main():
               f" sqlite={ent['sqlite_rows_per_s']:,}"
               f" match={ent['match']}", file=sys.stderr)
 
+    # mesh-sharded operator tier (ISSUE 17): per-device-count rows/s for
+    # hash_agg / join_probe / sort, so multichip scaling regressions are
+    # visible independent of the query shapes; match gates publication on
+    # byte-identity against the single-device kernels (N=1 row)
+    print("[bench] sharded operator tier ...", file=sys.stderr)
+    sharded_results = opbench.run_sharded()
+    for fam, ent in sharded_results["families"].items():
+        scaling = " ".join(f"{k}dev={v:,}"
+                           for k, v in ent["rows_per_s"].items())
+        print(f"[bench] sharded {fam}: {scaling} rows/s "
+              f"peak@{ent['best_devices']}dev "
+              f"{ent['speedup_max_vs_1']}x vs 1dev "
+              f"match={ent['match']}", file=sys.stderr)
+
     # observability self-cost (ISSUE 8 satellite): the fraction of one
     # core the background sampler would consume in steady state — ONE
     # shared definition with bench_serve.py (tsring.measure_overhead)
@@ -501,6 +515,7 @@ def main():
             for name, (t, c, l, ok) in results.items()
         },
         "operators": op_results,
+        "operators_sharded": sharded_results,
         "workload": workload,
         "param_reuse": param_reuse,
         "spill": spill_summary,
@@ -509,6 +524,8 @@ def main():
         "link": link,
         "correct": all(ok for _, _, _, ok in results.values())
                    and all(e["match"] for e in op_results.values())
+                   and all(e["match"]
+                           for e in sharded_results["families"].values())
                    and all(e["match"] for e in workload.values()),
         "total_bench_seconds": round(time.time() - t_start, 1),
     }

@@ -1,0 +1,56 @@
+"""qlint DF805/DF806/DF807 fixture: mesh-discipline violations.
+
+- ``shard_map`` imported raw and a collective dispatched with no
+  ``dist.shard_map_fn`` wiring in scope (DF805: the version-fallback
+  shim and the replication-check policy live in parallel/dist.py).
+- host sync / numpy compute inside a traced shard_map body (DF806).
+- a raw device-count scalar minted into a progcache key (DF807) —
+  the ``dist.shard_bucket`` twin is the sanctioned launder and stays
+  clean.
+"""
+import numpy as np
+
+import jax
+from jax import lax
+from jax.experimental.shard_map import shard_map  # DF805: raw import
+
+from tinysql_tpu.ops import progcache
+from tinysql_tpu.parallel import dist
+
+
+def _build():
+    return None
+
+
+def all_reduce_raw(block):
+    # DF805: collective with no dist wiring in scope — this traces into
+    # whatever single-device program encloses the call (wrong axis)
+    return lax.psum(block, "shards")
+
+
+def scatter_reduce(x, mesh, specs):
+    def kernel(block):
+        total = np.sum(block)             # DF806: host compute in body
+        n = block.sum().item()            # DF806: host sync under trace
+        return block * (total / n)
+    return shard_map(kernel, mesh=mesh, in_specs=specs,
+                     out_specs=specs)(x)
+
+
+def scatter_clean(x, mesh, specs):
+    def kernel(block):
+        return lax.psum(block, "shards")  # wired: stays clean
+    return dist.shard_map_fn(kernel, mesh, in_specs=specs,
+                             out_specs=specs)(x)
+
+
+def compile_mesh_raw(mesh):
+    n = jax.device_count()                # raw mesh-shape scalar
+    key = ("join_sharded", n)
+    return progcache.get(key, _build)     # DF807: per-topology mint
+
+
+def compile_mesh_bucketed(est_rows, mesh):
+    n = dist.shard_bucket(est_rows, dist.mesh_shards(mesh))
+    key = ("join_sharded", n)
+    return progcache.get(key, _build)     # laundered twin: clean

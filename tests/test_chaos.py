@@ -463,6 +463,46 @@ def _spill_reload_error(tk):
     _read_ok(s)
 
 
+def _mesh_session(s):
+    """Put the chaos session on the partition-parallel path: extra rows
+    push the join's estRows over dist.MIN_SHARD_ROWS*2 so the planner
+    annotates a real shard count (shard_bucket), and the join key is
+    NON-primary on the probe side so the optimizer picks a hash join
+    (pk=pk would merge-join) without pre-aggregating the probe away."""
+    s.execute("insert into t values " + ", ".join(
+        f"({i}, {i % 7})" for i in range(501, 601)))
+    s.execute("set @@tidb_use_tpu = 1")
+    s.execute("set @@tidb_tpu_min_rows = 1")
+    s.execute("set @@tidb_mesh_parallel = 1")
+
+
+#: probe side 600 rows, unique build side — the partitioned
+#: build/probe exchange in ops/shardops.unique_join_match_sharded
+_MESH_JOIN = "select t1.a from t t1 join t t2 on t1.b = t2.a"
+
+
+@chaos("shardExchangeStall")
+def _shard_exchange(tk):
+    """A fault at the shard-exchange entry surfaces TYPED out of the
+    sharded attempt (no silent wrong answer, no hang), and the same
+    statement runs clean — still sharded — once disarmed."""
+    s, _ = tk
+    _mesh_session(s)
+    base = s.query(_MESH_JOIN).rows
+    assert len(base) == 515  # 600 probe rows minus the 85 with b = 0
+    with fail.armed("shardExchangeStall", exc=IOError("exchange down"),
+                    times=1):
+        with pytest.raises(IOError):
+            s.query(_MESH_JOIN)
+    # the semijoin exchange shares the failpoint
+    with fail.armed("shardExchangeStall", exc=IOError("exchange down"),
+                    times=1):
+        with pytest.raises(IOError):
+            s.query("select t1.a from t t1 "
+                    "where t1.b in (select a from t t2)")
+    assert s.query(_MESH_JOIN).rows == base  # healthy + still sharded
+
+
 @chaos("admissionQueueFull")
 def _admission_queue_full(tk):
     """Forced queue-full verdict: every pooled statement sheds with the
@@ -652,6 +692,29 @@ def test_kill_query_aborts_running_statement(tk):
     assert isinstance(box[0], QueryKilled)
     assert box[0].mysql_code == 1317
     assert s.query("select count(*) from t").rows == [[500]]  # healthy
+
+
+def test_kill_lands_mid_shard_exchange(tk):
+    """KILL while the statement is wedged INSIDE a partitioned shard
+    exchange (sleep-armed failpoint at the exchange entry): the kill
+    lands at the next drain-block boundary with typed 1317, and the
+    session runs the same sharded join clean afterwards."""
+    s, _ = tk
+    _mesh_session(s)
+    base = s.query(_MESH_JOIN).rows
+    box = []
+    with fail.armed("shardExchangeStall", sleep=0.4):
+        t = threading.Thread(target=_slow_query, args=(s, _MESH_JOIN),
+                             kwargs={"exc_box": box})
+        t.start()
+        time.sleep(0.15)  # the exchange is holding the statement
+        from tinysql_tpu.utils import interrupt
+        assert interrupt.kill(s.conn_id, query_only=True)
+        t.join(15)
+    assert not t.is_alive()
+    assert isinstance(box[0], QueryKilled), box[0]
+    assert box[0].mysql_code == 1317
+    assert s.query(_MESH_JOIN).rows == base  # healthy, still sharded
 
 
 def test_kill_statement_from_second_session(tk):

@@ -419,6 +419,37 @@ def test_escape_fixture_fires_df804():
     assert all("remember" in d.message for d in got)
 
 
+def test_mesh_fixture_fires_df805():
+    diags = _devflow("bad_mesh.py")
+    got = [d for d in diags if d.rule == "DF805"]
+    # the raw shard_map import + the unwired collective; scatter_clean's
+    # psum is sanctioned by its dist.shard_map_fn wiring
+    assert len(got) == 2, [d.format() for d in diags]
+    assert any("all_reduce_raw" in d.message for d in got)
+    assert not any("scatter_clean" in d.message for d in got)
+
+
+def test_mesh_fixture_fires_df806():
+    diags = _devflow("bad_mesh.py")
+    got = [d for d in diags if d.rule == "DF806"]
+    # np.sum + .item() inside scatter_reduce's traced body; the pure-lax
+    # body in scatter_clean stays silent
+    assert len(got) == 2, [d.format() for d in diags]
+    assert all("scatter_reduce" in d.message for d in got)
+    assert not any("scatter_clean" in d.message for d in got)
+
+
+def test_mesh_fixture_fires_df807():
+    diags = _devflow("bad_mesh.py")
+    got = [d for d in diags if d.rule == "DF807"]
+    # jax.device_count() minted into the key; the
+    # dist.shard_bucket/mesh_shards twin is the sanctioned launder
+    assert len(got) == 1, [d.format() for d in diags]
+    assert "compile_mesh_raw" in got[0].message \
+        or "mesh-shape" in got[0].message
+    assert not any("compile_mesh_bucketed" in d.message for d in got)
+
+
 def test_cross_module_sync_requires_whole_program():
     # each half alone is clean: the helper's raw sync is only a bug
     # once the OTHER module's next() loop makes `pull` dispatch-hot —

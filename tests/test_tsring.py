@@ -445,7 +445,9 @@ EXPECTED_RULES = {"compile-storm", "progcache-hit-rate",
                   # stacked-params batching (ISSUE 14)
                   "batching-degraded",
                   # C10k wire front end (ISSUE 15)
-                  "connection-pressure"}
+                  "connection-pressure",
+                  # mesh-sharded operator tier (ISSUE 17)
+                  "shard-imbalance"}
 
 
 def test_rule_catalogue_fully_covered():
@@ -670,6 +672,27 @@ def test_rule_connection_pressure():
     # no sheds at all: silent
     ring = _ring_with({"tinysql_conn_accepts_total": 50})
     assert not _findings(ring, "connection-pressure")
+
+
+def test_rule_shard_imbalance():
+    n = oinspect.SHARD_SKEW_RETRIES_WARN
+    # skew bails alongside more completed sharded rounds: warning
+    ring = _ring_with({"tinysql_shard_skew_retries_total": n,
+                       "tinysql_shard_rounds_total": n * 5,
+                       "tinysql_shard_rows_hwm": 4096})
+    f = _findings(ring, "shard-imbalance")
+    assert len(f) == 1 and f[0].severity == "warning"
+    assert f[0].metric == "tinysql_shard_skew_retries_total"
+    assert "4096" in f[0].details
+    # the window abandoned MORE attempts than it completed rounds —
+    # the mesh is idle for this key distribution: critical
+    ring = _ring_with({"tinysql_shard_skew_retries_total": n * 4,
+                       "tinysql_shard_rounds_total": n})
+    assert _findings(ring, "shard-imbalance")[0].severity == "critical"
+    # a single capacity-gate bail is the gate working, not imbalance
+    ring = _ring_with({"tinysql_shard_skew_retries_total": n - 1,
+                       "tinysql_shard_rounds_total": 10})
+    assert not _findings(ring, "shard-imbalance")
 
 
 def test_rule_batching_degraded():

@@ -81,6 +81,11 @@ register_rules({
     "DF803": "value-derived (non-shape) scalar flows into a progcache key",
     "DF804": "device array stored in a module-level container outside the "
              "registered cache owners",
+    "DF805": "raw shard_map construction / collective outside the "
+             "dist.shard_map_fn wiring",
+    "DF806": "host sync or numpy call inside a shard_map body",
+    "DF807": "mesh-shape scalar flows into a progcache key outside the "
+             "sanctioned launders (dist.mesh_shards/shard_bucket)",
 })
 
 # ---- taint vocabulary ------------------------------------------------------
@@ -115,9 +120,32 @@ _SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
 #: scalar coercions (DF801 sinks when an argument is tainted)
 _SYNC_COERCE = {"float", "int", "bool"}
 #: calls that LAUNDER value taint into a shape-stable key component
-#: (bucketing data-dependent counts is THE sanctioned retrace bound)
+#: (bucketing data-dependent counts is THE sanctioned retrace bound;
+#: mesh_shards/shard_bucket/_shards_tag are the mesh-shape analogues)
 _VAL_LAUNDER = {"bucket", "occupancy_bucket", "len", "stable_shape_key",
-                "id", "type", "isinstance", "hasattr"}
+                "id", "type", "isinstance", "hasattr",
+                "mesh_shards", "shard_bucket", "_shards_tag"}
+
+# ---- mesh discipline (DF805/DF806/DF807, ISSUE 17) ------------------------
+
+#: mesh collectives: legal only under a shard_map wired through
+#: parallel/dist.py (shard_map_fn / shard_map_unchecked) — a raw
+#: collective outside that wiring dodges the version-fallback shim AND
+#: the sharded tier's counter discipline
+_COLLECTIVES = {"psum", "pmin", "pmax", "all_gather", "all_to_all",
+                "ppermute", "psum_scatter", "axis_index", "pbroadcast"}
+#: the sanctioned construction entry points (parallel/dist.py owns them)
+_SHARD_WIRING = {"shard_map_fn", "shard_map_unchecked"}
+#: the one module allowed to touch jax's shard_map entry points raw
+_MESH_OWNER = ("parallel.dist",)
+#: host-sync / host-compute sinks inside a shard_map body (DF806): a
+#: numpy call or transfer wrapper inside the traced SPMD body either
+#: fails at trace time or — worse — constant-folds host-side per shard
+_BODY_SINK_CALLS = {"d2h", "d2h_many", "h2d", "h2d_pad", "print", "open"}
+#: calls whose RESULT is a mesh-shape scalar (DF807 births)
+_MESH_BIRTHS = {"devices", "device_count", "local_device_count"}
+#: calls that LAUNDER mesh-shape taint into a sanctioned key component
+_MESH_LAUNDER = {"mesh_shards", "shard_bucket", "_shards_tag", "bucket"}
 
 #: dispatch-hot roots by protocol name: executor iterators, drain loops,
 #: the batching dispatch/replay legs (reachability closes over callees)
@@ -205,6 +233,7 @@ class _FnFlow:
                                  if m.modpath == func.mod)
         self.env: Dict[str, str] = {}      # name -> "dev" | "devfn"
         self.vals: Set[str] = set()        # value-derived local names
+        self.meshv: Set[str] = set()       # mesh-shape-derived names
         self.returns_dev = False
         self.returns_devfn = False
         self.attr_dev: Set[str] = set()
@@ -402,6 +431,35 @@ class _FnFlow:
             return self._val(e.value)
         return False
 
+    # ---- mesh-shape scalar taint (DF807) ----------------------------------
+    def _meshval(self, e: ast.expr) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in self.meshv
+        if isinstance(e, ast.Attribute):
+            if e.attr == "devices":
+                return True
+            return self._meshval(e.value)
+        if isinstance(e, ast.Call):
+            nm = _call_name(e.func)
+            if nm in _MESH_LAUNDER:
+                return False
+            if nm in _MESH_BIRTHS:
+                return True
+            return any(self._meshval(a) for a in e.args)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._meshval(x) for x in e.elts)
+        if isinstance(e, ast.Starred):
+            return self._meshval(e.value)
+        if isinstance(e, ast.BinOp):
+            return self._meshval(e.left) or self._meshval(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self._meshval(e.operand)
+        if isinstance(e, ast.IfExp):
+            return self._meshval(e.body) or self._meshval(e.orelse)
+        if isinstance(e, ast.Subscript):
+            return self._meshval(e.value)
+        return False
+
     # ---- statement walk ---------------------------------------------------
     def scan(self) -> None:
         self.checking = False
@@ -431,8 +489,9 @@ class _FnFlow:
                 self._visit_expr(val)
                 t = self._taint(val)
                 v = self._val(val)
+                mv = self._meshval(val)
                 for tgt in targets:
-                    self._bind(tgt, t, v)
+                    self._bind(tgt, t, v, mv)
                     self._store_check(tgt, val, t)
             return
         if isinstance(s, ast.Return):
@@ -487,12 +546,15 @@ class _FnFlow:
             if isinstance(child, ast.expr):
                 self._visit_expr(child)
 
-    def _bind(self, tgt: ast.expr, t: Optional[str], val: bool) -> None:
+    def _bind(self, tgt: ast.expr, t: Optional[str], val: bool,
+              mesh: bool = False) -> None:
         if isinstance(tgt, ast.Name):
             if t is not None:
                 self.env[tgt.id] = t
             if val:
                 self.vals.add(tgt.id)
+            if mesh:
+                self.meshv.add(tgt.id)
             return
         a = _self_attr(tgt)
         if a is not None:
@@ -503,7 +565,7 @@ class _FnFlow:
             return
         if isinstance(tgt, (ast.Tuple, ast.List)):
             for x in tgt.elts:
-                self._bind(x, t, val)
+                self._bind(x, t, val, mesh)
 
     # ---- DF804: stores into module-level containers -----------------------
     def _container_of(self, base: ast.expr) -> Optional[Tuple[str, str]]:
@@ -607,6 +669,19 @@ class _FnFlow:
                     "(exprjit ParamTable) or bucket it "
                     "(kernels.bucket/occupancy_bucket) into a "
                     "shape-stable key component")
+            # DF807: a raw mesh-shape scalar (device count, mesh.devices
+            # size) in the key ties the program registry to the physical
+            # topology instead of the laundered shard count — prewarm on
+            # a different host mesh minted different keys, and a resized
+            # mesh silently recompiles everything
+            if self._meshval(key):
+                self._flag(
+                    "DF807", node,
+                    "progcache key carries a raw mesh-shape scalar — "
+                    "launder it through dist.mesh_shards / "
+                    "dist.shard_bucket (the sanctioned bucketed shard "
+                    "counts) so keys stay stable across physical device "
+                    "topologies")
 
         # DF801: hidden host syncs in dispatch-hot regions
         if not self.hot or _mod_endswith(mod, _SANCTIONED_MODULES):
@@ -713,6 +788,120 @@ def _hot_set(prog: _Program) -> Set[str]:
 
 
 # ===========================================================================
+# mesh discipline (DF805 / DF806) — raw shard_map wiring + body hygiene
+# ===========================================================================
+
+def _mesh_discipline_diags(prog: _Program) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    for m in prog.modules:
+        if _mod_endswith(m.modpath, _MESH_OWNER):
+            continue  # parallel/dist.py IS the wiring layer
+        # DF805a: raw shard_map import — the version-fallback shim and
+        # the unchecked-replication variant live in dist.py alone
+        for node in ast.walk(m.sf.tree):
+            if isinstance(node, ast.ImportFrom):
+                modname = node.module or ""
+                if "shard_map" in modname or (
+                        modname.startswith("jax")
+                        and any(a.name == "shard_map"
+                                for a in node.names)):
+                    out.append(Diagnostic(
+                        "DF805",
+                        "raw shard_map import outside parallel/dist.py — "
+                        "construct through dist.shard_map_fn / "
+                        "shard_map_unchecked (one jax-version fallback, "
+                        "one replication-check policy)",
+                        m.sf.path, node.lineno, node.col_offset))
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if "shard_map" in a.name:
+                        out.append(Diagnostic(
+                            "DF805",
+                            "raw shard_map import outside parallel/"
+                            "dist.py — construct through "
+                            "dist.shard_map_fn / shard_map_unchecked",
+                            m.sf.path, node.lineno, node.col_offset))
+        for f in m.funcs:
+            if f.nested_in is not None:
+                continue  # nested defs ride their top-level scope
+            wired = any(
+                isinstance(n, ast.Call)
+                and _call_name(n.func) in _SHARD_WIRING
+                for n in ast.walk(f.node))
+            body_names: List[str] = []
+            for n in ast.walk(f.node):
+                if isinstance(n, ast.Call) \
+                        and _call_name(n.func) in ("shard_map",
+                                                   "shard_map_fn",
+                                                   "shard_map_unchecked") \
+                        and n.args and isinstance(n.args[0], ast.Name):
+                    body_names.append(n.args[0].id)
+                elif isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    # @partial(shard_map, ...) decorator idiom
+                    for d in n.decorator_list:
+                        if isinstance(d, ast.Call) \
+                                and _call_name(d.func) == "partial" \
+                                and d.args \
+                                and isinstance(d.args[0], ast.Name) \
+                                and d.args[0].id == "shard_map":
+                            body_names.append(n.name)
+            # DF805b: a collective with no dist wiring in scope runs
+            # outside any shard_map this pass can see — it either traces
+            # into a single-device program (wrong axis) or was wired raw
+            if not wired:
+                for n in ast.walk(f.node):
+                    if isinstance(n, ast.Call) \
+                            and _call_name(n.func) in _COLLECTIVES:
+                        out.append(Diagnostic(
+                            "DF805",
+                            f"collective `{_call_name(n.func)}` outside "
+                            "any dist.shard_map_fn/shard_map_unchecked "
+                            "wiring in scope — mesh programs construct "
+                            f"through parallel/dist.py (in `{f.qual}`)",
+                            m.sf.path, n.lineno, n.col_offset))
+            # DF806: host syncs / numpy compute inside the traced body
+            if not body_names:
+                continue
+            for n in ast.walk(f.node):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n.name in body_names:
+                    out.extend(_body_sync_diags(m, f, n))
+    return out
+
+
+def _body_sync_diags(m: _Module, f: _Func, body) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+
+    def flag(node, msg):
+        out.append(Diagnostic(
+            "DF806", msg + f" (shard_map body `{body.name}` in "
+            f"`{f.qual}`)", m.sf.path, node.lineno, node.col_offset))
+
+    for n in ast.walk(body):
+        if not isinstance(n, ast.Call):
+            continue
+        fn = n.func
+        nm = _call_name(fn)
+        if nm in _BODY_SINK_CALLS:
+            flag(n, f"`{nm}` inside a shard_map body — the traced SPMD "
+                 "program cannot host-sync; move the transfer outside "
+                 "the shard_map")
+        elif isinstance(fn, ast.Attribute) and fn.attr in _SYNC_ATTRS:
+            flag(n, f"`.{fn.attr}()` inside a shard_map body — a host "
+                 "sync under trace either fails or constant-folds "
+                 "per-shard host work into the program")
+        elif isinstance(fn, ast.Attribute) \
+                and isinstance(fn.value, ast.Name) \
+                and (fn.value.id == "np"
+                     or m.imports.get(fn.value.id, "").startswith("numpy")):
+            flag(n, f"numpy call `np.{fn.attr}(...)` inside a shard_map "
+                 "body — host compute under trace; use the jax "
+                 "namespace so the work stays in the SPMD program")
+    return out
+
+
+# ===========================================================================
 # module-body escapes (DF804 at import time)
 # ===========================================================================
 
@@ -754,6 +943,7 @@ def lint_device_flow(sources: List[SourceFile]) -> List[Diagnostic]:
         diags.extend(fl.check(f.qual in hot))
     for m in prog.modules:
         diags.extend(_module_body_diags(state, m))
+    diags.extend(_mesh_discipline_diags(prog))
     out = []
     for d in diags:
         sf = prog.by_path.get(d.path)

@@ -21,6 +21,11 @@ drift:
 - PD206: malformed CPU-fallback edge: a child that is not a physical
   operator or lost its schema — the materialization boundary between
   tiers needs both.
+- PD207: malformed mesh shard annotation: `mesh_shards` that is not a
+  power of two, exceeds the live device count, or sits on an operator
+  the sharded tier cannot run (checked with the same `mesh_admissible`
+  predicate place_devices annotates with, so checker and placement
+  cannot drift).
 
 Runs three ways: offline over the SQL corpus in tests/ (`check_corpus`,
 driven by tools/lint.py), as an opt-in runtime verifier inside the
@@ -42,6 +47,8 @@ register_rules({
     "PD204": "TPU placement on an operator with no device lowering",
     "PD205": "EXPLAIN device annotation inconsistent with placement",
     "PD206": "malformed CPU-fallback edge (non-operator or schema-less child)",
+    "PD207": "malformed mesh shard annotation (non-power-of-two, over the "
+             "device count, or on a mesh-inadmissible operator)",
 })
 
 _DEVICE_OPS = ("PhysicalHashAgg", "PhysicalHashJoin", "PhysicalSort",
@@ -60,10 +67,23 @@ def _node_path(path: List[str]) -> str:
     return "/".join(path) or "<root>"
 
 
+def _live_device_count() -> Optional[int]:
+    """Device count when a backend is already live; None offline (the
+    checker must not force a jax backend just to validate an
+    annotation)."""
+    import sys
+    if "jax" not in sys.modules:
+        return None
+    try:
+        return int(len(sys.modules["jax"].devices()))
+    except Exception:
+        return None
+
+
 def check_plan(p, path: Optional[List[str]] = None,
                where: str = "<plan>") -> List[Diagnostic]:
     """All PD2xx checks over one placed physical plan tree."""
-    from ..planner.device import tpu_admissibility
+    from ..planner.device import mesh_admissible, tpu_admissibility
     from ..planner.physical import PhysicalPlan
     path = (path or []) + [p.op_name()]
     out: List[Diagnostic] = []
@@ -102,6 +122,30 @@ def check_plan(p, path: Optional[List[str]] = None,
             out.append(Diagnostic(
                 "PD203", f"{_node_path(path)}: mesh_strategy without "
                 "its broadcast/shuffle cost record", where))
+    ms = getattr(p, "mesh_shards", None)
+    if ms is not None:
+        ms = int(ms)
+        if ms < 1 or (ms & (ms - 1)) != 0:
+            out.append(Diagnostic(
+                "PD207", f"{_node_path(path)}: mesh_shards {ms} is not "
+                "a power of two — shard_bucket only mints power-of-two "
+                "shard counts", where))
+        if not use_tpu:
+            out.append(Diagnostic(
+                "PD207", f"{_node_path(path)}: mesh_shards on a "
+                "non-TPU node — the sharded tier only runs placed "
+                "operators", where))
+        else:
+            reason = mesh_admissible(p)
+            if reason is not None:
+                out.append(Diagnostic(
+                    "PD207", f"{_node_path(path)}: mesh_shards on a "
+                    f"mesh-inadmissible operator — {reason}", where))
+        ndev = _live_device_count()
+        if ndev is not None and ms > ndev:
+            out.append(Diagnostic(
+                "PD207", f"{_node_path(path)}: mesh_shards {ms} "
+                f"exceeds the {ndev} live device(s)", where))
     for c in p.children:
         if not isinstance(c, PhysicalPlan) or c.schema is None:
             out.append(Diagnostic(

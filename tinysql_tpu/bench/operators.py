@@ -121,6 +121,157 @@ def _canon(rows):
                         for v in r) for r in rows)
 
 
+# ---- mesh-sharded operator tier (ISSUE 17) --------------------------------
+
+N_SHARD_ROWS = 1 << 19    # 512k rows: enough for the collectives to pay
+N_SHARD_BUILD = 1 << 16   # unique build side for the partitioned join
+
+
+def run_sharded(reps: int = 3) -> dict:
+    """Per-device-count rows/s for the partition-parallel operator tier
+    (ops/shardops.py): ``hash_agg`` (partial->final scalar aggregate),
+    ``join_probe`` (partitioned build/probe unique join) and ``sort``
+    (per-shard sort + rank merge), measured at every power-of-two submesh
+    the process exposes.  N=1 is the single-device kernel — the row the
+    sharded tier has to beat — and ``match`` asserts byte-identity of
+    every sharded result against it, so a scaling number from a wrong
+    answer can never publish.
+
+    TWO throughputs per (family, N), both from the same measured run:
+
+    - ``rows_per_s_wall`` — raw host wall.  A forced host mesh timeshares
+      its N virtual devices onto the physical cores (1 in CI), so this
+      number can NEVER scale with N on a host mesh; it is the honest
+      serialized cost and the regression-tracking number.
+    - ``rows_per_s`` (headline) — balanced-shard critical path: the
+      serial host sections (partition scatter, probe-order re-assembly)
+      at measured cost plus the measured shard-parallel device region
+      (shardops.LAST_DEVICE_REGION_S) divided by N.  Row-sliced shards
+      (hash_agg, sort) carry exactly nb/N rows each and hash-partition
+      blocks are capacity-equalized, so max-over-shards == mean and the
+      division is the wall a real N-device mesh would see — the
+      host-mesh proxy for ICI scaling (PROFILE.md §14).
+    """
+    import sys
+
+    from ..ops import kernels, shardops
+    from ..parallel import dist
+
+    ndev = len(kernels.jax().devices())
+    sizes = [n for n in (1, 2, 4, 8) if n <= ndev]
+    rng = np.random.default_rng(1117)
+    n = N_SHARD_ROWS
+    nb = kernels.bucket(n)
+
+    # shared inputs: f64 measure column (integer-valued so the partial
+    # sums are order-exact), int64 probe/sort keys, ~1% nulls
+    vals = rng.integers(0, 1000, n).astype(np.float64)
+    nulls = rng.random(n) < 0.01
+    probe = rng.integers(0, N_SHARD_BUILD * 2, n).astype(np.int64)
+    build = rng.permutation(N_SHARD_BUILD).astype(np.int64)
+    bnull = np.zeros(N_SHARD_BUILD, dtype=bool)
+    sortk = rng.integers(-(1 << 40), 1 << 40, n).astype(np.int64)
+    valid = np.ones(nb, dtype=bool)
+    valid[n:] = False
+
+    dev_cols = ((kernels.h2d_pad(vals, nb),
+                 kernels.h2d_pad(nulls, nb)),)
+    mask = ("host", kernels.h2d(valid))
+    specs = (("sum", True), ("min", True), ("max", True),
+             ("count_star", False))
+    args = [lambda cols, pr: (cols[0][0], cols[0][1])] * 3 + [None]
+
+    def agg(mesh):
+        if mesh is None:
+            outs, _ = kernels.fused_scalar_aggregate(
+                dev_cols, specs, args, n, nb, mask,
+                program_key=("opbench_sharded",))
+        else:
+            outs, _ = shardops.fused_scalar_aggregate_sharded(
+                mesh, dev_cols, specs, args, n, nb, mask,
+                program_key=("opbench_sharded",))
+        return [(np.asarray(v), np.asarray(m)) for v, m in outs]
+
+    def join(mesh):
+        if mesh is None:
+            return kernels.unique_join_match(
+                (probe, nulls), n, (build, bnull), N_SHARD_BUILD)
+        return shardops.unique_join_match_sharded(
+            mesh, (probe, nulls), n, (build, bnull), N_SHARD_BUILD)
+
+    def sort(mesh):
+        if mesh is None:
+            return kernels.sort_permutation([(sortk, nulls)], [False], n)
+        return shardops.sort_permutation_sharded(
+            mesh, [(sortk, nulls)], [False], n)
+
+    families = {"hash_agg": agg, "join_probe": join, "sort": sort}
+    import os
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover
+        cores = os.cpu_count() or 1
+    fams = {}
+    for fam, fn in families.items():
+        entry = {"input_rows": n, "rows_per_s": {}, "rows_per_s_wall": {},
+                 "wall_s": {}, "device_region_s": {}, "serial_host_s": {}}
+        baseline = None
+        for ns in sizes:
+            mesh = dist.sized_mesh(ns)
+            res = fn(mesh)  # warm compile before timing
+            assert res is not None, (fam, ns)
+            best, region = float("inf"), 0.0
+            for _ in range(reps):
+                t0 = time.time()
+                res = fn(mesh)
+                wall = time.time() - t0
+                if wall < best:
+                    best, region = wall, shardops.LAST_DEVICE_REGION_S
+            if mesh is None:
+                region, serial = best, 0.0  # whole run IS the one device
+            else:
+                serial = max(best - region, 0.0)
+            critical = serial + region / ns
+            entry["wall_s"][str(ns)] = round(best, 4)
+            entry["device_region_s"][str(ns)] = round(region, 4)
+            entry["serial_host_s"][str(ns)] = round(serial, 4)
+            entry["rows_per_s_wall"][str(ns)] = round(n / best)
+            entry["rows_per_s"][str(ns)] = round(n / critical)
+            if baseline is None:
+                baseline, match = res, True
+            else:
+                match = entry.get("match", True) and _same(baseline, res)
+            entry["match"] = match
+            print(f"[bench] sharded {fam} n={ns}: "
+                  f"{entry['rows_per_s'][str(ns)]:,} rows/s "
+                  f"(wall {entry['rows_per_s_wall'][str(ns)]:,}) "
+                  f"match={entry['match']}", file=sys.stderr)
+        one = entry["rows_per_s"].get("1", 0)
+        peak_n = max(entry["rows_per_s"], key=entry["rows_per_s"].get)
+        entry["best_devices"] = int(peak_n)
+        entry["speedup_max_vs_1"] = (
+            round(entry["rows_per_s"][peak_n] / one, 3) if one else 0.0)
+        fams[fam] = entry
+    return {
+        "host_cores": cores,
+        "definition": ("rows_per_s = input_rows / (serial_host_s + "
+                       "device_region_s / N): balanced-shard critical "
+                       "path, the host-mesh proxy for an N-device ICI "
+                       "mesh (the host timeshares its N virtual devices "
+                       "onto the physical cores, so rows_per_s_wall "
+                       "cannot scale with N here — PROFILE.md §14)"),
+        "families": fams,
+    }
+
+
+def _same(a, b):
+    """Byte-identity between a single-device result and a sharded one:
+    matching tuple arity and exact array equality, leaf by leaf."""
+    if isinstance(a, (tuple, list)):
+        return len(a) == len(b) and all(_same(x, y) for x, y in zip(a, b))
+    return bool(np.array_equal(np.asarray(a), np.asarray(b)))
+
+
 def _sqlite_times(reps: int = 3):
     import sqlite3
     fact, dim = _data()

@@ -1331,10 +1331,13 @@ class _JoinNode:
         capb = self._shuffle_cap_of(got_b, nbb, n)
         # skew gates, BOTH sides: a clustered hash would make one shard's
         # receive buffer rival the whole table — broadcast is strictly
-        # better there
+        # better there (counted: tinysql_shard_skew_retries_total)
+        from ..ops import shardops
         if n * n * capp > max(MAX_EXPAND, 2 * nb):
+            shardops.record_skew_retry()
             return None
         if n * n * capb > max(MAX_EXPAND, 2 * nbb):
+            shardops.record_skew_retry()
             return None
         pt = ParamTable()
         pt.add_int(pn_rows)
@@ -1347,6 +1350,12 @@ class _JoinNode:
         npc, nbc = len(ptv.meta), len(btv.meta)
         pb.key(("joinshuf", nb, nbb, capp, capb, pk_slot, bk_slot, outer,
                 probe_is_left, nbc, npc, n))
+        # shard-exchange economics: the all_to_all lane volume this
+        # program moves per dispatch (value+null byte per slot, plus the
+        # validity lane) and one round at the receive-buffer HWM
+        shardops.record_exchange(n * capp * (9 * npc + 1)
+                                 + n * capb * (9 * nbc + 1))
+        shardops.note_round(max(n * capp, n * capb))
 
         def kernel(ppairs, pvalid, bpairs, bvalid, pr):
             from jax import lax
@@ -1541,7 +1550,10 @@ class _JoinNode:
                                  shards=max(n_mesh, 1))
         if ob is None and mesh is not None:
             # probe skew blew the per-shard bound: retry unsharded
-            # before abandoning the device pipeline
+            # before abandoning the device pipeline (counted:
+            # tinysql_shard_skew_retries_total feeds the imbalance rule)
+            from ..ops import shardops
+            shardops.record_skew_retry()
             mesh = None
             n_mesh = 0
             ob = self._expand_bucket(raw, ptv, outer, per_probe)

@@ -79,6 +79,25 @@ def _block_budget(session_vars) -> int:
         return 0
 
 
+def _mesh_for(ctx, nb: int, plan=None):
+    """Execution mesh for one sharded dispatch, or None = single-device.
+    Gates, in order: session opt-in (tidb_mesh_parallel), the planner's
+    estRows-driven shard count when annotated (plan.mesh_shards from
+    planner/device.py — 1 is the degenerate 'stay single-device' case,
+    >=2 clips to a cached submesh), and the runtime row-bucket gate
+    (dist.shardable) on the ACTUAL padded row count."""
+    from ..parallel import dist
+    mesh = dist.session_mesh(ctx.session_vars)
+    if mesh is None:
+        return None
+    want = int(getattr(plan, "mesh_shards", 0) or 0)
+    if want == 1:
+        return None
+    if want >= 2:
+        mesh = dist.sized_mesh(min(want, dist.mesh_shards(mesh)))
+    return mesh if dist.shardable(nb, mesh) else None
+
+
 def _spill_run_rows(sctx, n: int, row_bytes: int) -> int:
     """Run length for the external sort/top-k: what the resident budget
     holds, floored (tiny budgets must not devolve into per-row runs) and
@@ -763,12 +782,25 @@ class TPUHashAggExec(Executor):
         # ---- run --------------------------------------------------------
         if not plan.group_by:
             out_keys = []
-            # batchable: THE single-shot dispatch cross-query
-            # micro-batching coalesces (ops/batching.py) — blockwise /
-            # sharded / passthrough variants stay solo
-            out_aggs, first_orig = kernels.fused_scalar_aggregate(
-                dev_cols, specs, progs, n, nb, mask_spec,
-                program_key=program_key, params=params, batchable=True)
+            mesh = self._mesh_if_enabled(nb)
+            if mesh is not None:
+                # partial->final over the mesh, and STILL batchable: the
+                # stacked variant vmaps B queries over the N-shard
+                # program (B x N in one dispatch)
+                from ..ops import shardops
+                out_aggs, first_orig = \
+                    shardops.fused_scalar_aggregate_sharded(
+                        mesh, dev_cols, specs, progs, n, nb, mask_spec,
+                        program_key=program_key, params=params,
+                        batchable=True)
+            else:
+                # batchable: THE single-shot dispatch cross-query
+                # micro-batching coalesces (ops/batching.py) — blockwise
+                # / passthrough variants stay solo
+                out_aggs, first_orig = kernels.fused_scalar_aggregate(
+                    dev_cols, specs, progs, n, nb, mask_spec,
+                    program_key=program_key, params=params,
+                    batchable=True)
         else:
             gid_dev = rep.memo(
                 ("gid_dev", tuple(slot_ids[e.index]
@@ -979,9 +1011,7 @@ class TPUHashAggExec(Executor):
         """Multi-chip mesh for the sharded aggregate when the session asks
         for it (SET @@tidb_mesh_parallel = 1) and the bucket divides over
         the devices (power-of-two buckets over power-of-two meshes)."""
-        from ..parallel import dist
-        mesh = dist.session_mesh(self.ctx.session_vars)
-        return mesh if dist.shardable(nb, mesh) else None
+        return _mesh_for(self.ctx, nb, self.plan)
 
     @staticmethod
     def _rep_key_codes(rep, e, chk, slot_id):
@@ -1683,10 +1713,28 @@ class TPUHashJoinExec(Executor):
                     rchk.full_rows(), rmask,
                     outer=(plan.tp == "left"), build_sorted=bs)
             else:
-                li, ri = kernels.unique_join_match(
-                    (lk, lnull), lchk.full_rows(), (rk, rnull),
-                    rchk.full_rows(), outer=(plan.tp == "left"),
-                    lvalid=lmask, rvalid=rmask, build_sorted=bs)
+                out = None
+                mesh = _mesh_for(
+                    self.ctx, kernels.bucket(max(lchk.full_rows(), 1)),
+                    plan)
+                if mesh is not None and isinstance(lk, np.ndarray) \
+                        and isinstance(rk, np.ndarray):
+                    # partitioned build/probe over the mesh (shard =
+                    # spill partition); None (skew, odd dtypes) falls
+                    # through to the single-device kernel
+                    from ..ops import shardops
+                    out = shardops.unique_join_match_sharded(
+                        mesh, (lk, lnull), lchk.full_rows(),
+                        (rk, rnull), rchk.full_rows(),
+                        outer=(plan.tp == "left"),
+                        lvalid=lmask, rvalid=rmask)
+                if out is not None:
+                    li, ri = out
+                else:
+                    li, ri = kernels.unique_join_match(
+                        (lk, lnull), lchk.full_rows(), (rk, rnull),
+                        rchk.full_rows(), outer=(plan.tp == "left"),
+                        lvalid=lmask, rvalid=rmask, build_sorted=bs)
         elif left_unique and plan.tp == "inner":
             bs = (not composite
                   and self._sorted_build(plan.left_keys[0], lchk))
@@ -1697,10 +1745,24 @@ class TPUHashJoinExec(Executor):
                     lchk.full_rows(), lmask, outer=False,
                     build_sorted=bs)
             else:
-                ri, li = kernels.unique_join_match(
-                    (rk, rnull), rchk.full_rows(), (lk, lnull),
-                    lchk.full_rows(), outer=False,
-                    lvalid=rmask, rvalid=lmask, build_sorted=bs)
+                out = None
+                mesh = _mesh_for(
+                    self.ctx, kernels.bucket(max(rchk.full_rows(), 1)),
+                    plan)
+                if mesh is not None and isinstance(lk, np.ndarray) \
+                        and isinstance(rk, np.ndarray):
+                    from ..ops import shardops
+                    out = shardops.unique_join_match_sharded(
+                        mesh, (rk, rnull), rchk.full_rows(),
+                        (lk, lnull), lchk.full_rows(), outer=False,
+                        lvalid=rmask, rvalid=lmask)
+                if out is not None:
+                    ri, li = out
+                else:
+                    ri, li = kernels.unique_join_match(
+                        (rk, rnull), rchk.full_rows(), (lk, lnull),
+                        lchk.full_rows(), outer=False,
+                        lvalid=rmask, rvalid=lmask, build_sorted=bs)
         elif stream:
             li, ri = stream_match(
                 kernels.join_match, lk, lnull, lchk.full_rows(), lmask,
@@ -1793,7 +1855,12 @@ class TPUHashJoinExec(Executor):
                 self.ctx, est,
                 lchk.full_rows() + rchk.full_rows(),
                 _JOIN_ROW_BYTES, "join")
-        host_keys = kernels.host_kernels_ok()
+        mesh = None if sctx is not None or len(plan.left_keys) > 1 else \
+            _mesh_for(self.ctx, kernels.bucket(max(lchk.full_rows(), 1)),
+                      plan)
+        # partitioned semijoin scatters HOST key lanes with the spill
+        # partitioner — device-resident keys would round-trip anyway
+        host_keys = kernels.host_kernels_ok() or mesh is not None
         if len(plan.left_keys) > 1:
             (lk, lnull), (rk, rnull) = _composite_key_lanes(
                 plan.left_keys, lchk, plan.right_keys, rchk)
@@ -1811,10 +1878,18 @@ class TPUHashJoinExec(Executor):
             li = self._spill_semi(sctx, (lk, lnull), (rk, rnull), lchk,
                                   rchk, lmask, rmask, anti, null_aware)
         else:
-            li = kernels.semi_join_match(
-                (lk, lnull), lchk.full_rows(), (rk, rnull),
-                rchk.full_rows(), anti=anti, null_aware=null_aware,
-                lvalid=lmask, rvalid=rmask)
+            li = None
+            if mesh is not None:
+                from ..ops import shardops
+                li = shardops.semi_join_match_sharded(
+                    mesh, (lk, lnull), lchk.full_rows(), (rk, rnull),
+                    rchk.full_rows(), anti=anti, null_aware=null_aware,
+                    lvalid=lmask, rvalid=rmask)
+            if li is None:
+                li = kernels.semi_join_match(
+                    (lk, lnull), lchk.full_rows(), (rk, rnull),
+                    rchk.full_rows(), anti=anti, null_aware=null_aware,
+                    lvalid=lmask, rvalid=rmask)
         if len(li) == 0:
             return None
         cols: List[CCol] = [LazyTakeColumn(c, li) for c in lchk.columns]
@@ -1998,7 +2073,17 @@ class TPUSortExec(Executor):
                     # violate tidb_device_block_rows
                     perm = kernels.host_sort_permutation(keys, descs, n)
                 else:
-                    perm = kernels.sort_permutation(keys, descs, n)
+                    perm = None
+                    mesh = _mesh_for(self.ctx,
+                                     kernels.bucket(max(n, 1)), self.plan)
+                    if mesh is not None:
+                        # per-shard sort + exact device rank merge;
+                        # None (multi-key, unscorable) falls through
+                        from ..ops import shardops
+                        perm = shardops.sort_permutation_sharded(
+                            mesh, keys, descs, n)
+                    if perm is None:
+                        perm = kernels.sort_permutation(keys, descs, n)
                 chk.set_sel(perm)
                 self._out = iter([chk.compact()])
         return next(self._out, None)
@@ -2047,7 +2132,16 @@ class TPUTopNExec(Executor):
                 elif budget > 0 and n > budget:
                     perm = self._blockwise_topk(keys, descs, n, k, budget)
                 else:
-                    perm = kernels.top_k(keys, descs, n, k)
+                    perm = None
+                    mesh = _mesh_for(self.ctx,
+                                     kernels.bucket(max(n, 1)), self.plan)
+                    if mesh is not None:
+                        # per-shard top-k + replicated tournament merge
+                        from ..ops import shardops
+                        perm = shardops.top_k_sharded(
+                            mesh, keys, descs, n, k)
+                    if perm is None:
+                        perm = kernels.top_k(keys, descs, n, k)
                 sel = perm[self.plan.offset:]
                 chk.set_sel(sel)
                 self._out = iter([chk.compact()] if len(sel) else [])

@@ -90,6 +90,14 @@ ADMISSION_DELAY = register(
     "entry claimed — the queue builds behind it, queued statements stay "
     "KILLable, the accept loop never hangs (server/pool.py)")
 
+# ---- sharded operator tier (ops/shardops.py) -------------------------------
+SHARD_EXCHANGE_STALL = register(
+    "shardExchangeStall",
+    "entry of a partitioned join/semijoin shard exchange (ops/shardops.py)"
+    " — armed with sleep= it holds the statement mid-exchange so KILL "
+    "must land at the next drain-block boundary with the session healthy "
+    "after; armed with exc= the sharded attempt surfaces the error")
+
 # ---- memory-adaptive spilling (ops/spill.py) -------------------------------
 SPILL_PARTITION_ERROR = register(
     "spillPartitionError",

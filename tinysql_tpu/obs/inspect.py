@@ -53,6 +53,11 @@ tests/test_tsring.py):
 - **connection-pressure** (ISSUE 15): the accept gate is refusing
   connects with MySQL 1040 (``tinysql_conn_sheds_total``); critical
   when a window sheds more connections than it admits;
+- **shard-imbalance** (ISSUE 17): sharded operator attempts keep
+  abandoning for partition skew (``tinysql_shard_skew_retries_total``)
+  — one hash partition rivals the whole input, so the mesh sits idle
+  while those operators run single-device; critical when the window
+  abandoned more attempts than it completed sharded rounds;
 - **cpu-saturation** (ISSUE 13): one thread role dominates the busy
   host-CPU samples (obs/conprof.py) while the admission queue is
   non-empty — the serving tier's latency is host CPU in that role, and
@@ -135,6 +140,12 @@ BATCH_DEGRADED_MIN_ATTEMPTS = 10
 BATCH_DEGRADED_MIN_GROUPS = 5
 BATCH_DEGRADED_WARN = 0.20
 BATCH_DEGRADED_CRIT = 0.50
+
+#: shard-imbalance: sharded attempts abandoned for partition skew
+#: within the window before the rule speaks — one clustered key set
+#: bailing to the single-device kernel is the capacity gate working as
+#: designed, a stream of them means the mesh is idle for this workload
+SHARD_SKEW_RETRIES_WARN = 2
 
 #: connection-pressure (ISSUE 15): minimum windowed 1040 sheds before
 #: the rule speaks at all — one refused connect is a client retrying
@@ -587,6 +598,31 @@ def _rule_batching_degraded(ctx: InspectionContext) -> List[Finding]:
                 "one-dispatch-per-round win (results stay correct)",
                 "tinysql_batch_stack_fallbacks_total"))
     return out
+
+
+@rule("shard-imbalance")
+def _rule_shard_imbalance(ctx: InspectionContext) -> List[Finding]:
+    """Sharded attempts repeatedly abandoned for partition skew
+    (ISSUE 17): the hash partitioner keeps producing one block that
+    rivals the whole input, so partition-parallel operators bail to
+    their single-device kernels and the mesh sits idle.  Evidence is
+    the skew-retry delta judged against completed sharded rounds, with
+    the per-shard row high-water mark as sizing context."""
+    retries = ctx.delta("tinysql_shard_skew_retries_total")
+    if retries < SHARD_SKEW_RETRIES_WARN:
+        return []
+    rounds = ctx.delta("tinysql_shard_rounds_total")
+    hwm = ctx.max_value("tinysql_shard_rows_hwm")
+    sev = "critical" if retries > rounds else "warning"
+    return [ctx.evidence(
+        "shard-imbalance", "mesh", sev,
+        f"{retries:.0f} sharded attempt(s) abandoned for partition skew "
+        f"within the window against {rounds:.0f} completed sharded "
+        f"rounds (per-shard row high-water mark {hwm:.0f}): one hash "
+        "partition keeps rivaling the whole input, so those operators "
+        "ran single-device — this key distribution defeats the "
+        "partitioner; results stay correct, the mesh speedup is gone",
+        "tinysql_shard_skew_retries_total")]
 
 
 @rule("cpu-saturation")

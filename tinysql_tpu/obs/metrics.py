@@ -144,6 +144,22 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "tinysql_spill_open_slots":
         ("gauge", "Live spill-store slots (0 between statements — "
                   "anything else is a leak)"),
+    # mesh-sharded operator tier (ops/shardops.py STATS)
+    "tinysql_shard_rounds_total":
+        ("counter", "Sharded program dispatches (partition-parallel "
+                    "join/semijoin/agg/sort/top-k rounds)"),
+    "tinysql_shard_rows_hwm":
+        ("gauge", "Per-shard row high-water mark (largest partition "
+                  "block / row slice one device has carried)"),
+    "tinysql_shard_exchange_bytes_total":
+        ("counter", "Bytes scattered through shard exchanges "
+                    "(partition-block scatter + shuffle-join lanes)"),
+    "tinysql_shard_skew_retries_total":
+        ("counter", "Sharded attempts abandoned for partition skew "
+                    "(fell back to the single-device kernel)"),
+    "tinysql_shard_stacked_rounds_total":
+        ("counter", "Batch rounds dispatched B stacked queries OVER a "
+                    "sharded program (the B x N product)"),
     # serving layer (server/admission.py, server/pool.py, ops/batching.py)
     "tinysql_admission_admitted_total":
         ("counter", "Statements that began executing on the statement "
@@ -253,6 +269,17 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "tinysql_metrics_ring_entries":
         ("gauge", "Samples currently retained in the time-series ring"),
 }
+
+#: shardops.STATS key -> metric name (ONE map shared by the /metrics
+#: render and the tsring "shardops" source, so the two surfaces can
+#: never disagree on the sharded tier's names)
+SHARD_METRIC_NAMES = (
+    ("shard_rounds", "tinysql_shard_rounds_total"),
+    ("shard_rows_hwm", "tinysql_shard_rows_hwm"),
+    ("shard_exchange_bytes", "tinysql_shard_exchange_bytes_total"),
+    ("shard_skew_retries", "tinysql_shard_skew_retries_total"),
+    ("shard_stacked_rounds", "tinysql_shard_stacked_rounds_total"),
+)
 
 #: STATS keys that are high-water marks (gauges), not accumulators —
 #: THE definition; kernels imports it (as ``_HWM_KEYS``) so the
@@ -473,6 +500,17 @@ def render_prometheus() -> str:
         emit("tinysql_spill_open_slots",
              METRICS["tinysql_spill_open_slots"][1], "gauge",
              [((), sp.get("open_slots", 0))])
+    # mesh-sharded operator tier (ops/shardops.py STATS): rounds,
+    # per-shard row HWM, exchange bytes, skew fall-backs, stacked BxN
+    try:
+        from ..ops.shardops import stats_snapshot as shard_stats
+        sh = shard_stats()
+    except Exception:
+        sh = {}
+    if sh:
+        for key, name in SHARD_METRIC_NAMES:
+            kind = METRICS[name][0]
+            emit(name, METRICS[name][1], kind, [((), sh.get(key, 0))])
 
     # serving-layer counters: admission verdicts (server/admission.py)
     # and cross-query micro-batching (ops/batching.py)

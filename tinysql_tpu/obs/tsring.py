@@ -522,6 +522,13 @@ def _src_spill() -> Dict[str, float]:
             "tinysql_spill_open_slots": s.get("open_slots", 0)}
 
 
+def _src_shardops() -> Dict[str, float]:
+    from ..ops.shardops import stats_snapshot
+    from .metrics import SHARD_METRIC_NAMES
+    s = stats_snapshot()
+    return {name: s.get(key, 0) for key, name in SHARD_METRIC_NAMES}
+
+
 def _src_degrade() -> Dict[str, float]:
     from ..ops import degrade
     d = degrade.snapshot()
@@ -585,7 +592,8 @@ for _name, _fn in (("queries", _src_queries), ("kernels", _src_kernels),
                    ("progcache", _src_progcache), ("pool", _src_pool),
                    ("conn", _src_conn), ("admission", _src_admission),
                    ("batching", _src_batching), ("memory", _src_memory),
-                   ("spill", _src_spill), ("degrade", _src_degrade),
+                   ("spill", _src_spill), ("shardops", _src_shardops),
+                   ("degrade", _src_degrade),
                    ("failpoints", _src_failpoints),
                    ("prewarm", _src_prewarm), ("slo", _src_slo),
                    ("conprof", _src_conprof),

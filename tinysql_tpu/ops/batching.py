@@ -301,14 +301,21 @@ class BatchRound:
             _stat_add("stack_fallbacks")
             return False
         totals = cap.device_totals()
-        share = {key: v / n for key, v in totals.items()}
+        # exact occupancy split (shardops.split_exact): members' shares
+        # sum to the round's totals to the last ulp, so per-member (and,
+        # for sharded programs, per-shard) attribution reconciles with
+        # the global counters EXACTLY, not just approximately
+        from . import shardops
+        shares = shardops.split_exact(totals, n)
+        if shardops.shards_of_key(p0.key) > 1:
+            shardops.note_stacked_round()
         tree_map = kernels.jax().tree_util.tree_map
         for i, p in enumerate(chunk):
             if kind == "packed":
                 out = ("host", (rows[0][i], rows[1][i]))
             else:
                 out = ("dev", tree_map(lambda x, i=i: x[i], res))
-            self._store(p, out, share)
+            self._store(p, out, shares[i])
         _stat_add("stacked_rounds")
         _stat_add("stacked_statements", n)
         _stat_add("stacked_occupancy_sum", n)

@@ -199,25 +199,59 @@ def set_compile_cache_dir(path: str) -> None:
             pass
 
 
+def _machine_sig() -> str:
+    """Short host/backend machine signature partitioning the persistent
+    compile cache: AOT artifacts embed target machine features (CPU ISA
+    flags, TPU generation), and reloading one compiled for a different
+    target makes cpu_aot_loader spam "Target machine feature ... is not
+    supported" on every multichip run.  Keying the cache subdirectory by
+    (platform, machine, ISA flag set) means each compile target owns its
+    own cache instead of fighting over one directory."""
+    import hashlib
+    import platform as _platform
+    parts = [_platform.system(), _platform.machine()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags") or line.startswith("Features"):
+                    parts.append(" ".join(sorted(line.split(":", 1)[1].split())))
+                    break
+    except OSError:
+        parts.append(_platform.processor() or "")
+    if _jax is not None:
+        try:
+            parts.append(_jax.default_backend())
+        except Exception:
+            pass
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:12]
+
+
 def _cache_dir() -> str:
     """Persistent compile-cache directory.  Resolution: the sysvar
     override (set_compile_cache_dir) > TINYSQL_JAX_CACHE env > the
-    config file's compile_cache_dir > <repo>/.jax_cache."""
+    config file's compile_cache_dir > <repo>/.jax_cache — always suffixed
+    with the _machine_sig partition so caches shared across hosts (NFS
+    home, container image layers) never mix AOT compile targets."""
     import os
+    base = None
     if _CACHE_DIR_STATE["override"]:
-        return _CACHE_DIR_STATE["override"]
-    env = os.environ.get("TINYSQL_JAX_CACHE")
-    if env:
-        return env
-    try:
-        from ..config import get_global_config
-        cfg = get_global_config().compile_cache_dir
-        if cfg:
-            return cfg
-    except Exception:
-        pass
-    return os.path.join(os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+        base = _CACHE_DIR_STATE["override"]
+    if base is None:
+        env = os.environ.get("TINYSQL_JAX_CACHE")
+        if env:
+            base = env
+    if base is None:
+        try:
+            from ..config import get_global_config
+            cfg = get_global_config().compile_cache_dir
+            if cfg:
+                base = cfg
+        except Exception:
+            pass
+    if base is None:
+        base = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+    return os.path.join(base, "mt-" + _machine_sig())
 
 
 def jax():
@@ -1433,15 +1467,13 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
 
     Inputs must be padded to a bucket divisible by the mesh size (power-of-
     two buckets over power-of-two meshes always are)."""
-    from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from ..parallel import dist
+    from . import shardops
+    shard_map, P = dist.shard_map_fn()
     j = jax()
     jn = jnp()
     nb = int(gid_dev.shape[0])
-    n_dev = mesh.devices.size
+    n_dev = dist.mesh_shards(mesh)
     assert nb % n_dev == 0, (nb, n_dev)
     ns = bucket(max(n_segments, 1))
     # the shard_map spec is frozen per closure: the per-slot structure of
@@ -1452,7 +1484,7 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
                       for c in dev_cols)
     mask_fn, mask_key, mask_arr = _mask_parts(mask)
     key = ("seg_sharded", tuple(agg_specs), program_key, mask_key, ns, nb,
-           n_dev, dev_shape)
+           ("shards", n_dev), dev_shape)
 
     def build():
         arg_fns = [_lower_arg(e) for e in arg_exprs]
@@ -1500,6 +1532,7 @@ def fused_segment_aggregate_sharded(mesh, dev_cols, gid_dev,
             return pack_arrays(kernel_schema, items)
         return counted_jit(packed), kernel_schema
     pfn, schema = progcache.get(key, build)
+    shardops.note_round(nb // n_dev)
     vals = unpack_flat(pfn(tuple(dev_cols), gid_dev, mask_arr,
                            _params_dev(params)), schema)
     presence, first_orig = vals[0], vals[1]

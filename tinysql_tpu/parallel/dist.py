@@ -2,12 +2,44 @@
 
 The TPU-native replacement for the reference's distributed communication
 backend (SURVEY §2.6/§2.11): KV regions -> mesh shards; coprocessor
-scatter-gather (P2) -> data-parallel shard_map; the parallel hash agg's
-partial/final split (P5) -> per-shard segment reduce + psum over ICI;
-region-sharded join (P4) -> broadcast (all_gather) build side + local
-probe.  Collectives ride the mesh axis (ICI on real hardware, host rings on
-the CPU test mesh); no NCCL/MPI analogue exists or is needed — XLA inserts
-the collectives.
+scatter-gather (P2) -> data-parallel shard_map; region-sharded operators
+(P4/P5) -> the ops/shardops.py sharded tier.  Collectives ride the mesh
+axis (ICI on real hardware, host rings on the CPU test mesh); no
+NCCL/MPI analogue exists or is needed — XLA inserts the collectives.
+
+What actually ships on this layer today:
+
+- **partial->final aggregation** (P5; PAPERS.md "Global Hash Tables
+  Strike Back!", "Partial Partial Aggregates"): each shard reduces its
+  row slice to a fixed-shape partial state — segment tables for GROUP
+  BY (kernels.fused_segment_aggregate_sharded), scalar accumulator
+  lanes for global aggregates (shardops.fused_scalar_aggregate_sharded)
+  — merged ONCE over the mesh axis with psum/pmin/pmax.  No shuffle:
+  the partial state, not the rows, crosses the interconnect.
+- **broadcast join** (P4, small build side): probe rows shard, the
+  sorted build side replicates via all_gather, every shard probes
+  locally (devpipe's default mesh join; make_broadcast_join_counts is
+  the seed demo).
+- **shuffle join** (P4, large build side): both sides re-partition BY
+  KEY HASH over the mesh with all_to_all (hash_dest_np/_traced +
+  exchange_lanes + local_unique_join below, driven by devpipe's
+  joinshuf programs), so each shard holds only its hash partition of
+  the build table.
+- **partitioned build/probe join + semijoin, sharded sort/top-k**
+  (ops/shardops.py): the host scatters rows into per-shard blocks with
+  THE PR 9 SPILL PARTITIONER (ops/spill.py hash_partition — shard =
+  spill partition, one partitioner drives device placement and the
+  spill ladder), shards work locally, exact merges (searchsorted rank
+  counting, top-k tournaments) happen on-device.
+
+Policy lives here too: session_mesh/sized_mesh gate on
+tidb_mesh_parallel and cache Mesh objects; shard_bucket is the
+estRows->shard-count launder the planner annotates plans with;
+shardable is the per-dispatch row-bucket gate.  The 1-device outcome of
+any gate means "run the single-device kernel" — Tier-1 on CPU is byte
+identical because every sharded family degenerates to its unsharded
+twin below the thresholds.  Every shard_map in the tree is constructed
+through shard_map_fn/shard_map_unchecked (qlint DF805 enforces this).
 """
 from __future__ import annotations
 
@@ -71,6 +103,49 @@ def session_mesh(session_vars):
     return _SESSION_MESH
 
 
+_SIZED_MESHES: dict = {}
+
+
+def sized_mesh(n_shards: int):
+    """A cached k-device submesh (first k devices) for plans whose
+    estRows-driven shard count is below the full device set; k < 2
+    degenerates to None = run the single-device kernel."""
+    if n_shards < 2:
+        return None
+    devs = kernels.jax().devices()
+    k = min(int(n_shards), len(devs))
+    if k < 2:
+        return None
+    m = _SIZED_MESHES.get(k)
+    if m is None or m.devices.size != k:
+        m = _SIZED_MESHES[k] = make_mesh(k)
+    return m
+
+
+def mesh_shards(mesh) -> int:
+    """Shard count of a mesh — THE sanctioned launder from mesh shape to
+    progcache-key literal (qlint DF807: mesh-shape scalars must not mint
+    program keys except through here / shard_bucket)."""
+    return 0 if mesh is None else int(mesh.devices.size)
+
+
+#: a shard must expect at least this many rows before fan-out pays for
+#: the partition scatter + collectives (estRows-driven; the per-dispatch
+#: row-bucket gate `shardable` still applies at runtime)
+MIN_SHARD_ROWS = 256
+
+
+def shard_bucket(est_rows: float, n_devices: int) -> int:
+    """estRows -> power-of-two shard count <= n_devices: the planner's
+    mesh admissibility output and the OTHER sanctioned mesh-shape
+    launder.  1 means 'stay single-device' (the degenerate mesh)."""
+    n = 1
+    est = max(float(est_rows or 0), 0.0)
+    while n * 2 <= n_devices and est >= MIN_SHARD_ROWS * (n * 2):
+        n *= 2
+    return n
+
+
 def shardable(nb: int, mesh) -> bool:
     """Row-bucket gate for sharding over `mesh`: divisible and big enough
     to amortize the collectives."""
@@ -95,8 +170,7 @@ def make_sharded_group_sum(mesh, n_buckets: int):
     """
     jax = kernels.jax()
     jnp = kernels.jnp()
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    shard_map, P = shard_map_fn()
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P("shard", None), P("shard", None), P("shard", None)),
@@ -127,8 +201,7 @@ def make_broadcast_join_counts(mesh):
     re-sharding via all_to_all) lands with the distributed executor."""
     jax = kernels.jax()
     jnp = kernels.jnp()
-    from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    shard_map, P = shard_map_fn()
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P("shard", None), P("shard", None), P(None)),
