@@ -249,6 +249,16 @@ def main():
                     extra["achieved_gflops"] = round(flops / best / 1e9, 3)
                     if pk_fl:
                         extra["mfu"] = round(flops / best / pk_fl, 6)
+            # memory truth (ISSUE 18): a fourth, UNTIMED run bracketed
+            # by the heap probe — tracemalloc taxes every allocation in
+            # the process, so the measured walls above stay probe-free
+            from tinysql_tpu.obs import memprof
+            probe = memprof.QueryMemProbe()
+            probe.start()
+            s.query(sql)
+            tracked_peak = getattr(getattr(s, "_stmt_mem", None),
+                                   "peak", 0) or 0
+            extra.update(probe.finish(tracked_peak_bytes=tracked_peak))
             # cold-start is a first-class metric (ROADMAP item 3): the
             # first-ever run pays whatever compilation the caches missed
             run_stats[sql] = {"runs_s": walls, "first_run_s": walls[0],
@@ -496,6 +506,14 @@ def main():
     conprof_overhead_frac = conprof_overhead["conprof_overhead_frac"]
     print(f"[bench] conprof_overhead_frac={conprof_overhead_frac} "
           f"({conprof_overhead})", file=sys.stderr)
+    # heap-profiler self-cost (ISSUE 18): one snapshot+fold tick against
+    # THIS process at the default rate — ONE shared definition with
+    # bench_serve (memprof.measure_overhead / live_overhead_frac)
+    from tinysql_tpu.obs import memprof as _memprof
+    memprof_overhead = _memprof.measure_overhead()
+    memprof_overhead_frac = memprof_overhead["memprof_overhead_frac"]
+    print(f"[bench] memprof_overhead_frac={memprof_overhead_frac} "
+          f"({memprof_overhead})", file=sys.stderr)
 
     q1_dev, q1_cpu, q1_lite, q1_ok = results["Q1"]
     # the metric NAME carries the tier that actually ran: an XLA:CPU run
@@ -521,6 +539,7 @@ def main():
         "spill": spill_summary,
         "obs_overhead_frac": obs_overhead_frac,
         "conprof_overhead_frac": conprof_overhead_frac,
+        "memprof_overhead_frac": memprof_overhead_frac,
         "link": link,
         "correct": all(ok for _, _, _, ok in results.values())
                    and all(e["match"] for e in op_results.values())

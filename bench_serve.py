@@ -29,6 +29,7 @@ bench.py's contract):
     {"metric": "serve_p99_ms", "value": ..., "unit": "ms"}
     {"metric": "obs_overhead_frac", "value": ..., "unit": "frac"}
     {"metric": "conprof_overhead_frac", "value": ..., "unit": "frac"}
+    {"metric": "memprof_overhead_frac", "value": ..., "unit": "frac"}
     {"metric": "serve_queue_wait_p99_share", "value": ..., "unit": "frac"}
     {"metric": "serve_dispatches_per_query", "value": ..., "unit": "dispatches"}
     {"metric": "serve_storm_dispatches_per_query", "value": ..., "unit": "dispatches"}
@@ -45,9 +46,11 @@ sample's wall over the default interval, measured against the live
 process — hard gate < 3%); conprof_overhead_frac is the continuous
 host profiler's LIVE self-cost across the mixed + storm window
 (obs/conprof.live_overhead_frac — also hard-gated < 3%, with the
-sampler's own backoff as the enforcement mechanism); the queue-wait
-share splits the published p99 into wait vs execution from the
-"queue" phase histogram.
+sampler's own backoff as the enforcement mechanism);
+memprof_overhead_frac is the continuous HEAP profiler's live self-cost
+over the same window (obs/memprof.live_overhead_frac — same < 3% gate,
+same backoff enforcement); the queue-wait share splits the published
+p99 into wait vs execution from the "queue" phase histogram.
 
 Hard assertions (the serve-smoke CI gate): zero statement errors, at
 least one coalesced batch with occupancy > 1 in the storm, at least
@@ -55,12 +58,13 @@ one STACKED round (one vmap-batched dispatch per group,
 tidb_batch_stack_max) with the storm's dispatches-per-query <= 0.6,
 zero progcache misses across the storm, storm results == solo results,
 /debug/conprof collapsed stacks from >= 3 thread roles, storm digest
-family carries sum_cpu_ms > 0 with cpu_ms <= exec wall, and both
-observability overhead fractions under 3%.
+family carries sum_cpu_ms > 0 with cpu_ms <= exec wall, and all three
+observability overhead fractions (obs / conprof / memprof) under 3%.
 
 Env knobs: SERVE_CLIENTS (8), SERVE_SF (0.02), SERVE_REQUESTS (24,
 per client, mixed phase), SERVE_STORM (32, total storm statements),
 SERVE_POOL (4), SERVE_QUEUE (256), SERVE_CONPROF_HZ (100),
+SERVE_MEMPROF_HZ (10),
 SERVE_C10K_CONNS (1024), SERVE_C10K_ROUNDS (4, burst rounds),
 SERVE_C10K_OVERLOAD (16, over-cap connect burst).
 """
@@ -152,6 +156,11 @@ def main():
     # there) and requires CPU attribution on the storm digest family
     boot.execute("set global tidb_conprof_rate = "
                  f"{int(os.environ.get('SERVE_CONPROF_HZ', '100'))}")
+    # continuous heap profiler ON: same live self-cost contract — the
+    # sampler's backoff stretches the period when a snapshot costs too
+    # much, and the bench gates the measured live fraction < 3%
+    boot.execute("set global tidb_memprof_rate = "
+                 f"{int(os.environ.get('SERVE_MEMPROF_HZ', '10'))}")
 
     def q6_variant(i: int) -> str:
         lo = 0.03 + (i % 5) * 0.01
@@ -236,6 +245,11 @@ def main():
     # definition the gate below judges)
     conprof0 = conprof.stats_snapshot()
     conprof_t0 = time.time()
+    # memory-truth window opens with it (ISSUE 18): same live-overhead
+    # definition, same gate, for the heap profiler's sampler
+    from tinysql_tpu.obs import memprof
+    memprof0 = memprof.stats_snapshot()
+    memprof_t0 = time.time()
     # dispatches-per-query over the mixed phase (the ROADMAP item 2
     # gate): compiled-program dispatches the whole serving tier paid,
     # divided by the statements the clients completed
@@ -569,14 +583,27 @@ def main():
     collapsed_text = urlopen(
         f"http://127.0.0.1:{status_port}/debug/conprof",
         timeout=10).read().decode()
+    heap_text = urlopen(
+        f"http://127.0.0.1:{status_port}/debug/heap",
+        timeout=10).read().decode()
     status.close()
     conprof_roles = sorted({line.split(";", 1)[0]
                             for line in collapsed_text.splitlines()
                             if line.strip()})
+    heap_roles = sorted({line.split(";", 1)[0]
+                         for line in heap_text.splitlines()
+                         if line.strip()})
     from tinysql_tpu.obs import stmtsummary
     q6_digest, _ = stmtsummary.normalize(q6_variant(0))
     q6_cpu = [r for r in stmtsummary.snapshot()
               if r.get("digest") == q6_digest]
+    memprof_stats = memprof.stats_snapshot()
+    memprof_frac = memprof.live_overhead_frac(
+        memprof0, memprof_stats, time.time() - memprof_t0)
+    print(f"[serve] memprof frac={memprof_frac} backoff="
+          f"{memprof_stats.get('backoff')} ticks="
+          f"{memprof_stats.get('ticks')} roles={heap_roles}",
+          file=sys.stderr)
     print(f"[serve] conprof frac={conprof_frac} backoff="
           f"{conprof_stats.get('backoff')} roles={conprof_roles} "
           f"q6 cpu={[(r['device'].get('cpu_samples'), round(float(r['device'].get('cpu_s', 0)) * 1e3, 1)) for r in q6_cpu]}",
@@ -602,6 +629,15 @@ def main():
             "backoff": conprof_stats.get("backoff", 1),
             "roles": conprof_roles,
         },
+        "memprof": {
+            "overhead_frac": memprof_frac,
+            "ticks": memprof_stats.get("ticks", 0),
+            "sites": memprof_stats.get("sites", 0),
+            "attributed": memprof_stats.get("attributed", 0),
+            "backoff": memprof_stats.get("backoff", 1),
+            "errors": memprof_stats.get("errors", 0),
+            "roles": heap_roles,
+        },
         "queue_wait_p99_ms": round(queue_p99_ms, 2),
         "queue_wait_stmts": queue_hist["count"],
         "total_bench_seconds": round(time.time() - t_start, 1),
@@ -615,6 +651,8 @@ def main():
                       "unit": "frac"}))
     print(json.dumps({"metric": "conprof_overhead_frac",
                       "value": conprof_frac, "unit": "frac"}))
+    print(json.dumps({"metric": "memprof_overhead_frac",
+                      "value": memprof_frac, "unit": "frac"}))
     print(json.dumps({"metric": "serve_queue_wait_p99_share",
                       "value": queue_share, "unit": "frac"}))
     print(json.dumps({"metric": "serve_dispatches_per_query",
@@ -672,6 +710,10 @@ def main():
     # the continuous profiler's LIVE self-cost stays under 3% of one
     # core (the sampler's own backoff enforces it; the gate proves it)
     assert conprof_frac < 0.03, (conprof_frac, conprof_stats)
+    # ---- memory truth gate (ISSUE 18 acceptance) ------------------------
+    # the heap profiler's LIVE self-cost stays under 3% of one core too
+    # (same backoff mechanism, same measured-live definition)
+    assert memprof_frac < 0.03, (memprof_frac, memprof_stats)
     # /debug/conprof saw the serving path: collapsed stacks from at
     # least 3 distinct thread roles under storm load
     assert len(conprof_roles) >= 3, (conprof_roles,

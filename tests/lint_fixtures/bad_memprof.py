@@ -1,0 +1,52 @@
+"""OB407 fixture: heap/HBM accumulator writes outside obs/memprof.py.
+
+The statement-memory counters (``heap_kb`` / ``heap_peak_kb`` /
+``hbm_bytes``) carry MEASURED truth — the sampler tick's traced-delta
+split (≤ process growth), the tracemalloc peak, and the device-buffer
+census — only the heap profiler's sampler tick may write them; and the
+profiler's window store may only be mutated by that same tick
+(rotation/eviction accounting).
+
+Every line marked OB407 below must fire the rule; the clean patterns at
+the bottom must stay silent.  Never imported — parsed by test_lint.py.
+"""
+from tinysql_tpu.obs import context as _obs
+from tinysql_tpu.obs import memprof
+from tinysql_tpu.obs.memprof import PROF, sample_once
+from tinysql_tpu.ops import kernels
+
+
+def fake_heap_attribution(qobs, nbytes):
+    # a guessed allocation size laundered into the measured counters
+    qobs.add_counter("heap_kb", nbytes / 1024.0)       # OB407
+    qobs.hwm_counter("heap_peak_kb", nbytes)           # OB407
+    qobs.hwm_counter("hbm_bytes", nbytes)              # OB407
+    kernels.stats_add("heap_kb", 1.0)                  # OB407
+    _obs.record("hbm_bytes", 4096)                     # OB407
+
+
+def fake_profile_tick():
+    # mutating the window store from outside the sampler corrupts the
+    # rotation/eviction accounting
+    memprof.PROF.sample_once(0.1)                      # OB407
+    PROF.reset()                                       # OB407
+    sample_once(0.1)                                   # OB407
+
+
+def clean_patterns():
+    # reads are fine anywhere — that is what the mem-table scan,
+    # /debug/heap, and the benches do
+    rows = memprof.memory_usage_rows()
+    text = memprof.collapsed(window_s=60)
+    stats = memprof.stats_snapshot()
+    census = memprof.hbm_census()
+    # unrelated counters route through the accumulators freely
+    kernels.stats_add("dispatches", 1)
+    _obs.record("d2h_bytes", 4096)
+    # an unrelated local reset/PROF is not memprof state
+    PROF_LOCAL = {"x": 1}
+
+    def reset():
+        PROF_LOCAL.clear()
+    reset()
+    return rows, text, stats, census

@@ -400,6 +400,43 @@ def _prewarm_compile_error(tk):
         s.storage._global_vars.pop("tidb_auto_prewarm_cooldown", None)
 
 
+@chaos("memprofSampleError")
+def _memprof_sample_error(tk):
+    """An injected snapshot failure kills exactly one heap-profiler tick:
+    the background sampler counts the error and keeps ticking — never
+    wedges, never surfaces to a statement."""
+    from tinysql_tpu.obs import memprof
+    s, _ = tk
+    prof = memprof.HeapProfiler()
+    sampler = memprof.MemprofSampler(s.storage, profiler=prof)
+    s.storage._global_vars["tidb_memprof_rate"] = 50
+    try:
+        with fail.armed("memprofSampleError",
+                        exc=RuntimeError("injected snapshot failure"),
+                        times=1):
+            sampler.start()
+            deadline = time.time() + 10
+            while time.time() < deadline and \
+                    prof.stats_snapshot()["errors"] < 1:
+                time.sleep(0.01)
+        st = prof.stats_snapshot()
+        assert st["errors"] == 1, st
+        # disarmed: the sampler is NOT wedged — clean ticks keep landing
+        # (the failed tick itself never counted: the fault fires before
+        # the fold, so the store stayed consistent)
+        t0 = st["ticks"]
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                prof.stats_snapshot()["ticks"] <= t0:
+            time.sleep(0.01)
+        st2 = prof.stats_snapshot()
+        assert st2["ticks"] > t0, st2
+        assert st2["errors"] == 1, st2
+    finally:
+        sampler.close()
+        s.storage._global_vars.pop("tidb_memprof_rate", None)
+
+
 def _spill_session(s):
     """Put the chaos session on the device path (the spill routes live
     in the TPU executors) with no row-count gate."""

@@ -792,6 +792,50 @@ def test_ob406_reads_and_unrelated_names_silent(tmp_path):
     assert lint_obs_discipline(SourceFile(str(p))) == []
 
 
+def test_memprof_fixture_fires_ob407():
+    sf = SourceFile(os.path.join(FIXDIR, "bad_memprof.py"))
+    diags = lint_obs_discipline(sf)
+    got = [d for d in diags if d.rule == "OB407"]
+    # 5 laundered memory-key writes + 3 store mutations; the reads and
+    # the unrelated local reset/PROF stay silent
+    assert len(got) == 8, [d.format() for d in diags]
+    assert sum(1 for d in got if "memory counter" in d.message) == 5
+    assert sum(1 for d in got if "store write" in d.message) == 3
+    # and nothing else fires: the fixture is OB407-pure
+    assert {d.rule for d in diags} == {"OB407"}, \
+        [d.format() for d in diags]
+
+
+def test_ob407_owning_module_exempt(tmp_path):
+    # obs/memprof.py owns the fold/attribution state; a same-named file
+    # is exempt by basename like the OB401/OB405/OB406 contracts
+    p = tmp_path / "memprof.py"
+    p.write_text("def attribute(qobs, kb):\n"
+                 "    qobs.add_counter('heap_kb', kb)\n"
+                 "    qobs.hwm_counter('heap_peak_kb', kb)\n"
+                 "    qobs.hwm_counter('hbm_bytes', kb * 1024)\n")
+    assert lint_obs_discipline(SourceFile(str(p))) == []
+
+
+def test_ob407_reads_and_unrelated_names_silent(tmp_path):
+    # reads are what the benches/mem-tables do, and an unrelated
+    # sample_once/reset (no provable memprof import) is not memprof
+    p = tmp_path / "elsewhere.py"
+    p.write_text("from tinysql_tpu.obs import memprof\n"
+                 "rows = memprof.memory_usage_rows()\n"
+                 "text = memprof.collapsed(window_s=60)\n"
+                 "census = memprof.hbm_census()\n"
+                 "class Ring:\n"
+                 "    def sample_once(self):\n"
+                 "        pass\n"
+                 "r = Ring()\n"
+                 "r.sample_once()\n"
+                 "def reset():\n"
+                 "    pass\n"
+                 "reset()\n")
+    assert lint_obs_discipline(SourceFile(str(p))) == []
+
+
 def test_metric_fixture_fires_ob404():
     sf = SourceFile(os.path.join(FIXDIR, "bad_metric.py"))
     diags = lint_obs_discipline(sf)
@@ -886,6 +930,7 @@ def test_corpus_plans_clean():
     ("obs", "bad_metric.py"),
     ("obs", "bad_devtime.py"),
     ("obs", "bad_conprof.py"),
+    ("obs", "bad_memprof.py"),
     ("conc", "bad_race.py"),
     ("conc", "bad_lockorder.py"),
     ("conc", "bad_blocking.py"),

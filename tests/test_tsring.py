@@ -447,7 +447,10 @@ EXPECTED_RULES = {"compile-storm", "progcache-hit-rate",
                   # C10k wire front end (ISSUE 15)
                   "connection-pressure",
                   # mesh-sharded operator tier (ISSUE 17)
-                  "shard-imbalance"}
+                  "shard-imbalance",
+                  # memory truth (ISSUE 18) — induced in
+                  # test_memprof.py alongside the profiler they judge
+                  "heap-growth", "hbm-pressure", "mem-untracked"}
 
 
 def test_rule_catalogue_fully_covered():

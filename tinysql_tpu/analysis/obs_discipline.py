@@ -45,6 +45,19 @@ Rules:
   (``sample_once`` / ``reset`` on the module or its ``PROF``/
   ``Profiler`` instances) would corrupt the rotation/eviction
   accounting behind ``information_schema.continuous_profiling``.
+- **OB407**: heap/HBM accumulator writes outside ``obs/memprof.py``.
+  The memory keys (``heap_kb`` / ``heap_peak_kb`` / ``hbm_bytes``) are
+  MEASURED truth: ``heap_kb`` is the sampler tick's traced-delta split
+  across executing statements (so the per-statement sum stays ≤ the
+  process's measured growth), ``heap_peak_kb`` is the tracemalloc
+  high-water mark, and ``hbm_bytes`` is the live device-buffer census.
+  Any other writer would publish a guess as measurement and break the
+  ≤-growth invariant behind ``statements_summary.sum_heap_alloc_kb``;
+  and any out-of-module mutation of the heap profiler's window store
+  (``sample_once`` / ``reset`` on the module or its ``PROF``/
+  ``HeapProfiler`` instances) would corrupt the rotation/eviction
+  accounting behind ``information_schema.memory_usage`` and
+  ``/debug/heap``.
 - **OB404**: metric-name drift.  In any module that touches the
   time-series ring (imports ``obs/tsring.py``, or IS it), every
   ``tinysql_*`` metric-name string literal must be declared in the
@@ -87,6 +100,10 @@ register_rules({
              "obs/conprof.py — only the sampler tick may claim "
              "statement CPU (cpu_s/cpu_samples) or mutate the "
              "window store",
+    "OB407": "heap/HBM accumulator write outside obs/memprof.py — only "
+             "the heap profiler's sampler tick may claim statement "
+             "memory (heap_kb/heap_peak_kb/hbm_bytes) or mutate the "
+             "window store",
 })
 
 #: modules that own a STATS dict and its accessors (the serving layer's
@@ -123,6 +140,16 @@ CONPROF_OWNING_MODULE = "conprof.py"
 
 #: mutating entry points on the profiler store / its module facade
 _CONPROF_WRITERS = {"sample_once", "reset"}
+
+#: statement-memory attribution keys (OB407) and their owning module:
+#: the heap profiler's sampler tick is the ONLY writer — these carry
+#: the traced-delta split (≤ measured process growth), the tracemalloc
+#: peak, and the device-buffer census
+HEAP_KEYS = {"heap_kb", "heap_peak_kb", "hbm_bytes"}
+MEMPROF_OWNING_MODULE = "memprof.py"
+
+#: mutating entry points on the heap-profiler store / its module facade
+_MEMPROF_WRITERS = {"sample_once", "reset"}
 
 
 def _is_stats_target(e: ast.expr) -> bool:
@@ -314,6 +341,95 @@ def _lint_conprof_writes(sf: SourceFile) -> List[Diagnostic]:
     return diags
 
 
+# ---- OB407: heap-profiler write discipline --------------------------------
+
+#: accumulator entry points a memory key could ride through — the
+#: device-time sinks plus the high-water-mark scope accessor memprof's
+#: attribution actually uses
+_MEMPROF_SINKS = _DEVTIME_SINKS | {"hwm_counter"}
+
+
+def _memprof_import_aliases(sf: SourceFile):
+    """(module aliases, writer names, profiler-instance names) bound by
+    any import of memprof — the OB406 matching contract: a name READING
+    as the module (bare ``memprof`` / any ``.memprof`` attribute)
+    matches by naming convention; the generic names (``reset`` /
+    ``sample_once`` / ``PROF``) qualify only when PROVABLY imported
+    from memprof, so an unrelated local ``reset`` helper or ``PROF``
+    global stays silent."""
+    modules, writers, profs = {"memprof"}, set(), set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.rsplit(".", 1)[-1] == "memprof" \
+                        and alias.asname:
+                    modules.add(alias.asname)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.rsplit(".", 1)[-1] == "memprof":
+                for alias in node.names:
+                    if alias.name in _MEMPROF_WRITERS:
+                        writers.add(alias.asname or alias.name)
+                    elif alias.name in ("PROF", "HeapProfiler"):
+                        profs.add(alias.asname or alias.name)
+            else:
+                for alias in node.names:
+                    if alias.name == "memprof":
+                        modules.add(alias.asname or alias.name)
+    return modules, writers, profs
+
+
+def _is_memprof_target(e: ast.expr, module_aliases: set,
+                       prof_aliases: set) -> bool:
+    """``memprof`` (under any alias) / ``obs.memprof`` /
+    ``memprof.PROF`` / a ``PROF`` imported FROM memprof."""
+    if isinstance(e, ast.Name):
+        return e.id in module_aliases or e.id in prof_aliases
+    if isinstance(e, ast.Attribute):
+        if e.attr == "memprof":
+            return True
+        return e.attr == "PROF" \
+            and _is_memprof_target(e.value, module_aliases, prof_aliases)
+    return False
+
+
+def _lint_memprof_writes(sf: SourceFile) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    module_aliases, writer_aliases, prof_aliases = \
+        _memprof_import_aliases(sf)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        # (a) a statement-memory key laundered through an accumulator
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if name in _MEMPROF_SINKS and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and arg.value in HEAP_KEYS:
+                diags.append(Diagnostic(
+                    "OB407",
+                    f"`{name}({arg.value!r}, ...)` writes a statement-"
+                    "memory counter outside obs/memprof.py — only the "
+                    "heap profiler's sampler tick may claim heap_kb/"
+                    "heap_peak_kb/hbm_bytes (measured, ≤-growth-capped)",
+                    sf.path, node.lineno))
+                continue
+        # (b) a mutating call on the heap-profiler store itself
+        hit = (isinstance(f, ast.Attribute)
+               and f.attr in _MEMPROF_WRITERS
+               and _is_memprof_target(f.value, module_aliases,
+                                      prof_aliases)) \
+            or (isinstance(f, ast.Name) and f.id in writer_aliases)
+        if hit:
+            diags.append(Diagnostic(
+                "OB407",
+                "heap-profiler store write outside obs/memprof.py — "
+                "window rotation/eviction accounting belongs to the "
+                "sampler",
+                sf.path, node.lineno))
+    return diags
+
+
 # ---- OB404: metric-name registry discipline -------------------------------
 
 #: matches the exported metric naming convention; deliberately excludes
@@ -399,6 +515,8 @@ def lint_obs_discipline(sf: SourceFile) -> List[Diagnostic]:
         diags.extend(_lint_devtime_writes(sf))
     if base != CONPROF_OWNING_MODULE:
         diags.extend(_lint_conprof_writes(sf))
+    if base != MEMPROF_OWNING_MODULE:
+        diags.extend(_lint_memprof_writes(sf))
     if base in OWNING_MODULES:
         return sf.filter(diags)
     for node in ast.walk(sf.tree):

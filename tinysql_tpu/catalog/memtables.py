@@ -21,7 +21,10 @@ filter like any other source:
   flops/bytes, joinable with ``statements_summary`` on plan_digest;
 - ``continuous_profiling``: the continuous host profiler's windowed
   folded stacks (obs/conprof.py) — per (window, thread role, stack)
-  sample counts and estimated cpu_ms.
+  sample counts and estimated cpu_ms;
+- ``memory_usage``: the memory reconciliation ledger (obs/memprof.py)
+  — tracked MemTracker bytes vs measured heap/RSS vs the HBM census
+  with per-owner attribution and the unattributed leak bucket.
 
 Rows are produced from the live InfoSchema / obs stores at query time.
 The catalog lists ITSELF: ``information_schema`` appears in SCHEMATA,
@@ -72,6 +75,11 @@ def _conprof_cols():
     return list(COLUMNS)
 
 
+def _memory_usage_cols():
+    from ..obs.memprof import MEMORY_USAGE_COLUMNS
+    return list(MEMORY_USAGE_COLUMNS)
+
+
 # table name -> [(column name, kind)];  statements_summary's layout is
 # owned by obs/stmtsummary.COLUMNS (one definition for store + catalog)
 _TABLES = {
@@ -100,6 +108,7 @@ _TABLES = {
     "inspection_result": _inspection_cols,
     "compiled_programs": _programs_cols,
     "continuous_profiling": _conprof_cols,
+    "memory_usage": _memory_usage_cols,
     "processlist": [("id", "int"),
                     ("user", "str"),
                     ("db", "str"),
@@ -171,6 +180,11 @@ def memtable_rows(infoschema, table: str) -> List[list]:
         # the SQL face of /debug/conprof
         from ..obs import conprof
         return conprof.rows()
+    if t == "memory_usage":
+        # the memory reconciliation ledger (obs/memprof.py): tracked vs
+        # measured vs HBM census — the SQL face of /debug/heap's truth
+        from ..obs import memprof
+        return memprof.memory_usage_rows()
     out: List[list] = []
     if t == "schemata":
         out.append(["def", DB_NAME])

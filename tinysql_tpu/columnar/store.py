@@ -17,6 +17,7 @@ remains the write path and source of truth; this store is a cache/replica:
 from __future__ import annotations
 
 import threading
+import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -24,6 +25,7 @@ import numpy as np
 
 from ..catalog.model import TableInfo
 from ..mytypes import EvalType
+from ..obs import memprof as _memprof
 
 
 @dataclass
@@ -47,10 +49,25 @@ class ColumnarTable:
         return v
 
 
+#: every live store, weakly held — the HBM census walks them to claim
+#: replica-memoized device buffers, and the spill gates read measured
+#: row widths off them (obs/memprof.measured_row_bytes)
+_STORES: "weakref.WeakSet[ColumnarStore]" = weakref.WeakSet()
+
+
+def live_stores() -> List["ColumnarStore"]:
+    return list(_STORES)
+
+
 class ColumnarStore:
     def __init__(self):
         self._tables: Dict[int, ColumnarTable] = {}
         self._mu = threading.Lock()
+        _STORES.add(self)
+
+    def tables_snapshot(self) -> List[ColumnarTable]:
+        with self._mu:
+            return list(self._tables.values())
 
     def get(self, table_id: int) -> Optional[ColumnarTable]:
         with self._mu:
@@ -63,6 +80,18 @@ class ColumnarStore:
     def invalidate(self, table_id: int) -> None:
         with self._mu:
             self._tables.pop(table_id, None)
+
+
+def _replica_memo_values():
+    """HBM census walker: every replica's derived-state memo values —
+    where ALL long-lived device buffers in the engine are born
+    (rep.memo(..., lambda: kernels.h2d(...)) in the executors)."""
+    for s in live_stores():
+        for tbl in s.tables_snapshot():
+            yield list(tbl.cache.values())
+
+
+_memprof.register_census_walker("replica", _replica_memo_values)
 
 
 def store_of(storage) -> ColumnarStore:

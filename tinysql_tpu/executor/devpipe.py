@@ -2524,7 +2524,7 @@ class DevPipeExec:
         run partitioned, the whole pipeline steps aside."""
         from ..ops import spill
         from ..utils import memory as _memory
-        from .tpu_executors import _JOIN_ROW_BYTES, _NOMINAL_ROW_BYTES
+        from .tpu_executors import _JOIN_ROW_BYTES, _probe_row_bytes
 
         def est_of(p) -> float:
             return float(getattr(p, "stats_row_count", 0.0) or 0.0)
@@ -2535,7 +2535,11 @@ class DevPipeExec:
                 # the join gate prices BOTH sides (it materializes both)
                 b = sum(est_of(c) for c in p.children) * _JOIN_ROW_BYTES
             else:
-                b = est_of(p) * _NOMINAL_ROW_BYTES
+                # measured replica row width when one exists, else the
+                # nominal pre-drain price — identical to the
+                # per-operator probe (_would_spill_here)
+                b = est_of(p) * _probe_row_bytes(
+                    p, getattr(ctx, "storage", None))
             for c in getattr(p, "children", ()):
                 b = max(b, max_bytes(c))
             return b

@@ -139,9 +139,44 @@ def _maybe_spill_ctx(ctx, est_rows: float, actual_rows: int,
 _JOIN_ROW_BYTES = 36
 
 #: nominal per-row pricing for the sort/topn PRE-drain softness check —
-#: the exact key layout isn't known until after materialization, so the
+#: the FALLBACK when no measured width exists (obs/memprof.py
+#: measured_row_bytes replaces it with the table's replica truth): the
 #: would-this-spill probe prices one 8-byte key + null + rowid
 _NOMINAL_ROW_BYTES = 17
+
+
+def _plan_base_table_id(plan) -> int:
+    """Table id of the single base table feeding ``plan`` (walks reader
+    wrappers and unary operators down to the scan; 0 when the subtree is
+    not scan-rooted — joins, memtables)."""
+    node = plan
+    for _ in range(32):
+        scan = getattr(node, "scan", None) or \
+            getattr(node, "table_scan", None)
+        if scan is not None:
+            node = scan
+        info = getattr(node, "table_info", None)
+        if info is not None:
+            return int(info.id)
+        kids = getattr(node, "children", None)
+        if not kids or len(kids) != 1:
+            return 0
+        node = kids[0]
+    return 0
+
+
+def _probe_row_bytes(plan, storage=None) -> int:
+    """Measured per-row width for the pre-drain spill probe: the base
+    table's replica truth (obs/memprof.py — device-memoized column
+    bytes over rows) when a replica exists, else the nominal constant.
+    The measured number prices what a drained row of THIS table really
+    costs, so `would_spill` flips where the ledger alone would not."""
+    from ..obs import memprof
+    tid = _plan_base_table_id(plan)
+    if tid <= 0:
+        return _NOMINAL_ROW_BYTES
+    return memprof.measured_row_bytes(tid, _NOMINAL_ROW_BYTES,
+                                      storage=storage)
 
 
 def _would_spill_here(ctx, plan) -> bool:
@@ -154,7 +189,9 @@ def _would_spill_here(ctx, plan) -> bool:
     from ..utils import memory as _memory
     return spill.would_spill(_memory.current(),
                              _est_rows_of(plan.children[0]),
-                             _NOMINAL_ROW_BYTES)
+                             _probe_row_bytes(plan.children[0],
+                                              getattr(ctx, "storage",
+                                                      None)))
 
 
 def _mask_compact_threshold() -> float:

@@ -121,3 +121,11 @@ EXEC_SLOW_NEXT = register(
     "fires once per root drain block — a sleep action makes any "
     "statement controllably long-running (KILL / max_execution_time "
     "tests; executor/executors.py Executor.drain)")
+
+# ---- continuous heap profiler (obs/memprof.py) -----------------------------
+MEMPROF_SAMPLE_ERROR = register(
+    "memprofSampleError",
+    "one heap-profiler sampling tick fails at snapshot time "
+    "(obs/memprof.py HeapProfiler.sample_once) — the background sampler "
+    "counts the error and keeps ticking, the fold/attribution store "
+    "stays consistent, no statement or surface is affected")

@@ -111,6 +111,7 @@ ROLE_PREFIXES: Tuple[Tuple[str, str], ...] = (
     ("devpipe-stage", "devpipe"),       # executor/devpipe.py producer
     ("metrics-sampler", "tsring"),      # obs/tsring.py Sampler
     ("conprof-sampler", "conprof"),     # this module's own sampler
+    ("memprof-sampler", "memprof"),     # obs/memprof.py heap sampler
     ("auto-prewarm", "prewarm"),        # session/prewarm.py worker
     ("distsql-cop", "distsql"),         # distsql/client.py task pool
     ("status-http", "http"),            # server/http_status.py

@@ -64,7 +64,15 @@ tests/test_tsring.py):
   /debug/conprof has the dominant stacks;
 - **profiler-overhead** (ISSUE 13): the continuous profiler's own
   sampling cost ran past its budget share of one core — the rule
-  reports it while the sampler's backoff divisor absorbs it.
+  reports it while the sampler's backoff divisor absorbs it;
+- **heap-growth** (ISSUE 18): the MEASURED python heap
+  (obs/memprof.py) rose monotonically across the window past the
+  threshold — leak-shaped growth, with /debug/heap holding the sites;
+- **hbm-pressure** (ISSUE 18): the HBM census approaches the backend's
+  exposed device-memory capacity (silent on CPU, which exposes none);
+- **mem-untracked** (ISSUE 18): measured heap growth diverged from the
+  MemTracker ledger beyond the documented band — allocation the
+  spill/admission gates cannot see.
 
 Thresholds are module-level constants, deliberately conservative: an
 inspection finding is a diagnosis, so false positives cost trust.
@@ -151,6 +159,20 @@ SHARD_SKEW_RETRIES_WARN = 2
 #: the rule speaks at all — one refused connect is a client retrying
 #: against a deliberately small cap, not pressure
 CONN_SHEDS_WARN = 2
+
+#: heap-growth (ISSUE 18): minimum sampled points of the traced-heap
+#: gauge before monotone-rise leak detection may judge, the fraction of
+#: point-to-point steps that must be rises (a sawtooth heap is a cache,
+#: not a leak), and the total windowed rise in bytes that makes the
+#: pattern worth reporting
+HEAP_GROWTH_MIN_POINTS = 4
+HEAP_GROWTH_RISE_FRAC = 0.9
+HEAP_GROWTH_MIN_BYTES = 32 << 20
+#: hbm-pressure: census share of the backend's exposed device-memory
+#: capacity at which the finding fires (never on backends that expose
+#: no limit — CPU reads bytes_limit 0)
+HBM_PRESSURE_FRAC = 0.85
+HBM_PRESSURE_CRIT_FRAC = 0.95
 
 
 class Finding:
@@ -708,6 +730,77 @@ def _rule_slo_burn(ctx: InspectionContext) -> List[Finding]:
         "budget is burning — split the regression into queue wait vs "
         "execution via the phase histograms and statements_summary",
         "tinysql_slo_exec_breaches_total")]
+
+
+@rule("heap-growth")
+def _rule_heap_growth(ctx: InspectionContext) -> List[Finding]:
+    # monotone-rise leak detection over the MEASURED python heap
+    # (obs/memprof.py memory_state): a heap that only goes up, window
+    # after window, is a leak — a working set breathes back down
+    metric = "tinysql_mem_traced_bytes"
+    pts = ctx.series(metric)
+    if len(pts) < HEAP_GROWTH_MIN_POINTS:
+        return []
+    rise = pts[-1][1] - pts[0][1]
+    if rise < HEAP_GROWTH_MIN_BYTES:
+        return []
+    steps = len(pts) - 1
+    rises = sum(1 for i in range(steps) if pts[i + 1][1] >= pts[i][1])
+    if rises / steps < HEAP_GROWTH_RISE_FRAC:
+        return []
+    return [ctx.evidence(
+        "heap-growth", "heap", "warning",
+        f"traced python heap rose {rise / 1048576.0:.1f} MiB "
+        f"monotonically across {len(pts)} samples in the window "
+        f"({rises}/{steps} rising steps): leak-shaped growth — "
+        "/debug/heap has the allocation sites holding the bytes",
+        metric)]
+
+
+@rule("hbm-pressure")
+def _rule_hbm_pressure(ctx: InspectionContext) -> List[Finding]:
+    # HBM census vs the backend's exposed capacity; silent on backends
+    # without a limit (CPU) — a share of zero is not evidence
+    metric = "tinysql_hbm_live_bytes"
+    limit = ctx.last("tinysql_hbm_limit_bytes")
+    if limit <= 0:
+        return []
+    live = ctx.last(metric)
+    share = live / limit
+    if share < HBM_PRESSURE_FRAC:
+        return []
+    sev = "critical" if share >= HBM_PRESSURE_CRIT_FRAC else "warning"
+    return [ctx.evidence(
+        "hbm-pressure", "device", sev,
+        f"live device buffers hold {share:.0%} of the backend's "
+        f"{limit / 1048576.0:.0f} MiB capacity "
+        "(information_schema.memory_usage attributes them by owner; a "
+        "non-empty unattributed bucket there is a leak)", metric)]
+
+
+@rule("mem-untracked")
+def _rule_mem_untracked(ctx: InspectionContext) -> List[Finding]:
+    # measured-vs-tracked divergence: windowed MEASURED heap growth
+    # beyond everything the MemTracker ledger ever held in the window.
+    # Deltas, not absolutes — the absolute traced number includes the
+    # interpreter baseline no statement should answer for.  The band
+    # (obs/memprof.UNTRACKED_BAND_BYTES) is the documented tolerance.
+    from .memprof import UNTRACKED_BAND_BYTES
+    metric = "tinysql_mem_traced_bytes"
+    d_traced = ctx.delta(metric)
+    tracked_peak = ctx.max_value("tinysql_mem_tracked_bytes")
+    over = d_traced - tracked_peak - UNTRACKED_BAND_BYTES
+    if over <= 0:
+        return []
+    return [ctx.evidence(
+        "mem-untracked", "ledger", "warning",
+        f"measured heap grew {d_traced / 1048576.0:.1f} MiB in the "
+        "window while the statement MemTracker ledger peaked at "
+        f"{tracked_peak / 1048576.0:.1f} MiB — "
+        f"{over / 1048576.0:.1f} MiB past the "
+        f"{UNTRACKED_BAND_BYTES >> 20} MiB band is allocation the "
+        "spill/admission gates cannot see (operator working state "
+        "missing its tracker charge)", metric)]
 
 
 # ---- evaluation -----------------------------------------------------------

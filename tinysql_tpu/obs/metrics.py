@@ -257,6 +257,56 @@ METRICS: Dict[str, Tuple[str, str]] = {
         ("gauge", "Distinct folded stacks in the current window"),
     "tinysql_conprof_windows":
         ("gauge", "Retained profile windows (current + rotated)"),
+    # continuous heap profiler (obs/memprof.py)
+    "tinysql_memprof_ticks_total":
+        ("counter", "Heap-profiler sampling ticks (tracemalloc "
+                    "snapshots taken)"),
+    "tinysql_memprof_sites_total":
+        ("counter", "Allocation sites folded by the heap profiler"),
+    "tinysql_memprof_attributed_total":
+        ("counter", "Statement attributions of traced-heap growth "
+                    "(statements_summary sum_heap_alloc_kb)"),
+    "tinysql_memprof_self_seconds_total":
+        ("counter", "Wall seconds the heap profiler spent snapshotting "
+                    "and folding (its own overhead; the bench_serve "
+                    "memprof gate's evidence)"),
+    "tinysql_memprof_evicted_total":
+        ("counter", "Allocation sites evicted into the (evicted) "
+                    "tombstone by the per-window tidb_memprof_max_sites "
+                    "cap"),
+    "tinysql_memprof_errors_total":
+        ("counter", "Heap-profiler ticks that failed (torn snapshots, "
+                    "memprofSampleError) — counted, never fatal"),
+    "tinysql_memprof_backoff":
+        ("gauge", "Live overhead-backoff divisor (effective rate = "
+                  "tidb_memprof_rate / backoff; 1 = at full rate)"),
+    # measured-vs-tracked memory reconciliation (obs/memprof.py
+    # memory_state — the heap-growth / hbm-pressure / mem-untracked
+    # rules' evidence series)
+    "tinysql_mem_tracked_bytes":
+        ("gauge", "Live statement MemTracker bytes (the ledger the "
+                  "spill/admission gates act on)"),
+    "tinysql_mem_traced_bytes":
+        ("gauge", "Measured python heap (tracemalloc current traced "
+                  "bytes; 0 when tracing is off)"),
+    "tinysql_mem_traced_peak_bytes":
+        ("gauge", "Measured python heap high water since tracing "
+                  "started"),
+    "tinysql_mem_rss_bytes":
+        ("gauge", "Process resident set size (/proc/self/statm)"),
+    "tinysql_mem_untracked_bytes":
+        ("gauge", "Measured heap beyond the MemTracker ledger (the "
+                  "mem-untracked rule's divergence)"),
+    "tinysql_hbm_live_bytes":
+        ("gauge", "Total bytes of live device buffers (HBM census)"),
+    "tinysql_hbm_buffers":
+        ("gauge", "Live device buffers counted by the HBM census"),
+    "tinysql_hbm_unattributed_bytes":
+        ("gauge", "Live device bytes no registered owner claims — the "
+                  "leak bucket (hbm census)"),
+    "tinysql_hbm_limit_bytes":
+        ("gauge", "Backend device-memory capacity when exposed "
+                  "(memory_stats bytes_limit; 0 on CPU)"),
     # time-series sampler self-accounting (obs/tsring.py)
     "tinysql_metrics_samples_total":
         ("counter", "Time-series ring samples taken"),
@@ -622,6 +672,28 @@ def render_prometheus() -> str:
             if n:
                 name = conprof.role_metric(role)
                 emit(name, METRICS[name][1], "counter", [((), n)])
+
+    # continuous heap profiler (obs/memprof.py): sampler self-accounting
+    # only — the reconciliation gauges ride the memory_state ring source
+    # (a /metrics scrape must never pay for an HBM census walk)
+    try:
+        from . import memprof
+        mp = memprof.stats_snapshot()
+    except Exception:
+        mp = {}
+    if mp.get("ticks"):
+        for key, name in (("ticks", "tinysql_memprof_ticks_total"),
+                          ("sites", "tinysql_memprof_sites_total"),
+                          ("attributed",
+                           "tinysql_memprof_attributed_total"),
+                          ("self_s",
+                           "tinysql_memprof_self_seconds_total"),
+                          ("evicted", "tinysql_memprof_evicted_total"),
+                          ("errors", "tinysql_memprof_errors_total")):
+            emit(name, METRICS[name][1], "counter", [((), mp.get(key, 0))])
+        emit("tinysql_memprof_backoff",
+             METRICS["tinysql_memprof_backoff"][1], "gauge",
+             [((), mp.get("backoff", 1))])
 
     # time-series sampler self-accounting (obs/tsring.py): the cost of
     # observing is itself observable (bench obs_overhead_frac reads it)

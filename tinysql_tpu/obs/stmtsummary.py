@@ -114,7 +114,7 @@ class StmtRecord:
                  "schema_name", "exec_count", "sum_errors", "sum_ms",
                  "max_ms", "device", "max_mem", "sum_rows", "first_seen",
                  "last_seen", "sample_sql", "sample_plan", "queued_count",
-                 "max_spill_bytes", "spill_count")
+                 "max_spill_bytes", "spill_count", "max_heap_kb")
 
     def __init__(self, sql_digest: str, digest_text: str,
                  plan_digest: str):
@@ -137,6 +137,7 @@ class StmtRecord:
         self.queued_count = 0
         self.max_spill_bytes = 0
         self.spill_count = 0
+        self.max_heap_kb = 0.0
 
     def fold(self, *, stmt_type: str, schema_name: str,
              info: Dict[str, float], device: Dict[str, float],
@@ -163,6 +164,12 @@ class StmtRecord:
             self.spill_count += 1
             if sp > self.max_spill_bytes:
                 self.max_spill_bytes = sp
+        # heap truth (obs/memprof.py): this EXECUTION's traced-heap high
+        # water (the hwm counter is per-statement, so the max folds here;
+        # heap_kb sums through the device loop above)
+        hk = float(device.get("heap_peak_kb", 0.0))
+        if hk > self.max_heap_kb:
+            self.max_heap_kb = hk
         if max_mem > self.max_mem:
             self.max_mem = int(max_mem)
         self.sum_rows += int(rows_returned)
@@ -190,6 +197,7 @@ class StmtRecord:
         self.max_spill_bytes = max(self.max_spill_bytes,
                                    other.max_spill_bytes)
         self.spill_count += other.spill_count
+        self.max_heap_kb = max(self.max_heap_kb, other.max_heap_kb)
         self.sum_rows += other.sum_rows
         if other.first_seen and (not self.first_seen
                                  or other.first_seen < self.first_seen):
@@ -239,6 +247,12 @@ class StmtRecord:
             # with tidb_conprof_rate=0 or no sampler running)
             round(float(d.get("cpu_s", 0.0)) * 1e3, 3),
             int(d.get("cpu_samples", 0)),
+            # heap truth (obs/memprof.py): traced-heap growth attributed
+            # to these executions (the sum across concurrent statements
+            # never exceeds measured process growth) and the traced high
+            # water while any of them ran (0 with tidb_memprof_rate=0)
+            round(float(d.get("heap_kb", 0.0)), 1),
+            round(self.max_heap_kb, 1),
             int(d.get("pipe_blocks", 0)), self._overlap_frac(),
             int(d.get("coalesced", 0)),
             int(d.get("spill_bytes", 0)), self.max_spill_bytes,
@@ -259,6 +273,7 @@ class StmtRecord:
                 "device": dict(self.device), "max_mem": self.max_mem,
                 "max_spill_bytes": self.max_spill_bytes,
                 "spill_count": self.spill_count,
+                "max_heap_kb": self.max_heap_kb,
                 "rows": self.sum_rows, "sample_sql": self.sample_sql}
 
 
@@ -280,6 +295,7 @@ COLUMNS = [
     ("sum_device_ms", "real"), ("profiled_dispatches", "int"),
     ("sum_compile_ms", "real"),
     ("sum_cpu_ms", "real"), ("cpu_samples", "int"),
+    ("sum_heap_alloc_kb", "real"), ("max_heap_kb", "real"),
     ("pipe_blocks", "int"), ("pipe_overlap_frac", "real"),
     ("coalesced", "int"),
     ("sum_spill_bytes", "int"), ("max_spill_bytes", "int"),

@@ -557,6 +557,15 @@ def _src_slo() -> Dict[str, float]:
     return oinspect.slo_sample()
 
 
+def _src_memory_state() -> Dict[str, float]:
+    # measured-vs-tracked memory reconciliation (obs/memprof.py): the
+    # tracked MemTracker ledger vs tracemalloc heap / RSS vs the HBM
+    # census, plus the heap sampler's self-accounting — the evidence
+    # series the heap-growth / hbm-pressure / mem-untracked rules judge
+    from . import memprof
+    return memprof.memory_state()
+
+
 def _src_conprof() -> Dict[str, float]:
     # continuous host profiler (obs/conprof.py): the cpu-saturation and
     # profiler-overhead inspection rules judge these windowed deltas
@@ -597,5 +606,6 @@ for _name, _fn in (("queries", _src_queries), ("kernels", _src_kernels),
                    ("failpoints", _src_failpoints),
                    ("prewarm", _src_prewarm), ("slo", _src_slo),
                    ("conprof", _src_conprof),
+                   ("memory_state", _src_memory_state),
                    ("tsring", _src_tsring)):
     register_source(_name, _fn)

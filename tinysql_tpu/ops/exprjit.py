@@ -295,6 +295,23 @@ def _apply(name: str, vals: List[VV], arg_types, ret_int: bool,
     raise ValueError(f"not jittable: {name}")
 
 
+#: live ParamTables, weakly held — the HBM census claims any device
+#: buffers a parameter staging path pins (today's slots are host python
+#: lists and uploads are per-dispatch transients: the category reads 0)
+import weakref  # noqa: E402
+_LIVE_PARAM_TABLES: "weakref.WeakSet[ParamTable]" = weakref.WeakSet()
+
+
+def _census_param_tables():
+    for pt in list(_LIVE_PARAM_TABLES):
+        yield [pt.i64, pt.f64]
+
+
+from ..obs import memprof as _memprof  # noqa: E402  (cycle-free: memprof
+#                                        imports no ops module at top level)
+_memprof.register_census_walker("paramtable", _census_param_tables)
+
+
 class ParamTable:
     """Per-query runtime parameters for compiled device programs.
     Constants lower to slot reads instead of baked literals, so a query
@@ -308,6 +325,7 @@ class ParamTable:
     def __init__(self):
         self.i64: list = []
         self.f64: list = []
+        _LIVE_PARAM_TABLES.add(self)
 
     def add_int(self, v) -> int:
         from ..mytypes import wrap_i64

@@ -441,16 +441,30 @@ def resolve_pending_costs() -> None:
         with _PENDING_MU:
             if not _PENDING_COSTS:
                 return
-            costs, spec, w, absargs = _PENDING_COSTS.pop()
+            costs, spec, w, absargs, prog_key = _PENDING_COSTS.pop()
         a, k = absargs
         try:
-            ca = w.lower(*a, **k).compile().cost_analysis()
+            compiled = w.lower(*a, **k).compile()
+            ca = compiled.cost_analysis()
             if isinstance(ca, (list, tuple)):  # older jax: dict per device
                 ca = ca[0] if ca else {}
             costs[spec] = (float(ca.get("flops", 0.0) or 0.0),
                            float(ca.get("bytes accessed", 0.0) or 0.0))
         except Exception:
             costs[spec] = (0.0, 0.0)
+            continue
+        try:
+            # the program's static HBM footprint (peak scratch / operand /
+            # result bytes) rides the same deferred resolution into the
+            # catalog — compiled_programs' peak_*_bytes columns
+            ma = compiled.memory_analysis()
+            progcache.note_memory(
+                prog_key,
+                float(getattr(ma, "temp_size_in_bytes", 0) or 0),
+                float(getattr(ma, "argument_size_in_bytes", 0) or 0),
+                float(getattr(ma, "output_size_in_bytes", 0) or 0))
+        except Exception:
+            pass  # backends without memory_analysis keep zeros
 
 
 def counted_jit(fn, **kw):
@@ -494,7 +508,8 @@ def counted_jit(fn, **kw):
                         else:
                             costs[spec] = None
                             _PENDING_COSTS.append(
-                                (costs, spec, w, _abstractify((a, k))))
+                                (costs, spec, w, _abstractify((a, k)),
+                                 prog_key))
         sampled = profiler.should_sample()
         t0 = time.perf_counter() if sampled else 0.0
         with _obs.span("dispatch", cat="device"):

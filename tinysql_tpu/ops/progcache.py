@@ -99,7 +99,9 @@ class ProgramMeta:
 
     __slots__ = ("key", "domain", "created_at", "compile_s", "prewarmed",
                  "dispatches", "device_s", "profiled_dispatches",
-                 "flops", "bytes_accessed", "plan_digest", "last_used")
+                 "flops", "bytes_accessed", "peak_temp_bytes",
+                 "peak_arg_bytes", "peak_out_bytes", "plan_digest",
+                 "last_used")
 
     def __init__(self, key: tuple):
         self.key = key
@@ -112,6 +114,12 @@ class ProgramMeta:
         self.profiled_dispatches = 0
         self.flops = 0.0
         self.bytes_accessed = 0.0
+        # XLA memory_analysis of the compiled program (peak scratch /
+        # operand / result bytes) — the static half of the HBM story,
+        # fed by the pending-cost resolver (kernels.resolve_pending_costs)
+        self.peak_temp_bytes = 0.0
+        self.peak_arg_bytes = 0.0
+        self.peak_out_bytes = 0.0
         self.plan_digest = ""
         self.last_used = 0.0
 
@@ -125,6 +133,9 @@ class ProgramMeta:
                 "profiled_dispatches": self.profiled_dispatches,
                 "flops": self.flops,
                 "bytes_accessed": self.bytes_accessed,
+                "peak_temp_bytes": self.peak_temp_bytes,
+                "peak_arg_bytes": self.peak_arg_bytes,
+                "peak_out_bytes": self.peak_out_bytes,
                 "plan_digest": self.plan_digest,
                 "last_used": self.last_used}
 
@@ -221,6 +232,38 @@ def note_dispatch(key: Optional[tuple], device_s: Optional[float] = None,
             meta.plan_digest = digest
 
 
+def note_memory(key: Optional[tuple], temp_bytes: float, arg_bytes: float,
+                out_bytes: float) -> None:
+    """Fold a compiled program's XLA ``memory_analysis`` (peak temp /
+    argument / output bytes) into its catalog entry — called by the
+    pending-cost resolver alongside cost analysis.  Shapes of the same
+    program keep the LARGEST footprint seen (the conservative number
+    admission wants); all-zero reports (backends without the API) never
+    clobber a real measurement."""
+    if key is None or not (temp_bytes or arg_bytes or out_bytes):
+        return
+    with _mu:
+        meta = _meta_locked(key)
+        meta.peak_temp_bytes = max(meta.peak_temp_bytes, float(temp_bytes))
+        meta.peak_arg_bytes = max(meta.peak_arg_bytes, float(arg_bytes))
+        meta.peak_out_bytes = max(meta.peak_out_bytes, float(out_bytes))
+
+
+def _census_registry_values():
+    """HBM census walker: every registered program entry.  Wrapper
+    functions keep their program state inside XLA (not as live arrays),
+    so this category normally reads 0 — but a builder that publishes a
+    (fn, device-constant) tuple is claimed here instead of leaking into
+    the unattributed bucket."""
+    with _mu:
+        return list(_REG.values())
+
+
+from ..obs import memprof as _memprof  # noqa: E402  (cycle-free: memprof
+#                                        imports no ops module at top level)
+_memprof.register_census_walker("progcache", _census_registry_values)
+
+
 def peek(key: tuple):
     """Entry or None, without counting or building (introspection)."""
     with _mu:
@@ -262,6 +305,8 @@ CATALOG_COLUMNS = [
     ("device_ms", "real"), ("profiled_dispatches", "int"),
     ("flops", "real"), ("bytes_accessed", "real"),
     ("plan_digest", "str"), ("last_used", "str"),
+    ("peak_temp_bytes", "real"), ("peak_arg_bytes", "real"),
+    ("peak_out_bytes", "real"),
 ]
 
 
@@ -288,5 +333,7 @@ def catalog_rows() -> List[list]:
             int(m["profiled_dispatches"]), float(m["flops"]),
             float(m["bytes_accessed"]), m["plan_digest"],
             _ts(m["last_used"]) if m["last_used"] else "",
+            float(m["peak_temp_bytes"]), float(m["peak_arg_bytes"]),
+            float(m["peak_out_bytes"]),
         ])
     return out

@@ -46,6 +46,7 @@ import os
 import shutil
 import tempfile
 import threading
+import weakref
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -255,6 +256,25 @@ class SpillStore:
 
 # ---- the spill context -----------------------------------------------------
 
+#: live spill scopes, weakly held — the HBM census walks their resident
+#: partitions so a future device-resident spill working set is born
+#: attributed (today's partitions are host numpy: the category reads 0)
+_LIVE_CONTEXTS: "weakref.WeakSet[SpillContext]" = weakref.WeakSet()
+
+
+def _census_working_sets():
+    for ctx in list(_LIVE_CONTEXTS):
+        for part in list(getattr(ctx, "_resident", ())):
+            arrays = getattr(part, "arrays", None)
+            if arrays:
+                yield list(arrays.values())
+
+
+from ..obs import memprof as _memprof  # noqa: E402  (cycle-free: memprof
+#                                        imports no ops module at top level)
+_memprof.register_census_walker("spill", _census_working_sets)
+
+
 class SpillContext:
     """Per-operator spill scope: budget, partition fan-out, recursion
     bound, the store, and the tracker the partition residency charges
@@ -278,6 +298,7 @@ class SpillContext:
         self.enforce = enforce
         self.label = label
         self.store = SpillStore(tag=label)
+        _LIVE_CONTEXTS.add(self)
         #: resident partitions, evictable on demand: the tracker's
         #: pressure callback (fired when a chunk allocation crosses the
         #: watermark or would cross the hard quota) spills them, so

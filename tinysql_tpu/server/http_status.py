@@ -90,6 +90,21 @@ def _make_handler(server_ref):
                 self._send(200, collapsed(window_s=window).encode(),
                            "text/plain; charset=utf-8")
                 return
+            if parsed.path == "/debug/heap":
+                # collapsed allocation-site text (same format as
+                # /debug/conprof — conprof.parse_collapsed and
+                # flamegraph.pl ingest both; counts are live KB);
+                # ?window=N bounds to the last N seconds of windows
+                from ..obs.memprof import collapsed as heap_collapsed
+                qs = parse_qs(parsed.query)
+                try:
+                    window = float(qs.get("window", ["0"])[0]) or None
+                except ValueError:
+                    window = None
+                self._send(200,
+                           heap_collapsed(window_s=window).encode(),
+                           "text/plain; charset=utf-8")
+                return
             if parsed.path == "/debug/programs":
                 from ..ops.progcache import catalog_snapshot
                 self._send(200, json.dumps(catalog_snapshot(),
@@ -134,6 +149,7 @@ def _make_handler(server_ref):
                            b'<a href="/debug/stmtsummary">stmtsummary</a> '
                            b'<a href="/debug/programs">programs</a> '
                            b'<a href="/debug/conprof">conprof</a> '
+                           b'<a href="/debug/heap">heap</a> '
                            b'<a href="/debug/prewarm">prewarm</a> '
                            b'<a href="/debug/inspection">inspection</a> '
                            b'<a href="/debug/metrics/summary">'

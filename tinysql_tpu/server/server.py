@@ -427,6 +427,13 @@ class Server:
         # profiler-overhead inspection rules
         from ..obs.conprof import ConprofSampler
         self.conprof_sampler = ConprofSampler(storage)
+        # continuous heap profiler (obs/memprof.py): tracemalloc-based
+        # allocation-site sampler paced by tidb_memprof_rate (Hz, 0 =
+        # off + tracing stopped), feeding /debug/heap, the memory_state
+        # reconciliation series, statements_summary heap attribution,
+        # and the heap-growth / mem-untracked inspection rules
+        from ..obs.memprof import MemprofSampler
+        self.memprof_sampler = MemprofSampler(storage)
         self.host = host
         self.port = port
         self.sock: Optional[socket.socket] = None
@@ -455,6 +462,7 @@ class Server:
         self.prewarm.start()
         self.metrics_sampler.start()
         self.conprof_sampler.start()
+        self.memprof_sampler.start()
         # device-time truth knobs are process-global module state applied
         # at SET time (session/session.py) — a fresh server re-applies
         # whatever GLOBAL scope the storage carries
@@ -550,6 +558,7 @@ class Server:
         self.prewarm.close()
         self.metrics_sampler.close()
         self.conprof_sampler.close()
+        self.memprof_sampler.close()
         self.domain.close()
         if self.sock is not None:
             try:

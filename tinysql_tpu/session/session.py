@@ -196,6 +196,19 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # max distinct folded stacks per window; beyond it the
     # least-recently-seen stack folds into the '(evicted)' tombstone
     "tidb_conprof_max_stacks": 512,
+    # ---- continuous heap profiler (obs/memprof.py; GLOBAL scope — the
+    # server's background memory sampler re-reads all four every tick) --
+    # sampling rate in Hz (0 = off AND tracemalloc stopped — tracing
+    # taxes every allocation, so off must mean off; a tracemalloc
+    # snapshot is far pricier than a stack walk, hence the low default)
+    "tidb_memprof_rate": 1,
+    # seconds per aggregation window of the /debug/heap site store
+    "tidb_memprof_window": 60,
+    # rotated windows retained
+    "tidb_memprof_history": 15,
+    # max distinct allocation sites per window; beyond it the
+    # least-recently-seen site folds into the '(evicted)' tombstone
+    "tidb_memprof_max_sites": 256,
 }
 
 
@@ -1099,7 +1112,11 @@ class Session:
                      "tidb_conprof_rate",
                      "tidb_conprof_window",
                      "tidb_conprof_history",
-                     "tidb_conprof_max_stacks")
+                     "tidb_conprof_max_stacks",
+                     "tidb_memprof_rate",
+                     "tidb_memprof_window",
+                     "tidb_memprof_history",
+                     "tidb_memprof_max_sites")
 
     @staticmethod
     def _validate_uint_sysvar(name: str, v: Datum) -> int:
