@@ -26,7 +26,7 @@ from tinysql_tpu import fail
 from tinysql_tpu.codec import tablecodec
 from tinysql_tpu.columnar.store import store_of
 from tinysql_tpu.kv.errors import (BackoffExceeded, KVError, RegionError,
-                                   UndeterminedError)
+                                   UndeterminedError, WalError)
 from tinysql_tpu.ops import degrade
 from tinysql_tpu.session.session import Session, SessionError, new_session
 from tinysql_tpu.utils.interrupt import QueryKilled, QueryTimeout
@@ -250,6 +250,100 @@ def _before_commit(tk):
     assert s2.query("select count(*) from t where a = 7").rows == [[1]]
     s2.execute("delete from t where a = 7")
     s2.execute("insert into t values (7, 0)")
+
+
+# the durability failpoints need a DURABLE store (volatile sessions
+# never journal) — each driver builds its own tempdir-backed storage
+# and does all setup BEFORE arming, so the armed point is consumed by
+# exactly the statement under test
+
+def _durable_session():
+    import tempfile
+    from tinysql_tpu.kv import new_mock_storage
+    d = tempfile.mkdtemp(prefix="chaos-wal-")
+    st = new_mock_storage(data_dir=d)
+    s = Session(st)
+    s.execute("create database w")
+    s.execute("use w")
+    s.execute("set @@tidb_use_tpu = 0")
+    s.execute("create table t (a int primary key, b int)")
+    s.execute("insert into t values (1, 1), (2, 2), (3, 3)")
+    return s, st, d
+
+
+@chaos("walAppendError")
+def _wal_append(tk):
+    s, st, d = _durable_session()
+    with fail.armed("walAppendError", exc=IOError("disk full"), times=1):
+        with pytest.raises(WalError):
+            s.execute("delete from t where a = 1")
+    # journal-before-apply: the append failed BEFORE the store mutated,
+    # so the row survives and the key is immediately writable again
+    assert s.query("select count(*) from t where a = 1").rows == [[1]]
+    s.execute("delete from t where a = 1")
+    assert s.query("select count(*) from t where a = 1").rows == [[0]]
+    # and the delete that DID ack is durable across a simulated kill
+    st2 = __import__("tinysql_tpu.kv",
+                     fromlist=["new_mock_storage"]).new_mock_storage(
+        data_dir=d)
+    s2 = Session(st2, current_db="w")
+    s2.execute("set @@tidb_use_tpu = 0")
+    assert s2.query("select count(*) from t where a = 1").rows == [[0]]
+
+
+@chaos("walFsyncError")
+def _wal_fsync(tk):
+    from tinysql_tpu.kv import wal as walmod
+    s, st, d = _durable_session()
+    s.execute("set @@tidb_wal_fsync = 'strict'")
+    base = walmod.stats_snapshot()["fsync_errors"]
+    with fail.armed("walFsyncError", exc=OSError("EIO"), times=1):
+        # the ack-bearing fsync failed: outcome undetermined (bytes may
+        # sit in the page cache) — exactly the primary-commit contract
+        with pytest.raises((KVError, UndeterminedError)):
+            s.execute("delete from t where a = 2")
+    assert walmod.stats_snapshot()["fsync_errors"] > base
+    # counted, not wedged: the log keeps accepting traffic
+    s.execute("set @@tidb_wal_fsync = 'relaxed'")
+    s.execute("delete from t where a = 3")
+    assert s.query("select count(*) from t where a = 3").rows == [[0]]
+
+
+@chaos("walTornTail")
+def _wal_torn(tk):
+    from tinysql_tpu.kv import new_mock_storage
+    s, st, d = _durable_session()
+    with fail.armed("walTornTail", times=1):
+        with pytest.raises(KVError):
+            s.execute("delete from t where a = 1")
+    # the poisoned live log refuses to let the store diverge ahead of it
+    with pytest.raises(KVError):
+        s.execute("delete from t where a = 2")
+    # recovery truncates the torn tail: pre-tear rows intact, the torn
+    # transaction atomically absent, the log writable again
+    st2 = new_mock_storage(data_dir=d)
+    s2 = Session(st2, current_db="w")
+    s2.execute("set @@tidb_use_tpu = 0")
+    assert s2.query("select count(*) from t").rows == [[3]]
+    s2.execute("delete from t where a = 1")
+    assert s2.query("select count(*) from t").rows == [[2]]
+
+
+@chaos("checkpointError")
+def _checkpoint(tk):
+    from tinysql_tpu.kv import new_mock_storage
+    from tinysql_tpu.kv.errors import CheckpointError
+    s, st, d = _durable_session()
+    with fail.armed("checkpointError", exc=OSError("nope"), times=1):
+        with pytest.raises(CheckpointError):
+            st.flush_and_checkpoint()
+    # counted, never fatal: the unrotated log remains the recovery
+    # source and traffic continues
+    s.execute("delete from t where a = 1")
+    st2 = new_mock_storage(data_dir=d)
+    s2 = Session(st2, current_db="w")
+    s2.execute("set @@tidb_use_tpu = 0")
+    assert s2.query("select count(*) from t").rows == [[2]]
 
 
 @chaos("copTaskError")

@@ -448,6 +448,8 @@ EXPECTED_RULES = {"compile-storm", "progcache-hit-rate",
                   "connection-pressure",
                   # mesh-sharded operator tier (ISSUE 17)
                   "shard-imbalance",
+                  # durable MVCC (ISSUE 19)
+                  "wal-stall",
                   # memory truth (ISSUE 18) — induced in
                   # test_memprof.py alongside the profiler they judge
                   "heap-growth", "hbm-pressure", "mem-untracked"}
@@ -696,6 +698,37 @@ def test_rule_shard_imbalance():
     ring = _ring_with({"tinysql_shard_skew_retries_total": n - 1,
                        "tinysql_shard_rounds_total": 10})
     assert not _findings(ring, "shard-imbalance")
+
+
+def test_rule_wal_stall():
+    n = oinspect.WAL_STALL_MIN_FSYNCS
+    # mean fsync wall past the warning line: the strict-policy ack tax
+    ring = _ring_with({"tinysql_wal_fsyncs_total": n,
+                       "tinysql_wal_fsync_seconds_total":
+                           n * oinspect.WAL_STALL_MEAN_WARN_S * 1.5})
+    f = _findings(ring, "wal-stall")
+    assert len(f) == 1 and f[0].severity == "warning"
+    assert f[0].metric == "tinysql_wal_fsync_seconds_total"
+    # past the critical line
+    ring = _ring_with({"tinysql_wal_fsyncs_total": n,
+                       "tinysql_wal_fsync_seconds_total":
+                           n * oinspect.WAL_STALL_MEAN_CRIT_S * 2})
+    assert _findings(ring, "wal-stall")[0].severity == "critical"
+    # fast disk: silent
+    ring = _ring_with({"tinysql_wal_fsyncs_total": n * 10,
+                       "tinysql_wal_fsync_seconds_total":
+                           n * oinspect.WAL_STALL_MEAN_WARN_S * 0.1})
+    assert not _findings(ring, "wal-stall")
+    # too few syncs to judge the mean: silent
+    ring = _ring_with({"tinysql_wal_fsyncs_total": n - 1,
+                       "tinysql_wal_fsync_seconds_total": 10.0})
+    assert not _findings(ring, "wal-stall")
+    # ANY journal error is critical outright — the durability path
+    # itself failed, whatever the latency looked like
+    ring = _ring_with({"tinysql_wal_append_errors_total": 1})
+    f = _findings(ring, "wal-stall")
+    assert len(f) == 1 and f[0].severity == "critical"
+    assert f[0].metric == "tinysql_wal_fsync_errors_total"
 
 
 def test_rule_batching_degraded():

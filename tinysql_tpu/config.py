@@ -52,6 +52,11 @@ class Config:
     # (ops/kernels.py _cache_dir resolution: sysvar tidb_compile_cache_dir
     # > TINYSQL_JAX_CACHE env > this entry > default)
     compile_cache_dir: str = ""
+    # durability arming (kv/wal.py): directory for the MVCC WAL +
+    # checkpoints.  "" = volatile in-memory store, byte-identical to the
+    # pre-WAL behavior.  Resolution: --data-dir CLI > this entry >
+    # TINYSQL_DATA_DIR env (kv/txn.py resolve_data_dir)
+    data_dir: str = ""
     log: Log = field(default_factory=Log)
     status: Status = field(default_factory=Status)
     security: Security = field(default_factory=Security)

@@ -99,8 +99,24 @@ class Domain:
                 ddl = self.ddl()
                 if ddl.owner.campaign():
                     ddl.worker.run_pending(owner=ddl.owner)
+                    # the GC safepoint trigger rides the owner duty loop
+                    # (reference: the gc worker leader): exactly one
+                    # server per storage advances the safepoint, paced
+                    # by storage.maybe_run_gc itself
+                    self._maybe_gc()
             except Exception:
                 pass
+
+    def _maybe_gc(self) -> None:
+        """Invoke mvcc GC when the GLOBAL ``tidb_gc_safepoint`` sysvar
+        arms a retention window (seconds; 0 = disabled)."""
+        run = getattr(self.storage, "maybe_run_gc", None)
+        if run is None:
+            return
+        g = getattr(self.storage, "_global_vars", None) or {}
+        retention = g.get("tidb_gc_safepoint", 0)
+        if retention:
+            run(retention)
 
     def ddl(self):
         """Per-server DDL facade whose owner manager campaigns under

@@ -35,6 +35,31 @@ BEFORE_COMMIT = register(
     "between prewrite and commit_keys — a panic here models the classic "
     "Percolator crashed-committer window (kv/txn.py)")
 
+# ---- durability: WAL + checkpoint (kv/wal.py) ------------------------------
+WAL_APPEND_ERROR = register(
+    "walAppendError",
+    "WAL record append fails BEFORE any bytes are written — the "
+    "journaled mutation is not applied, a typed WalError surfaces, the "
+    "store never diverges ahead of its log (kv/wal.py append)")
+WAL_FSYNC_ERROR = register(
+    "walFsyncError",
+    "the wal fsync syscall fails — under strict policy the ack-bearing "
+    "commit surfaces a typed error (the bytes may still be in the page "
+    "cache: outcome undetermined, exactly the primary-commit contract); "
+    "counted as fsync_errors (kv/wal.py _fsync_locked)")
+WAL_TORN_TAIL = register(
+    "walTornTail",
+    "the next record is deliberately half-written — the crash-boundary "
+    "lever: recovery must truncate at the first bad checksum and the "
+    "live log poisons itself (further appends raise WalError) "
+    "(kv/wal.py append)")
+CHECKPOINT_ERROR = register(
+    "checkpointError",
+    "a checkpoint attempt fails (or stalls, with sleep=) before the "
+    "atomic rename — counted, never fatal: the previous checkpoint + "
+    "unrotated log remain the recovery source; armed during recovery it "
+    "is the crash-during-recovery lever (kv/wal.py checkpoint)")
+
 # ---- distsql coprocessor ---------------------------------------------------
 COP_TASK_ERROR = register(
     "copTaskError",

@@ -1,7 +1,9 @@
 """Transactional KV layer (reference: kv/, store/tikv/, store/mockstore/)."""
 from .errors import (KVError, KeyNotFound, KeyExists, KeyIsLocked,
                      WriteConflict, TxnAborted, RetryableError, RegionError,
-                     BackoffExceeded, UndeterminedError, SchemaOutdated)
+                     BackoffExceeded, UndeterminedError, SchemaOutdated,
+                     WalError, CheckpointError)
+from .wal import WriteAheadLog
 from .oracle import Oracle
 from .memdb import MemDB, UnionStore, TOMBSTONE
 from .mvcc import MVCCStore, Mutation, OP_PUT, OP_DEL, OP_INSERT
@@ -16,7 +18,8 @@ from .range_task import RangeTaskRunner, RangeTaskStat
 __all__ = [
     "KVError", "KeyNotFound", "KeyExists", "KeyIsLocked", "WriteConflict",
     "TxnAborted", "RetryableError", "RegionError", "BackoffExceeded",
-    "UndeterminedError", "SchemaOutdated",
+    "UndeterminedError", "SchemaOutdated", "WalError", "CheckpointError",
+    "WriteAheadLog",
     "Oracle", "MemDB", "UnionStore", "TOMBSTONE",
     "MVCCStore", "Mutation", "OP_PUT", "OP_DEL", "OP_INSERT",
     "Cluster", "Region", "Store", "RPCClient", "RegionCache", "RegionCtx",

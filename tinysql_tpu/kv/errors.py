@@ -63,6 +63,18 @@ class UndeterminedError(KVError):
     reference: 2pc.go:417-428."""
 
 
+class WalError(KVError):
+    """Write-ahead-log append/fsync failure — the mutation it was meant
+    to journal is NOT applied (the store never diverges ahead of a log
+    it could not write)."""
+
+
+class CheckpointError(WalError):
+    """A checkpoint attempt failed — counted and retried on the next
+    trigger; the previous checkpoint + the unrotated log remain the
+    recovery source, so this is never fatal to the store."""
+
+
 class TaskCancelled(KVError):
     """A cooperative cancel (early close of a scatter-gather, statement
     kill) interrupted this task's retry loop — never user-visible: the

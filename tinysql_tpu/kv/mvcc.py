@@ -16,6 +16,12 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from .errors import (KeyExists, KeyIsLocked, KeyNotFound, TxnAborted,
                      WriteConflict)
 
+# Durability (kv/wal.py): when constructed with a data_dir the store
+# journals every mutation inside its critical section — the journal is
+# written AFTER validation but BEFORE the in-memory apply, so a failed
+# append (WalError) leaves the store unmutated and a journaled record is
+# always appliable on replay.
+
 # write-record types (reference: mvcc.go WriteType)
 W_PUT, W_DELETE, W_ROLLBACK = 0, 1, 2
 
@@ -47,11 +53,34 @@ class Mutation:
 
 
 class MVCCStore:
-    def __init__(self):
+    def __init__(self, data_dir: Optional[str] = None):
         self._entries: Dict[bytes, _Entry] = {}
         self._sorted: List[bytes] = []
         self._dirty = False
         self._mu = threading.RLock()
+        self._wal = None
+        self._replaying = False
+        self.recovery_info: Optional[dict] = None
+        if data_dir:
+            from .wal import WriteAheadLog
+            self._wal = WriteAheadLog(data_dir)
+            self.recovery_info = self._wal.recover_into(self)
+
+    @property
+    def wal(self):
+        return self._wal
+
+    def _journal(self, fn) -> None:
+        """Append one redo record via ``fn(wal)``; called with self._mu
+        held, after validation, before the apply.  A WalError here must
+        propagate — the caller skips the apply, so store and log never
+        diverge with the store ahead."""
+        if self._wal is not None and not self._replaying:
+            fn(self._wal)
+
+    def _maybe_checkpoint(self) -> None:
+        if self._wal is not None and not self._replaying:
+            self._wal.maybe_checkpoint(self)
 
     # ---- helpers ------------------------------------------------------
     def _entry(self, key: bytes) -> _Entry:
@@ -121,16 +150,23 @@ class MVCCStore:
         already serves them.  Keys with any existing write or a live
         lock are skipped untouched (they are already row-store-real);
         returns the number installed."""
-        n = 0
         with self._mu:
+            installed: List[Tuple[bytes, bytes]] = []
+            planned = set()
             for key, value in kvs:
                 e = self._entry(key)
-                if e.lock is not None or e.writes:
+                if e.lock is not None or e.writes or key in planned:
                     continue
+                installed.append((key, value))
+                planned.add(key)
+            if installed:
+                self._journal(lambda w: w.log_backfill(ts, installed))
+            for key, value in installed:
+                e = self._entry(key)
                 e.data[ts] = value
                 e.writes.append((ts, W_PUT, ts))
-                n += 1
-        return n
+            self._maybe_checkpoint()
+        return len(installed)
 
     # ---- percolator write protocol ------------------------------------
     def prewrite(self, mutations: List[Mutation], primary: bytes,
@@ -139,21 +175,37 @@ class MVCCStore:
         mvcc_leveldb.go Prewrite)."""
         with self._mu:
             errs = []
+            plans: List[Mutation] = []
+            seen = set()
             for m in mutations:
+                if m.key in seen:
+                    continue  # same-batch re-prewrite is idempotent
                 try:
-                    self._prewrite_one(m, primary, start_ts, ttl_ms)
+                    if self._check_prewrite(m, primary, start_ts, ttl_ms):
+                        plans.append(m)
+                        seen.add(m.key)
                 except (KeyIsLocked, WriteConflict, KeyExists) as ex:
                     errs.append(ex)
+            if plans:
+                self._journal(lambda w: w.log_prewrite(
+                    primary, start_ts, ttl_ms,
+                    [(m.op, m.key, m.value) for m in plans]))
+            for m in plans:
+                self._entry(m.key).lock = Lock(primary, start_ts, ttl_ms,
+                                               m.op, m.value)
+            self._maybe_checkpoint()
             if errs:
                 raise errs[0]
 
-    def _prewrite_one(self, m: Mutation, primary: bytes, start_ts: int,
-                      ttl_ms: int) -> None:
+    def _check_prewrite(self, m: Mutation, primary: bytes, start_ts: int,
+                        ttl_ms: int) -> bool:
+        """Validation half of prewrite: raises on conflict, returns False
+        for an idempotent re-prewrite, True when a lock must be taken."""
         e = self._entry(m.key)
         if e.lock is not None:
             if e.lock.start_ts != start_ts:
                 raise KeyIsLocked(m.key, e.lock.primary, e.lock.start_ts, e.lock.ttl_ms)
-            return  # idempotent re-prewrite
+            return False  # idempotent re-prewrite
         if e.writes:
             newest = e.writes[0]
             if newest[0] >= start_ts:
@@ -166,49 +218,78 @@ class MVCCStore:
             w = self._find_write(e, start_ts)
             if w is not None and w[1] == W_PUT:
                 raise KeyExists(m.key)
-        e.lock = Lock(primary, start_ts, ttl_ms, m.op, m.value)
+        return True
 
     def commit(self, keys: List[bytes], start_ts: int, commit_ts: int) -> None:
         with self._mu:
+            plans: List[Tuple[bytes, Lock]] = []
             for k in keys:
-                self._commit_one(k, start_ts, commit_ts)
+                lk = self._check_commit(k, start_ts)
+                if lk is not None:
+                    plans.append((k, lk))
+            if plans:
+                self._journal(lambda w: w.log_commit(
+                    start_ts, commit_ts,
+                    [(k, W_DELETE if lk.op == OP_DEL else W_PUT, lk.value)
+                     for k, lk in plans]))
+            for k, lk in plans:
+                self._apply_commit(k, lk, start_ts, commit_ts)
+            self._maybe_checkpoint()
 
-    def _commit_one(self, key: bytes, start_ts: int, commit_ts: int) -> None:
+    def _check_commit(self, key: bytes, start_ts: int) -> Optional[Lock]:
+        """Validation half of commit: returns the lock to commit, None
+        for an idempotent re-commit, raises TxnAborted otherwise."""
         e = self._entries.get(key)
         if e is None:
             raise TxnAborted(f"commit of unknown key {key!r}")
         lk = e.lock
         if lk is not None and lk.start_ts == start_ts:
-            wtype = W_DELETE if lk.op == OP_DEL else W_PUT
-            if wtype == W_PUT:
-                e.data[start_ts] = lk.value
-            e.writes.append((commit_ts, wtype, start_ts))
-            e.writes.sort(key=lambda w: -w[0])  # keep newest-first invariant
-            e.lock = None
-            return
+            return lk
         # lock gone: committed already (idempotent) or rolled back (abort)
         for w in e.writes:
             if w[2] == start_ts:
                 if w[1] == W_ROLLBACK:
                     raise TxnAborted(f"txn {start_ts} already rolled back")
-                return
+                return None
         raise TxnAborted(f"txn {start_ts} has no lock and no write on {key!r}")
+
+    def _apply_commit(self, key: bytes, lk: Lock, start_ts: int,
+                      commit_ts: int) -> None:
+        e = self._entry(key)
+        wtype = W_DELETE if lk.op == OP_DEL else W_PUT
+        if wtype == W_PUT:
+            e.data[start_ts] = lk.value
+        e.writes.append((commit_ts, wtype, start_ts))
+        e.writes.sort(key=lambda w: -w[0])  # keep newest-first invariant
+        if e.lock is not None and e.lock.start_ts == start_ts:
+            e.lock = None
 
     def rollback(self, keys: List[bytes], start_ts: int) -> None:
         with self._mu:
+            plans: List[bytes] = []
             for k in keys:
+                e = self._entry(k)
+                committed = None
+                for w in e.writes:
+                    if w[2] == start_ts:
+                        committed = w
+                        break
+                if committed is not None and committed[1] != W_ROLLBACK:
+                    raise TxnAborted(
+                        f"cannot roll back committed txn {start_ts}")
+                if ((e.lock is not None and e.lock.start_ts == start_ts)
+                        or committed is None):
+                    plans.append(k)
+            if plans:
+                self._journal(lambda w: w.log_rollback(start_ts, plans))
+            for k in plans:
                 e = self._entry(k)
                 if e.lock is not None and e.lock.start_ts == start_ts:
                     e.lock = None
-                for w in e.writes:
-                    if w[2] == start_ts:
-                        if w[1] != W_ROLLBACK:
-                            raise TxnAborted(
-                                f"cannot roll back committed txn {start_ts}")
-                        break
-                else:
+                if not any(w[2] == start_ts for w in e.writes):
                     e.writes.append((start_ts, W_ROLLBACK, start_ts))
                     e.writes.sort(key=lambda w: -w[0])
+            self._maybe_checkpoint()
 
     # ---- recovery (lock resolution) -----------------------------------
     def check_txn_status(self, primary: bytes, lock_ts: int,
@@ -245,6 +326,7 @@ class MVCCStore:
         data versions.  Returns versions removed."""
         removed = 0
         with self._mu:
+            self._journal(lambda w: w.log_gc(safepoint_ts))
             for key, e in list(self._entries.items()):
                 keep: List[Tuple[int, int, int]] = []
                 kept_visible = False
@@ -271,6 +353,7 @@ class MVCCStore:
                 if not e.writes and e.lock is None and not e.data:
                     del self._entries[key]
                     self._dirty = True
+            self._maybe_checkpoint()
         return removed
 
     def resolve_lock(self, key: bytes, start_ts: int, commit_ts: int) -> None:
@@ -281,7 +364,12 @@ class MVCCStore:
             if e is None or e.lock is None or e.lock.start_ts != start_ts:
                 return
             if commit_ts > 0:
-                self._commit_one(key, start_ts, commit_ts)
+                lk = e.lock
+                wtype = W_DELETE if lk.op == OP_DEL else W_PUT
+                self._journal(lambda w: w.log_resolve(
+                    key, start_ts, commit_ts, wtype, lk.value))
+                self._apply_commit(key, lk, start_ts, commit_ts)
+                self._maybe_checkpoint()
             else:
                 self.rollback([key], start_ts)
 
@@ -291,3 +379,80 @@ class MVCCStore:
             return [k for k, e in self._entries.items()
                     if e.lock is not None and
                     (start_ts is None or e.lock.start_ts == start_ts)]
+
+    def max_known_ts(self) -> int:
+        """Largest timestamp recorded anywhere in the entry map — after
+        recovery the oracle must be advanced past it so a fast restart
+        loop can never mint a timestamp that collides with (or sorts
+        below) pre-crash history."""
+        with self._mu:
+            m = 0
+            for e in self._entries.values():
+                if e.lock is not None and e.lock.start_ts > m:
+                    m = e.lock.start_ts
+                for w in e.writes:
+                    if w[0] > m:
+                        m = w[0]
+                    if w[2] > m:
+                        m = w[2]
+                for sts in e.data:
+                    if sts > m:
+                        m = sts
+            return m
+
+    # ---- recovery replay (kv/wal.py) ----------------------------------
+    # Raw redo application: validation already happened when the record
+    # was journaled, so these rebuild state without re-checking — the
+    # byte-for-byte shape a live store would have reached.
+    def _replay_prewrite(self, primary: bytes, start_ts: int, ttl_ms: int,
+                         muts: List[Tuple[int, bytes, bytes]]) -> None:
+        with self._mu:
+            for op, key, value in muts:
+                self._entry(key).lock = Lock(primary, start_ts, ttl_ms,
+                                             op, value)
+
+    def _replay_commit(self, start_ts: int, commit_ts: int,
+                       items: List[Tuple[bytes, int, bytes]]) -> None:
+        with self._mu:
+            for key, wtype, value in items:
+                e = self._entry(key)
+                if wtype == W_PUT:
+                    e.data[start_ts] = value
+                e.writes.append((commit_ts, wtype, start_ts))
+                e.writes.sort(key=lambda w: -w[0])
+                if e.lock is not None and e.lock.start_ts == start_ts:
+                    e.lock = None
+
+    def _replay_rollback(self, start_ts: int, keys: List[bytes]) -> None:
+        with self._mu:
+            for key in keys:
+                e = self._entry(key)
+                if e.lock is not None and e.lock.start_ts == start_ts:
+                    e.lock = None
+                if not any(w[2] == start_ts for w in e.writes):
+                    e.writes.append((start_ts, W_ROLLBACK, start_ts))
+                    e.writes.sort(key=lambda w: -w[0])
+
+    def _replay_resolve(self, key: bytes, start_ts: int, commit_ts: int,
+                        wtype: int, value: bytes) -> None:
+        if commit_ts > 0:
+            self._replay_commit(start_ts, commit_ts, [(key, wtype, value)])
+        else:
+            self._replay_rollback(start_ts, [key])
+
+    def _replay_gc(self, safepoint_ts: int) -> None:
+        with self._mu:
+            was = self._replaying
+            self._replaying = True
+            try:
+                self.gc(safepoint_ts)
+            finally:
+                self._replaying = was
+
+    def _replay_backfill(self, ts: int,
+                         kvs: List[Tuple[bytes, bytes]]) -> None:
+        with self._mu:
+            for key, value in kvs:
+                e = self._entry(key)
+                e.data[ts] = value
+                e.writes.append((ts, W_PUT, ts))

@@ -45,6 +45,16 @@ class Oracle:
                 phys = self._last_physical
             return compose_ts(phys, self._logical)
 
+    def ensure_after(self, ts: int) -> None:
+        """Fence the oracle past ``ts``: every future timestamp is
+        strictly greater.  Recovery calls this with the max timestamp in
+        the replayed entry map — a restart within the same millisecond
+        (or under a skewed clock) must never re-mint a pre-crash ts."""
+        with self._lock:
+            if ts >= compose_ts(self._last_physical, self._logical):
+                self._last_physical = ts >> PHYSICAL_SHIFT
+                self._logical = ts & ((1 << PHYSICAL_SHIFT) - 1)
+
     def get_timestamp_async(self):
         """Lazy TSO future (reference: session.go:638-663 lazy txn +
         GetTimestampAsync): capture nothing now, fetch on .wait()."""

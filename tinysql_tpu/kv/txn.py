@@ -468,23 +468,46 @@ class Transaction:
         self.valid = False
 
 
+def resolve_data_dir(data_dir: Optional[str]) -> str:
+    """Durability arming chain: explicit arg > TINYSQL_DATA_DIR env >
+    config.data_dir; empty everywhere = today's volatile store."""
+    if data_dir is not None:
+        return data_dir
+    import os
+    env = os.environ.get("TINYSQL_DATA_DIR", "")
+    if env:
+        return env
+    from .. import config as cfgmod
+    return getattr(cfgmod.get_global_config(), "data_dir", "") or ""
+
+
 class TiKVStorage:
     """Storage facade: cluster + mvcc + oracle + client + caches
-    (reference: store/tikv/kv.go tikvStore + store/mockstore driver)."""
+    (reference: store/tikv/kv.go tikvStore + store/mockstore driver).
 
-    def __init__(self, num_stores: int = 1):
+    With a ``data_dir`` the MVCC store journals to a WAL and recovers on
+    construction (kv/wal.py); the oracle is then fenced past every
+    recovered timestamp so restart loops cannot mint colliding or
+    backwards timestamps."""
+
+    def __init__(self, num_stores: int = 1,
+                 data_dir: Optional[str] = None):
         from .cluster import Cluster
         from .mvcc import MVCCStore
+        self.data_dir = resolve_data_dir(data_dir)
         self.cluster = Cluster()
         self.cluster.bootstrap(num_stores)
-        self.mvcc = MVCCStore()
+        self.mvcc = MVCCStore(self.data_dir or None)
         self.client = RPCClient(self.cluster, self.mvcc)
         self.cache = RegionCache(self.cluster)
         self.oracle = Oracle()
+        if self.mvcc.recovery_info is not None:
+            self.oracle.ensure_after(self.mvcc.max_known_ts())
         self.resolver = LockResolver(self.client, self.cache, self.oracle,
                                      storage=self)
         from ..distsql.copr import make_cop_handler
         self.client.cop_handler = make_cop_handler(self.mvcc)
+        self._gc_last = 0.0
 
     def begin(self, start_ts: Optional[int] = None) -> Transaction:
         if start_ts is None:
@@ -499,7 +522,61 @@ class TiKVStorage:
     def current_version(self) -> int:
         return self.oracle.get_timestamp()
 
+    # ---- durability lifecycle -------------------------------------------
+    def flush_and_checkpoint(self) -> None:
+        """Fsync the WAL tail and fold it into a fresh checkpoint — the
+        graceful-close hook (both wire modes route through here).  No-op
+        on a volatile store; raises CheckpointError on a failed attempt
+        (the unrotated log stays authoritative)."""
+        wal = self.mvcc.wal
+        if wal is None:
+            return
+        wal.flush()
+        wal.checkpoint(self.mvcc)
 
-def new_mock_storage(num_stores: int = 1) -> TiKVStorage:
+    def close(self) -> None:
+        """Full shutdown: checkpoint (best effort) and close the WAL."""
+        wal = self.mvcc.wal
+        if wal is None:
+            return
+        try:
+            self.flush_and_checkpoint()
+        except KVError:
+            pass
+        wal.close()
+
+    # ---- gc safepoint trigger (satellite of the durability story) -------
+    def run_gc(self, safepoint_ts: int) -> int:
+        """Journal + apply one GC pass at an explicit safepoint."""
+        from .wal import _bump
+        removed = self.mvcc.gc(safepoint_ts)
+        _bump("gc_runs")
+        _bump("gc_removed", removed)
+        return removed
+
+    def maybe_run_gc(self, retention_s: float,
+                     force: bool = False) -> int:
+        """The `tidb_gc_safepoint` sysvar's trigger (domain owner loop):
+        GC versions older than ``retention_s`` seconds, self-paced to at
+        most one pass per half-retention (floor 1s).  retention<=0 =
+        disabled."""
+        import time as _time
+        try:
+            retention_s = float(retention_s)
+        except (TypeError, ValueError):
+            return 0
+        if retention_s <= 0:
+            return 0
+        now = _time.time()
+        if not force and now - self._gc_last < max(1.0, retention_s / 2):
+            return 0
+        self._gc_last = now
+        from .oracle import compose_ts
+        safepoint = compose_ts(int((now - retention_s) * 1000), 0)
+        return self.run_gc(safepoint)
+
+
+def new_mock_storage(num_stores: int = 1,
+                     data_dir: Optional[str] = None) -> TiKVStorage:
     """reference: store/mockstore/tikv.go NewMockTikvStore."""
-    return TiKVStorage(num_stores)
+    return TiKVStorage(num_stores, data_dir=data_dir)

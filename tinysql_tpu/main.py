@@ -26,6 +26,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("-P", "--port", type=int, default=None)
     ap.add_argument("--store", default=None, choices=["mocktikv"])
     ap.add_argument("--path", default=None, help="store path/dsn")
+    ap.add_argument("--data-dir", default=None,
+                    help="durable MVCC data directory (WAL + checkpoints);"
+                         " empty = volatile store")
     ap.add_argument("--status", type=int, default=None,
                     help="status HTTP port")
     ap.add_argument("--log-file", default=None)
@@ -45,6 +48,8 @@ def load_config(argv) -> cfgmod.Config:
         cfg.store = args.store
     if args.path is not None:
         cfg.path = args.path
+    if args.data_dir is not None:
+        cfg.data_dir = args.data_dir
     if args.status is not None:
         cfg.status.status_port = args.status
     if args.log_file is not None:
@@ -88,7 +93,16 @@ def main(argv=None) -> int:
     setup_logging(cfg)
     _honor_jax_platforms_env()
     log = logging.getLogger("tinysql_tpu")
-    storage = new_mock_storage(num_stores=cfg.num_stores)
+    # data_dir: CLI/config wins; "" falls through to TINYSQL_DATA_DIR env
+    # (kv/txn.py resolve_data_dir); no dir at all = the volatile store
+    storage = new_mock_storage(num_stores=cfg.num_stores,
+                               data_dir=cfg.data_dir or None)
+    if storage.data_dir:
+        ri = storage.mvcc.recovery_info or {}
+        log.info("durable store on %s (replayed %d wal records, "
+                 "%d in-flight locks recovered)", storage.data_dir,
+                 ri.get("replayed_records", 0),
+                 ri.get("recovered_locks", 0))
     bootstrap(storage)
     server = Server(storage, cfg.host, cfg.port,
                     ssl_cert=cfg.security.ssl_cert,
@@ -111,6 +125,7 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, on_signal)
     stop.wait()
     server.close()
+    storage.close()  # final WAL checkpoint + fd close (no-op volatile)
     if status is not None:
         status.close()
     return 0

@@ -58,6 +58,11 @@ tests/test_tsring.py):
   — one hash partition rivals the whole input, so the mesh sits idle
   while those operators run single-device; critical when the window
   abandoned more attempts than it completed sharded rounds;
+- **wal-stall** (ISSUE 19): the durability journal is degraded — mean
+  WAL fsync wall time within the window past threshold (under
+  ``tidb_wal_fsync=strict`` every commit-class ack pays it), or any
+  append/fsync ERROR at all (critical: writes surface typed WalErrors
+  and nothing new is durable until the log is writable);
 - **cpu-saturation** (ISSUE 13): one thread role dominates the busy
   host-CPU samples (obs/conprof.py) while the admission queue is
   non-empty — the serving tier's latency is host CPU in that role, and
@@ -154,6 +159,16 @@ BATCH_DEGRADED_CRIT = 0.50
 #: bailing to the single-device kernel is the capacity gate working as
 #: designed, a stream of them means the mesh is idle for this workload
 SHARD_SKEW_RETRIES_WARN = 2
+
+#: wal-stall (ISSUE 19): minimum windowed fsyncs before the mean may
+#: judge (one slow sync on a cold disk is noise), and the mean fsync
+#: wall seconds at warning / critical — past these every commit-class
+#: ack under the strict policy eats the stall, so commit latency IS
+#: the disk.  Any windowed append/fsync error is critical outright:
+#: the durability path itself failed.
+WAL_STALL_MIN_FSYNCS = 5
+WAL_STALL_MEAN_WARN_S = 0.010
+WAL_STALL_MEAN_CRIT_S = 0.050
 
 #: connection-pressure (ISSUE 15): minimum windowed 1040 sheds before
 #: the rule speaks at all — one refused connect is a client retrying
@@ -645,6 +660,43 @@ def _rule_shard_imbalance(ctx: InspectionContext) -> List[Finding]:
         "ran single-device — this key distribution defeats the "
         "partitioner; results stay correct, the mesh speedup is gone",
         "tinysql_shard_skew_retries_total")]
+
+
+@rule("wal-stall")
+def _rule_wal_stall(ctx: InspectionContext) -> List[Finding]:
+    """Durability path degraded (ISSUE 19): WAL fsyncs stalling (under
+    the strict policy every commit-class ack waits on one, so commit
+    latency IS the disk) or — worse — append/fsync errors, meaning the
+    journal itself is failing while the store keeps refusing to diverge
+    ahead of it."""
+    out: List[Finding] = []
+    errs = (ctx.delta("tinysql_wal_append_errors_total")
+            + ctx.delta("tinysql_wal_fsync_errors_total"))
+    if errs > 0:
+        out.append(ctx.evidence(
+            "wal-stall", "storage", "critical",
+            f"{errs:.0f} WAL append/fsync error(s) within the window: "
+            "the durability journal is failing — affected mutations "
+            "surfaced typed WalErrors without mutating the store, but "
+            "no new write is durable until the log is writable again "
+            "(check the data dir's filesystem)",
+            "tinysql_wal_fsync_errors_total"))
+    fsyncs = ctx.delta("tinysql_wal_fsyncs_total")
+    if fsyncs >= WAL_STALL_MIN_FSYNCS:
+        mean_s = ctx.delta("tinysql_wal_fsync_seconds_total") / fsyncs
+        if mean_s >= WAL_STALL_MEAN_WARN_S:
+            sev = ("critical" if mean_s >= WAL_STALL_MEAN_CRIT_S
+                   else "warning")
+            out.append(ctx.evidence(
+                "wal-stall", "storage", sev,
+                f"mean WAL fsync took {mean_s * 1000:.1f}ms over "
+                f"{fsyncs:.0f} sync(s) in the window: commit-class "
+                "acks under tidb_wal_fsync=strict are paying this "
+                "stall per statement — a slow or contended data-dir "
+                "disk; consider tidb_wal_fsync=relaxed (group commit) "
+                "if power-loss durability per ack is not required",
+                "tinysql_wal_fsync_seconds_total"))
+    return out
 
 
 @rule("cpu-saturation")

@@ -307,6 +307,55 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "tinysql_hbm_limit_bytes":
         ("gauge", "Backend device-memory capacity when exposed "
                   "(memory_stats bytes_limit; 0 on CPU)"),
+    # durable MVCC: WAL + checkpoint + crash recovery (kv/wal.py STATS)
+    "tinysql_wal_appends_total":
+        ("counter", "WAL records journaled (prewrite/commit/rollback/"
+                    "resolve/gc/backfill)"),
+    "tinysql_wal_append_bytes_total":
+        ("counter", "Framed bytes written to the write-ahead log"),
+    "tinysql_wal_append_errors_total":
+        ("counter", "WAL appends that failed BEFORE mutating the store "
+                    "(typed WalError surfaced to the caller)"),
+    "tinysql_wal_fsyncs_total":
+        ("counter", "WAL fsync syscalls (strict: per commit-class "
+                    "record; relaxed: group commit)"),
+    "tinysql_wal_fsync_seconds_total":
+        ("counter", "Wall seconds inside WAL fsync — the durability "
+                    "tax; the wal-stall rule's evidence"),
+    "tinysql_wal_fsync_errors_total":
+        ("counter", "WAL fsync failures (outcome undetermined: bytes "
+                    "may survive in the page cache)"),
+    "tinysql_wal_torn_writes_total":
+        ("counter", "Deliberately half-written records (walTornTail "
+                    "crash-boundary lever)"),
+    "tinysql_wal_size_bytes":
+        ("gauge", "Bytes in the live log since the last checkpoint "
+                  "rotation"),
+    "tinysql_wal_checkpoints_total":
+        ("counter", "Full entry-map snapshots atomically installed "
+                    "(tmp -> fsync -> rename -> log truncate)"),
+    "tinysql_wal_checkpoint_seconds_total":
+        ("counter", "Wall seconds spent writing checkpoints"),
+    "tinysql_wal_checkpoint_errors_total":
+        ("counter", "Checkpoint attempts that failed before the atomic "
+                    "rename — counted, never fatal"),
+    "tinysql_recovery_runs_total":
+        ("counter", "Crash-recovery passes (checkpoint load + wal "
+                    "replay) at store open"),
+    "tinysql_recovery_replayed_records_total":
+        ("counter", "WAL records re-applied during recovery"),
+    "tinysql_recovery_locks_total":
+        ("counter", "In-flight Percolator locks rebuilt by recovery "
+                    "(TTL re-armed from restart time) for the "
+                    "lock-resolution ladder to fence or complete"),
+    "tinysql_recovery_truncated_tails_total":
+        ("counter", "Torn log tails truncated at the first bad "
+                    "checksum during recovery"),
+    "tinysql_gc_runs_total":
+        ("counter", "MVCC garbage-collection sweeps run under the "
+                    "tidb_gc_safepoint trigger"),
+    "tinysql_gc_removed_versions_total":
+        ("counter", "Stale MVCC versions removed below the safepoint"),
     # time-series sampler self-accounting (obs/tsring.py)
     "tinysql_metrics_samples_total":
         ("counter", "Time-series ring samples taken"),
@@ -329,6 +378,29 @@ SHARD_METRIC_NAMES = (
     ("shard_exchange_bytes", "tinysql_shard_exchange_bytes_total"),
     ("shard_skew_retries", "tinysql_shard_skew_retries_total"),
     ("shard_stacked_rounds", "tinysql_shard_stacked_rounds_total"),
+)
+
+#: kv/wal.py STATS key -> metric name (ONE map shared by the /metrics
+#: render and the tsring "wal" source).  tinysql_wal_size_bytes is the
+#: only gauge — everything else accumulates.
+WAL_METRIC_NAMES = (
+    ("appends", "tinysql_wal_appends_total"),
+    ("append_bytes", "tinysql_wal_append_bytes_total"),
+    ("append_errors", "tinysql_wal_append_errors_total"),
+    ("fsyncs", "tinysql_wal_fsyncs_total"),
+    ("fsync_s", "tinysql_wal_fsync_seconds_total"),
+    ("fsync_errors", "tinysql_wal_fsync_errors_total"),
+    ("torn_writes", "tinysql_wal_torn_writes_total"),
+    ("wal_size_bytes", "tinysql_wal_size_bytes"),
+    ("checkpoints", "tinysql_wal_checkpoints_total"),
+    ("checkpoint_s", "tinysql_wal_checkpoint_seconds_total"),
+    ("checkpoint_errors", "tinysql_wal_checkpoint_errors_total"),
+    ("recoveries", "tinysql_recovery_runs_total"),
+    ("replayed_records", "tinysql_recovery_replayed_records_total"),
+    ("recovered_locks", "tinysql_recovery_locks_total"),
+    ("truncated_tails", "tinysql_recovery_truncated_tails_total"),
+    ("gc_runs", "tinysql_gc_runs_total"),
+    ("gc_removed", "tinysql_gc_removed_versions_total"),
 )
 
 #: STATS keys that are high-water marks (gauges), not accumulators —
@@ -561,6 +633,18 @@ def render_prometheus() -> str:
         for key, name in SHARD_METRIC_NAMES:
             kind = METRICS[name][0]
             emit(name, METRICS[name][1], kind, [((), sh.get(key, 0))])
+    # durable MVCC (kv/wal.py STATS): all-zero means no data dir was
+    # ever armed — emit nothing so the volatile store's /metrics output
+    # is byte-identical to the pre-WAL build
+    try:
+        from ..kv.wal import stats_snapshot as wal_stats
+        wl = wal_stats()
+    except Exception:
+        wl = {}
+    if any(wl.values()):
+        for key, name in WAL_METRIC_NAMES:
+            kind = METRICS[name][0]
+            emit(name, METRICS[name][1], kind, [((), wl.get(key, 0))])
 
     # serving-layer counters: admission verdicts (server/admission.py)
     # and cross-query micro-batching (ops/batching.py)

@@ -529,6 +529,15 @@ def _src_shardops() -> Dict[str, float]:
     return {name: s.get(key, 0) for key, name in SHARD_METRIC_NAMES}
 
 
+def _src_wal() -> Dict[str, float]:
+    from ..kv.wal import stats_snapshot
+    from .metrics import WAL_METRIC_NAMES
+    s = stats_snapshot()
+    if not any(s.values()):
+        return {}  # volatile store: zero movement, zero samples
+    return {name: s.get(key, 0) for key, name in WAL_METRIC_NAMES}
+
+
 def _src_degrade() -> Dict[str, float]:
     from ..ops import degrade
     d = degrade.snapshot()
@@ -602,6 +611,7 @@ for _name, _fn in (("queries", _src_queries), ("kernels", _src_kernels),
                    ("conn", _src_conn), ("admission", _src_admission),
                    ("batching", _src_batching), ("memory", _src_memory),
                    ("spill", _src_spill), ("shardops", _src_shardops),
+                   ("wal", _src_wal),
                    ("degrade", _src_degrade),
                    ("failpoints", _src_failpoints),
                    ("prewarm", _src_prewarm), ("slo", _src_slo),

@@ -11,6 +11,7 @@ from __future__ import annotations
 import logging
 import socket
 import threading
+import time
 import weakref
 from typing import Dict, Optional
 
@@ -474,6 +475,9 @@ class Server:
             from ..obs import inspect as obs_inspect
             obs_inspect.set_slo_p99_ms(float(
                 g.get("tidb_slo_p99_ms", 0) or 0))
+            wal = self.storage.mvcc.wal
+            if wal is not None and g.get("tidb_wal_fsync"):
+                wal.set_fsync_policy(str(g["tidb_wal_fsync"]))
         except Exception:
             log.warning("device-profile knob re-apply failed",
                         exc_info=True)
@@ -550,6 +554,20 @@ class Server:
     def close(self) -> None:
         """Graceful drain (reference: server.go:155-283)."""
         self._closed.set()
+        # shutdown drain: give in-flight pooled statements a bounded
+        # window to complete (and their responses to flush) BEFORE the
+        # front ends are torn down — the WAL checkpoint below must cover
+        # every statement the wire acked.  Wedged statements (armed
+        # sleeps, kills in flight) fall through to today's cancel path.
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            try:
+                snap = self.pool.snapshot()
+                if not snap.get("running") and not snap.get("queued"):
+                    break
+            except Exception:
+                break
+            time.sleep(0.01)  # qlint: disable=CC701 -- bounded drain poll at shutdown, no lock held
         with self._mu:
             fe = self._aio
         if fe is not None:
@@ -572,3 +590,15 @@ class Server:
                     cc.sock.shutdown(socket.SHUT_RDWR)
                 except OSError:
                     pass
+        # graceful-close durability parity (BOTH wire modes end here):
+        # fsync the WAL tail and fold it into a checkpoint, so a clean
+        # shutdown leaves the data dir checkpoint-clean.  Best effort —
+        # a failed checkpoint leaves the unrotated log authoritative,
+        # and a shared storage may already be closed by another server.
+        flush = getattr(self.storage, "flush_and_checkpoint", None)
+        if flush is not None:
+            try:
+                flush()
+            except Exception:
+                log.warning("wal checkpoint on close failed",
+                            exc_info=True)

@@ -109,6 +109,18 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # failpoint arming spec (fail.configure): "name=error(msg);..." —
     # process-global, empty string disarms everything
     "tidb_failpoints": "",
+    # ---- durability (kv/wal.py; active only on a data_dir store) ------
+    # WAL fsync policy, applied to the live store at SET time:
+    # 'strict' = fsync before acking every commit-class record,
+    # 'relaxed' = group commit (one fsync per GROUP_COMMIT_S window;
+    # a POWER loss can lose acks inside the open window, a SIGKILL
+    # cannot), 'off' = never fsync the log (checkpoints still fsync)
+    "tidb_wal_fsync": "relaxed",
+    # GC retention in SECONDS: versions older than this are collectable
+    # by the domain owner loop's safepoint trigger (storage.maybe_run_gc,
+    # self-paced to one pass per half-retention).  0 = GC disabled —
+    # mvcc.gc() is never invoked, today's unbounded-history behavior
+    "tidb_gc_safepoint": 0,
     # stats-driven auto-prewarm (session/prewarm.py PrewarmWorker, wired
     # into the server lifecycle): a background worker ranks the top-K
     # (digest, bucket) families from statements_summary by exec count x
@@ -1177,6 +1189,30 @@ class Session:
                         f"value of '{v}'", mysql_code=1231,
                         sqlstate="42000")
                 v = mv
+            if name == "tidb_wal_fsync":
+                # enum validated at SET time, applied to the live WAL
+                # immediately (no-op on a volatile store): the fsync
+                # policy is a store property, not a per-session one
+                pv = str(v).strip().lower() if v is not None else ""
+                if pv not in ("off", "relaxed", "strict"):
+                    raise SessionError(
+                        f"Variable 'tidb_wal_fsync' can't be set to the "
+                        f"value of '{v}'", mysql_code=1231,
+                        sqlstate="42000")
+                v = pv
+            if name == "tidb_gc_safepoint":
+                # retention seconds, numeric >= 0 (0 disables GC)
+                try:
+                    gv = float(v if not isinstance(v, bool) else "x")
+                except (TypeError, ValueError):
+                    raise SessionError(
+                        f"Incorrect argument type to variable '{name}'",
+                        mysql_code=1232, sqlstate="42000")
+                if gv < 0:
+                    raise SessionError(
+                        f"Variable '{name}' can't be set to the value "
+                        f"of '{v}'", mysql_code=1231, sqlstate="42000")
+                v = gv
             if name == "tidb_failpoints":
                 # validate + apply atomically BEFORE storing: a bad spec
                 # must fail the SET and leave the armed set unchanged
@@ -1206,6 +1242,11 @@ class Session:
                 # source (obs/inspect.py owns the objective state)
                 from ..obs import inspect as obs_inspect
                 obs_inspect.set_slo_p99_ms(float(v))
+            elif name == "tidb_wal_fsync":
+                wal = getattr(getattr(self.storage, "mvcc", None),
+                              "wal", None)
+                if wal is not None:
+                    wal.set_fsync_policy(str(v))
         return None
 
     # ---- SHOW (reference: executor/show.go) ------------------------------
