@@ -30,6 +30,7 @@ bench.py's contract):
     {"metric": "obs_overhead_frac", "value": ..., "unit": "frac"}
     {"metric": "conprof_overhead_frac", "value": ..., "unit": "frac"}
     {"metric": "memprof_overhead_frac", "value": ..., "unit": "frac"}
+    {"metric": "flight_overhead_frac", "value": ..., "unit": "frac"}
     {"metric": "serve_queue_wait_p99_share", "value": ..., "unit": "frac"}
     {"metric": "serve_dispatches_per_query", "value": ..., "unit": "dispatches"}
     {"metric": "serve_storm_dispatches_per_query", "value": ..., "unit": "dispatches"}
@@ -49,8 +50,14 @@ host profiler's LIVE self-cost across the mixed + storm window
 sampler's own backoff as the enforcement mechanism);
 memprof_overhead_frac is the continuous HEAP profiler's live self-cost
 over the same window (obs/memprof.live_overhead_frac — same < 3% gate,
-same backoff enforcement); the queue-wait share splits the published
-p99 into wait vs execution from the "queue" phase histogram.
+same backoff enforcement); flight_overhead_frac is the durable flight
+writer's per-tick snapshot+append self-cost amortized over the default
+tidb_flight_interval duty cycle, measured ARMED on a throwaway data
+dir (a 1 s collection cadence just gathers more ticks per bench
+second) against the obs stores the storm just populated — and conprof
++ memprof + flight COMBINED are gated < 3%; the queue-wait share
+splits the published p99 into wait vs execution from the "queue"
+phase histogram.
 
 Hard assertions (the serve-smoke CI gate): zero statement errors, at
 least one coalesced batch with occupancy > 1 in the storm, at least
@@ -71,6 +78,7 @@ SERVE_C10K_OVERLOAD (16, over-cap connect burst).
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 
@@ -250,6 +258,7 @@ def main():
     from tinysql_tpu.obs import memprof
     memprof0 = memprof.stats_snapshot()
     memprof_t0 = time.time()
+    from tinysql_tpu.obs import flight
     # dispatches-per-query over the mixed phase (the ROADMAP item 2
     # gate): compiled-program dispatches the whole serving tier paid,
     # divided by the statements the clients completed
@@ -600,6 +609,30 @@ def main():
     memprof_stats = memprof.stats_snapshot()
     memprof_frac = memprof.live_overhead_frac(
         memprof0, memprof_stats, time.time() - memprof_t0)
+    # flight-writer live window (ISSUE 20): the serving run above is
+    # volatile (no data dir), so the writer is measured ARMED on a
+    # throwaway dir at a 1 s interval — 10x the default duty cycle,
+    # snapshotting the obs stores the storm just populated; its
+    # measured-live frac joins the combined gate below
+    from tinysql_tpu.session.session import new_session
+    flight_dir = tempfile.mkdtemp(prefix="bench-flight-")
+    flight_storage = new_mock_storage(data_dir=flight_dir)
+    new_session(flight_storage).execute(
+        "set global tidb_flight_interval = 1")
+    flight_writer = flight.FlightWriter(flight_storage)
+    flight0 = flight.stats_snapshot()
+    flight_writer.start()
+    time.sleep(5.0)
+    flight_stats = flight.stats_snapshot()
+    flight_writer.close()
+    # the writer's duty cycle is interval-paced, so its live frac is
+    # (measured per-tick self-cost) / (default interval) — the 1 s
+    # cadence above just collects more ticks per bench second
+    flight_ticks = flight_stats["segments"] - flight0["segments"]
+    flight_self_s = flight_stats["self_s"] - flight0["self_s"]
+    flight_frac = (flight_self_s
+                   / (flight_ticks * flight.DEFAULT_INTERVAL_S)
+                   if flight_ticks else 0.0)
     print(f"[serve] memprof frac={memprof_frac} backoff="
           f"{memprof_stats.get('backoff')} ticks="
           f"{memprof_stats.get('ticks')} roles={heap_roles}",
@@ -638,6 +671,11 @@ def main():
             "errors": memprof_stats.get("errors", 0),
             "roles": heap_roles,
         },
+        "flight": {
+            "overhead_frac": flight_frac,
+            "segments": flight_stats.get("segments", 0),
+            "errors": flight_stats.get("errors", 0),
+        },
         "queue_wait_p99_ms": round(queue_p99_ms, 2),
         "queue_wait_stmts": queue_hist["count"],
         "total_bench_seconds": round(time.time() - t_start, 1),
@@ -653,6 +691,8 @@ def main():
                       "value": conprof_frac, "unit": "frac"}))
     print(json.dumps({"metric": "memprof_overhead_frac",
                       "value": memprof_frac, "unit": "frac"}))
+    print(json.dumps({"metric": "flight_overhead_frac",
+                      "value": flight_frac, "unit": "frac"}))
     print(json.dumps({"metric": "serve_queue_wait_p99_share",
                       "value": queue_share, "unit": "frac"}))
     print(json.dumps({"metric": "serve_dispatches_per_query",
@@ -710,6 +750,10 @@ def main():
     # the continuous profiler's LIVE self-cost stays under 3% of one
     # core (the sampler's own backoff enforces it; the gate proves it)
     assert conprof_frac < 0.03, (conprof_frac, conprof_stats)
+    # ---- flight recorder gate (ISSUE 20 acceptance): the three live
+    # samplers COMBINED stay under the observability budget ---------------
+    assert conprof_frac + memprof_frac + flight_frac < 0.03, \
+        (conprof_frac, memprof_frac, flight_frac)
     # ---- memory truth gate (ISSUE 18 acceptance) ------------------------
     # the heap profiler's LIVE self-cost stays under 3% of one core too
     # (same backoff mechanism, same measured-live definition)

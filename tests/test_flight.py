@@ -1,0 +1,323 @@
+"""Flight recorder (tinysql_tpu/obs/flight.py): volatile byte-identity,
+segment durability across close/reopen, torn-tail truncation, retention
+compaction, the incarnation column on the history mem-tables, the
+``flight_incarnations`` surface, the /debug endpoints, and the
+size-capped slow-log rotation satellite."""
+import json
+import os
+import urllib.request
+
+import pytest
+
+from tinysql_tpu.kv import new_mock_storage
+from tinysql_tpu.obs import flight
+from tinysql_tpu.obs import metrics as obs_metrics
+from tinysql_tpu.obs import slowlog as obs_slowlog
+from tinysql_tpu.obs import stmtsummary, tsring
+from tinysql_tpu.server.http_status import DEBUG_ENDPOINTS, StatusServer
+from tinysql_tpu.utils.testkit import TestKit
+
+
+@pytest.fixture(autouse=True)
+def _isolated_flight_state():
+    """flight keeps process-global state (active writer, cumulative
+    STATS); every test here starts and ends detached + zeroed so the
+    volatile byte-identity assertions can't see a neighbor's armed
+    run."""
+    flight._set_active(None)
+    flight.reset_stats()
+    yield
+    flight._set_active(None)
+    flight.reset_stats()
+
+
+def _kit(storage=None) -> TestKit:
+    tk = TestKit(storage)
+    tk.must_exec("create database if not exists test")
+    tk.must_exec("use test")
+    tk.must_exec("set @@tidb_use_tpu = 0")
+    return tk
+
+
+# ---- volatile byte-identity ----------------------------------------------
+
+def test_volatile_run_moves_no_flight_counters():
+    """No data dir => no store, no thread, no segment bytes, and the
+    tinysql_flight_* family stays OUT of /metrics and the tsring source
+    (the kv/wal.py any-counter-moved discipline)."""
+    st = new_mock_storage()
+    assert st.data_dir == ""
+    w = flight.FlightWriter(st)
+    assert w.store is None
+    w.start()          # must be a no-op, not a paused thread
+    assert w._thread is None
+    tk = _kit(st)
+    tk.must_exec("create table v (a int primary key)")
+    tk.must_exec("insert into v values (1)")
+    tk.must_query("select a from v")
+    w.close()
+    assert all(v == 0 for v in flight.stats_snapshot().values())
+    text = obs_metrics.render_prometheus()
+    assert "tinysql_flight_" not in text
+    # identity is NOT flight activity: always exported
+    assert "tinysql_incarnation " in text
+    assert "tinysql_server_start_timestamp " in text
+    assert tsring._src_flight() == {}
+
+
+def test_volatile_incarnation_counter_still_advances():
+    st = new_mock_storage()
+    before = flight.current_incarnation()
+    flight.FlightWriter(st)
+    mid = flight.current_incarnation()
+    flight.FlightWriter(st)
+    assert mid == before + 1
+    assert flight.current_incarnation() == mid + 1
+    assert flight.server_start_ts() > 0
+
+
+# ---- durability across close/reopen --------------------------------------
+
+def _armed_cycle(tmp_path):
+    """One armed incarnation with real telemetry: returns the summary
+    rows and metric samples captured in its segments."""
+    st = new_mock_storage(data_dir=str(tmp_path))
+    tk = _kit(st)
+    tk.must_exec("create table f (a int primary key, b int)")
+    for i in range(4):
+        tk.must_exec(f"insert into f values ({i}, {i})")
+    tk.must_query("select b, count(*) from f group by b")
+    w = flight.FlightWriter(st)
+    assert w.store is not None and w.store.incarnation >= 1
+    inc = w.store.incarnation
+    # a deterministic metric sample for the metrics tier
+    tsring.RING.record({"tinysql_queries_total": 41.0})
+    w.flush_now()
+    pre_summary = stmtsummary.history_rows()
+    w.close()   # final flush: marks the run clean
+    return st, inc, pre_summary
+
+
+def test_close_reopen_replays_presummary_rows(tmp_path):
+    _st, inc, pre_summary = _armed_cycle(tmp_path)
+
+    st2 = new_mock_storage(data_dir=str(tmp_path))
+    w2 = flight.FlightWriter(st2)
+    try:
+        assert w2.store.incarnation == inc + 1
+        assert inc in w2.store.prior
+        # replayed summary tier == the SQL rows the dead process served
+        replay = w2.store.tier_rows(inc, "summary")
+        assert [list(map(str, r)) for r in replay] \
+            == [list(map(str, r)) for r in pre_summary]
+        # the recorded ring sample crossed death too
+        mrows = w2.store.tier_rows(inc, "metrics")
+        assert ["tinysql_queries_total", 41.0] in \
+            [[r[2], r[3]] for r in mrows]
+        # and the SQL surface answers with the incarnation predicate
+        tk2 = _kit(st2)
+        rows = tk2.must_query(
+            "select digest, incarnation from information_schema"
+            ".statements_summary_history").data
+        incs = {int(r[1]) for r in rows}
+        assert inc in incs and (inc + 1) in incs
+    finally:
+        w2.close()
+
+
+def test_flight_incarnations_surface(tmp_path):
+    _st, inc, _pre = _armed_cycle(tmp_path)
+    st2 = new_mock_storage(data_dir=str(tmp_path))
+    w2 = flight.FlightWriter(st2)
+    try:
+        tk2 = _kit(st2)
+        res = tk2.must_query("select * from information_schema"
+                             ".flight_incarnations")
+        assert res.columns == [c for c, _ in flight.INCARNATION_COLUMNS]
+        by_inc = {int(r[0]): r for r in res.data}
+        # the closed run flushed a final segment on an intact tail
+        assert by_inc[inc][3] == "clean"
+        assert int(by_inc[inc][5]) >= 2  # tick + final
+        assert by_inc[inc + 1][3] == "running"
+    finally:
+        w2.close()
+
+
+def test_final_segment_carries_blackbox(tmp_path):
+    _st, inc, _pre = _armed_cycle(tmp_path)
+    store = flight.FlightStore(str(tmp_path))
+    store.open_read_only()
+    doc = store.last_segment(inc)
+    assert doc["final"] is True
+    assert doc["reason"] == "close"
+    assert "traces" in doc and "processlist" in doc
+    assert doc["incarnation"] == inc
+
+
+# ---- torn tails ----------------------------------------------------------
+
+def test_torn_tail_marks_run_torn_and_writer_truncates(tmp_path):
+    _st, inc, _pre = _armed_cycle(tmp_path)
+    path = flight._inc_path(os.path.join(str(tmp_path), flight.SUBDIR),
+                            inc)
+    intact = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b"\x07garbage-after-the-last-good-record")
+    # read-only view: the intact segments survive, the verdict is torn
+    ro = flight.FlightStore(str(tmp_path))
+    ro.open_read_only()
+    summ = [s for s in ro.incarnation_summary()
+            if s["incarnation"] == inc][0]
+    assert summ["status"] == "torn"
+    assert summ["segments"] >= 2
+    # writer reopening the SAME file (counter raced a kill) truncates
+    # the garbage at the last good boundary
+    cpath = os.path.join(str(tmp_path), flight.SUBDIR,
+                         flight._COUNTER_FILE)
+    with open(cpath, "w", encoding="utf-8") as f:
+        f.write(f"{inc - 1}\n")
+    store = flight.FlightStore(str(tmp_path))
+    assert store.open_writer() == inc
+    assert os.path.getsize(path) == intact
+    assert flight.stats_snapshot()["torn_truncations"] == 1
+    store.close()
+
+
+def test_kill_between_ticks_is_torn_not_lost(tmp_path):
+    """No final flush (the SIGKILL shape): segments stay readable, the
+    run is torn."""
+    st = new_mock_storage(data_dir=str(tmp_path))
+    w = flight.FlightWriter(st)
+    inc = w.store.incarnation
+    w.flush_now()
+    w.store.close()   # drop the fd WITHOUT final_flush
+    flight._set_active(None)
+    ro = flight.FlightStore(str(tmp_path))
+    ro.open_read_only()
+    summ = [s for s in ro.incarnation_summary()
+            if s["incarnation"] == inc][0]
+    assert summ["status"] == "torn"
+    assert summ["segments"] == 1
+
+
+# ---- retention -----------------------------------------------------------
+
+def test_retention_compaction_bounds_segments(tmp_path):
+    store = flight.FlightStore(str(tmp_path))
+    store.open_writer()
+    retention = 3
+    for i in range(11):
+        store.append_segment({"seq": i, "tiers": {}}, retention)
+    docs, _end, clean = flight._scan_segments(store.path)
+    assert clean
+    assert retention <= len(docs) <= 2 * retention
+    assert docs[-1]["seq"] == 10  # newest survives compaction
+    assert flight.stats_snapshot()["compactions"] >= 1
+    store.close()
+
+
+def test_retention_prunes_old_incarnation_files(tmp_path):
+    for i in range(5):
+        store = flight.FlightStore(str(tmp_path))
+        store.open_writer()
+        store.append_segment({"seq": i, "tiers": {}}, retention=2)
+        store.close()
+    fdir = os.path.join(str(tmp_path), flight.SUBDIR)
+    files = flight._list_incarnation_files(fdir)
+    # newest `retention` files plus (at most) the current one
+    assert len(files) <= 3
+    assert files[-1][0] == 5
+
+
+# ---- incarnation column goldens ------------------------------------------
+
+HISTORY_TABLES = ("metrics_history", "statements_summary_history",
+                  "continuous_profiling", "inspection_result")
+
+
+def test_history_tables_end_with_incarnation_column():
+    tk = _kit()
+    cur = flight.current_incarnation()
+    for table in HISTORY_TABLES:
+        res = tk.must_query(f"select * from information_schema.{table}")
+        assert res.columns[-1] == "incarnation", (table, res.columns)
+        for r in res.data:
+            assert int(r[-1]) == cur, (table, r)
+
+
+# ---- /debug endpoints ----------------------------------------------------
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.read().decode()
+
+
+def test_debug_flight_and_index_and_prior(tmp_path):
+    _st, inc, _pre = _armed_cycle(tmp_path)
+    st2 = new_mock_storage(data_dir=str(tmp_path))
+    w2 = flight.FlightWriter(st2)
+    srv = StatusServer(None, port=0)
+    srv.start()
+    try:
+        snap = json.loads(_get(srv.port, "/debug/flight"))
+        assert snap["armed"] is True
+        assert snap["incarnation"] == inc + 1
+        assert any(s["incarnation"] == inc and s["status"] == "clean"
+                   for s in snap["incarnations"])
+        # the index page names every registered debug endpoint
+        index = _get(srv.port, "/debug/")
+        for path, _desc in DEBUG_ENDPOINTS:
+            assert path in index, path
+        assert _get(srv.port, "/debug") == index
+        # ?incarnation=N serves the PRIOR run's rows
+        prior = json.loads(_get(
+            srv.port, f"/debug/stmtsummary?incarnation={inc}"))
+        assert prior["incarnation"] == inc
+        assert prior["columns"][0] == "summary_begin_time"
+        assert prior["rows"]
+        # out-of-range incarnations fall back to the live view (a list)
+        live = json.loads(_get(srv.port,
+                               "/debug/stmtsummary?incarnation=999"))
+        assert isinstance(live, list)
+    finally:
+        srv.close()
+        w2.close()
+
+
+# ---- slow-log rotation satellite -----------------------------------------
+
+def test_slowlog_size_capped_rotation(tmp_path, monkeypatch):
+    log = tmp_path / "slow.jsonl"
+    monkeypatch.setenv("TINYSQL_SLOW_LOG", str(log))
+    monkeypatch.setenv("TINYSQL_SLOW_LOG_MAX_BYTES", "400")
+    obs_slowlog.clear()
+    n = 12
+    for i in range(n):
+        obs_slowlog.log_slow({"sql": f"q{i}", "pad": "x" * 80})
+    rotated = str(log) + ".1"
+    assert os.path.exists(rotated), "no .1 generation after overflow"
+    assert os.path.getsize(str(log)) <= 400
+    # the cap is file plumbing only: the ring kept every record
+    ring = obs_slowlog.recent()
+    assert [r["sql"] for r in ring] == [f"q{i}" for i in range(n)]
+    # one rotated generation: what is on disk is a contiguous SUFFIX of
+    # the stream (older rotations are discarded, never interleaved)
+    kept = []
+    for p in (rotated, str(log)):
+        with open(p, encoding="utf-8") as f:
+            kept += [json.loads(line)["sql"] for line in f]
+    assert kept == [f"q{i}" for i in range(n - len(kept), n)]
+    assert kept  # disk never ends up empty after an overflow
+
+
+def test_slowlog_unbounded_without_cap(tmp_path, monkeypatch):
+    log = tmp_path / "slow.jsonl"
+    monkeypatch.setenv("TINYSQL_SLOW_LOG", str(log))
+    monkeypatch.delenv("TINYSQL_SLOW_LOG_MAX_BYTES", raising=False)
+    obs_slowlog.clear()
+    for i in range(20):
+        obs_slowlog.log_slow({"sql": f"u{i}", "pad": "x" * 100})
+    assert not os.path.exists(str(log) + ".1")
+    with open(log, encoding="utf-8") as f:
+        assert sum(1 for _ in f) == 20

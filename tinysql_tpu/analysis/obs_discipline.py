@@ -110,7 +110,8 @@ register_rules({
 #: admission/batching counters follow the same discipline: locked
 #: accessor writes inside the owning module, snapshot reads anywhere)
 OWNING_MODULES = ("kernels.py", "progcache.py", "admission.py",
-                  "batching.py", "spill.py", "shardops.py", "wal.py")
+                  "batching.py", "spill.py", "shardops.py", "wal.py",
+                  "flight.py")
 
 #: modules allowed to write the statement-summary store: the store
 #: itself and the session statement-close hook that feeds it

@@ -24,7 +24,14 @@ filter like any other source:
   sample counts and estimated cpu_ms;
 - ``memory_usage``: the memory reconciliation ledger (obs/memprof.py)
   — tracked MemTracker bytes vs measured heap/RSS vs the HBM census
-  with per-owner attribution and the unattributed leak bucket.
+  with per-owner attribution and the unattributed leak bucket;
+- ``flight_incarnations``: the flight recorder's run catalogue
+  (obs/flight.py) — one row per process incarnation with boundaries
+  and the clean-vs-torn shutdown verdict.  The history-shaped tables
+  (``statements_summary_history``, ``metrics_history``,
+  ``continuous_profiling``, ``inspection_result``) carry an
+  ``incarnation`` column: prior runs replay read-only from the
+  durable flight store, the current run is the highest id.
 
 Rows are produced from the live InfoSchema / obs stores at query time.
 The catalog lists ITSELF: ``information_schema`` appears in SCHEMATA,
@@ -50,9 +57,19 @@ def _summary_cols():
     return [(name, kind) for name, kind in COLUMNS]
 
 
+# The cross-incarnation surfaces (ISSUE 20): the history-shaped
+# mem-tables gain an ``incarnation`` column — the current run is the
+# highest id, prior runs replay read-only from the flight store
+# (obs/flight.py).  Current-window tables (statements_summary,
+# metrics_summary) stay incarnation-free: they are by definition live.
+
+def _summary_history_cols():
+    return _summary_cols() + [("incarnation", "int")]
+
+
 def _metrics_history_cols():
     from ..obs.tsring import HISTORY_COLUMNS
-    return list(HISTORY_COLUMNS)
+    return list(HISTORY_COLUMNS) + [("incarnation", "int")]
 
 
 def _metrics_summary_cols():
@@ -62,7 +79,7 @@ def _metrics_summary_cols():
 
 def _inspection_cols():
     from ..obs.inspect import COLUMNS
-    return list(COLUMNS)
+    return list(COLUMNS) + [("incarnation", "int")]
 
 
 def _programs_cols():
@@ -72,7 +89,12 @@ def _programs_cols():
 
 def _conprof_cols():
     from ..obs.conprof import COLUMNS
-    return list(COLUMNS)
+    return list(COLUMNS) + [("incarnation", "int")]
+
+
+def _flight_incarnation_cols():
+    from ..obs.flight import INCARNATION_COLUMNS
+    return list(INCARNATION_COLUMNS)
 
 
 def _memory_usage_cols():
@@ -102,12 +124,13 @@ _TABLES = {
                    ("seq_in_index", "int"),
                    ("column_name", "str")],
     "statements_summary": _summary_cols,
-    "statements_summary_history": _summary_cols,
+    "statements_summary_history": _summary_history_cols,
     "metrics_history": _metrics_history_cols,
     "metrics_summary": _metrics_summary_cols,
     "inspection_result": _inspection_cols,
     "compiled_programs": _programs_cols,
     "continuous_profiling": _conprof_cols,
+    "flight_incarnations": _flight_incarnation_cols,
     "memory_usage": _memory_usage_cols,
     "processlist": [("id", "int"),
                     ("user", "str"),
@@ -154,20 +177,23 @@ def memtable_rows(infoschema, table: str) -> List[list]:
         return stmtsummary.rows()
     if t == "statements_summary_history":
         from ..obs import stmtsummary
-        return stmtsummary.history_rows()
+        return _with_incarnations("summary", stmtsummary.history_rows())
     if t == "processlist":
         return _processlist_rows()
     if t == "slow_query":
         return _slow_query_rows()
     if t == "metrics_history":
         from ..obs import tsring
-        return tsring.history_rows()
+        return _with_incarnations("metrics", tsring.history_rows())
     if t == "metrics_summary":
         from ..obs import tsring
         return tsring.summary_rows()
     if t == "inspection_result":
         from ..obs import inspect as obs_inspect
-        return obs_inspect.rows()
+        return _with_incarnations("findings", obs_inspect.rows())
+    if t == "flight_incarnations":
+        from ..obs import flight
+        return flight.incarnation_rows()
     if t == "compiled_programs":
         # the per-program catalog (ops/progcache.py): dispatch counts,
         # compile walls, measured device time, cost-analysis flops/bytes
@@ -179,7 +205,7 @@ def memtable_rows(infoschema, table: str) -> List[list]:
         # (obs/conprof.py): role, stack, samples, estimated cpu_ms —
         # the SQL face of /debug/conprof
         from ..obs import conprof
-        return conprof.rows()
+        return _with_incarnations("conprof", conprof.rows())
     if t == "memory_usage":
         # the memory reconciliation ledger (obs/memprof.py): tracked vs
         # measured vs HBM census — the SQL face of /debug/heap's truth
@@ -216,6 +242,21 @@ def memtable_rows(infoschema, table: str) -> List[list]:
             for i, (cn, ft) in enumerate(memtable_columns(name)):
                 out.append([DB_NAME, name, cn, i + 1, _type_name(ft),
                             "YES", ""])
+    return out
+
+
+def _with_incarnations(tier: str, live_rows: List[list]) -> List[list]:
+    """Cross-incarnation splice (obs/flight.py): prior runs' replayed
+    rows (ascending incarnation) followed by the live rows, every row
+    tagged with its incarnation id in a trailing column.  Volatile
+    (no flight store armed) degrades to live rows + current id — the
+    column exists either way so queries need no arming awareness."""
+    from ..obs import flight
+    out: List[list] = []
+    for inc, rows in flight.prior_tier_rows(tier):
+        out.extend(r + [inc] for r in rows)
+    cur = flight.current_incarnation()
+    out.extend(r + [cur] for r in live_rows)
     return out
 
 

@@ -112,6 +112,7 @@ ROLE_PREFIXES: Tuple[Tuple[str, str], ...] = (
     ("metrics-sampler", "tsring"),      # obs/tsring.py Sampler
     ("conprof-sampler", "conprof"),     # this module's own sampler
     ("memprof-sampler", "memprof"),     # obs/memprof.py heap sampler
+    ("flight-writer", "flight"),        # obs/flight.py segment writer
     ("auto-prewarm", "prewarm"),        # session/prewarm.py worker
     ("distsql-cop", "distsql"),         # distsql/client.py task pool
     ("status-http", "http"),            # server/http_status.py

@@ -356,6 +356,43 @@ METRICS: Dict[str, Tuple[str, str]] = {
                     "tidb_gc_safepoint trigger"),
     "tinysql_gc_removed_versions_total":
         ("counter", "Stale MVCC versions removed below the safepoint"),
+    # flight recorder (obs/flight.py STATS): durable observability
+    # segments — all-zero means no data dir was armed (volatile
+    # byte-identity: the family never appears)
+    "tinysql_flight_segments_total":
+        ("counter", "Flight-recorder segments appended (crc-framed, "
+                    "zlib-compressed tier snapshots)"),
+    "tinysql_flight_segment_bytes_total":
+        ("counter", "Framed bytes appended to the flight store"),
+    "tinysql_flight_fsyncs_total":
+        ("counter", "Flight-store fsync syscalls (one per segment "
+                    "append)"),
+    "tinysql_flight_final_flushes_total":
+        ("counter", "Final black-box segments force-flushed on a death "
+                    "path (close / atexit)"),
+    "tinysql_flight_compactions_total":
+        ("counter", "Retention-bounded in-file compactions (rewrite "
+                    "keeping the newest N segments)"),
+    "tinysql_flight_torn_truncations_total":
+        ("counter", "Torn segment tails truncated at the last good "
+                    "crc boundary on writer open"),
+    "tinysql_flight_prior_segments_total":
+        ("counter", "Prior-incarnation segments loaded read-only at "
+                    "boot"),
+    "tinysql_flight_errors_total":
+        ("counter", "Flight writer errors (collection or append "
+                    "failures — counted, never fatal)"),
+    "tinysql_flight_self_seconds_total":
+        ("counter", "Wall seconds inside the flight writer's "
+                    "snapshot+append path (the bench overhead gate's "
+                    "evidence)"),
+    # boot identity (obs/flight.py): the join key every flight surface
+    # shares — always emitted, armed or not
+    "tinysql_incarnation":
+        ("gauge", "This process's incarnation id (monotonic across "
+                  "restarts when a data dir is armed)"),
+    "tinysql_server_start_timestamp":
+        ("gauge", "Unix timestamp of this incarnation's boot"),
     # time-series sampler self-accounting (obs/tsring.py)
     "tinysql_metrics_samples_total":
         ("counter", "Time-series ring samples taken"),
@@ -401,6 +438,21 @@ WAL_METRIC_NAMES = (
     ("truncated_tails", "tinysql_recovery_truncated_tails_total"),
     ("gc_runs", "tinysql_gc_runs_total"),
     ("gc_removed", "tinysql_gc_removed_versions_total"),
+)
+
+#: obs/flight.py STATS key -> metric name (ONE map shared by the
+#: /metrics render and the tsring "flight" source).  All counters; the
+#: family only appears once the recorder is armed and moving.
+FLIGHT_METRIC_NAMES = (
+    ("segments", "tinysql_flight_segments_total"),
+    ("segment_bytes", "tinysql_flight_segment_bytes_total"),
+    ("fsyncs", "tinysql_flight_fsyncs_total"),
+    ("final_flushes", "tinysql_flight_final_flushes_total"),
+    ("compactions", "tinysql_flight_compactions_total"),
+    ("torn_truncations", "tinysql_flight_torn_truncations_total"),
+    ("prior_segments_loaded", "tinysql_flight_prior_segments_total"),
+    ("errors", "tinysql_flight_errors_total"),
+    ("self_s", "tinysql_flight_self_seconds_total"),
 )
 
 #: STATS keys that are high-water marks (gauges), not accumulators —
@@ -645,6 +697,29 @@ def render_prometheus() -> str:
         for key, name in WAL_METRIC_NAMES:
             kind = METRICS[name][0]
             emit(name, METRICS[name][1], kind, [((), wl.get(key, 0))])
+    # flight recorder (obs/flight.py STATS): all-zero means no data dir
+    # was armed — emit nothing, same volatile byte-identity discipline
+    # as the WAL family above
+    try:
+        from .flight import stats_snapshot as flight_stats
+        fl = flight_stats()
+    except Exception:
+        fl = {}
+    if any(fl.values()):
+        for key, name in FLIGHT_METRIC_NAMES:
+            emit(name, METRICS[name][1], "counter",
+                 [((), fl.get(key, 0))])
+    # boot identity: incarnation + start timestamp are the join key the
+    # flight surfaces share — emitted armed or not (constant gauges)
+    try:
+        from .flight import current_incarnation, server_start_ts
+        emit("tinysql_incarnation", METRICS["tinysql_incarnation"][1],
+             "gauge", [((), current_incarnation())])
+        emit("tinysql_server_start_timestamp",
+             METRICS["tinysql_server_start_timestamp"][1], "gauge",
+             [((), server_start_ts())])
+    except Exception:
+        pass
 
     # serving-layer counters: admission verdicts (server/admission.py)
     # and cross-query micro-batching (ops/batching.py)

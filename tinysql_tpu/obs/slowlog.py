@@ -12,7 +12,13 @@ joinable against ``information_schema.statements_summary`` and
 Destinations:
 - the ``tinysql_tpu.slowlog`` logger (one JSON line per record);
 - an append-only JSONL file when ``TINYSQL_SLOW_LOG`` names a path
-  (resolved once per env value, not per record);
+  (resolved once per env value, not per record).
+  ``TINYSQL_SLOW_LOG_MAX_BYTES`` caps it: when an append would grow the
+  file past the cap, the current file rotates to ``<path>.1``
+  (tmp→rename, one rotated generation — the reference keeps bounded
+  slow-log files the same way) and the append starts a fresh file.
+  Rotation is file-plumbing only: the in-process ring and the
+  ``slow_query`` mem-table never change behavior;
 - an in-process ring (``recent``) for tests, debug endpoints, and the
   ``slow_query`` mem-table — ``TINYSQL_SLOW_LOG_RING`` sizes it
   (default 64; applied on the next :func:`clear`).
@@ -97,12 +103,43 @@ def build_record(sql: str, info: dict, qobs=None, *, conn_id: int = 0,
     return rec
 
 
+def _max_bytes() -> int:
+    """``TINYSQL_SLOW_LOG_MAX_BYTES`` (0/absent/junk = unbounded)."""
+    try:
+        return max(0, int(os.environ.get("TINYSQL_SLOW_LOG_MAX_BYTES",
+                                         "0")))
+    except ValueError:
+        return 0
+
+
+def _maybe_rotate(path: str, incoming: int) -> None:
+    """Size-capped rotation: if appending ``incoming`` bytes would push
+    the file past the cap, move it aside as ``<path>.1`` (via a tmp
+    name so a crash mid-rotation never leaves ``.1`` half-replaced)."""
+    cap = _max_bytes()
+    if cap <= 0:
+        return
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return
+    if size + incoming <= cap:
+        return
+    tmp = path + ".1.tmp"
+    try:
+        os.replace(path, tmp)
+        os.replace(tmp, path + ".1")
+    except OSError:
+        pass  # rotation is best-effort, like the append itself
+
+
 def log_slow(record: dict) -> None:
     line = json.dumps(record, default=str, sort_keys=True)
     LOGGER.warning("%s", line)
     path = _log_path()
     if path:
         try:
+            _maybe_rotate(path, len(line) + 1)
             with open(path, "a", encoding="utf-8") as f:
                 f.write(line + "\n")
         except OSError:

@@ -538,6 +538,21 @@ def _src_wal() -> Dict[str, float]:
     return {name: s.get(key, 0) for key, name in WAL_METRIC_NAMES}
 
 
+def _src_flight() -> Dict[str, float]:
+    from .flight import stats_snapshot
+    from .metrics import FLIGHT_METRIC_NAMES
+    s = stats_snapshot()
+    if not any(s.values()):
+        return {}  # no data dir armed: zero movement, zero samples
+    return {name: s.get(key, 0) for key, name in FLIGHT_METRIC_NAMES}
+
+
+def _src_identity() -> Dict[str, float]:
+    from .flight import current_incarnation, server_start_ts
+    return {"tinysql_incarnation": float(current_incarnation()),
+            "tinysql_server_start_timestamp": server_start_ts()}
+
+
 def _src_degrade() -> Dict[str, float]:
     from ..ops import degrade
     d = degrade.snapshot()
@@ -612,6 +627,8 @@ for _name, _fn in (("queries", _src_queries), ("kernels", _src_kernels),
                    ("batching", _src_batching), ("memory", _src_memory),
                    ("spill", _src_spill), ("shardops", _src_shardops),
                    ("wal", _src_wal),
+                   ("flight", _src_flight),
+                   ("identity", _src_identity),
                    ("degrade", _src_degrade),
                    ("failpoints", _src_failpoints),
                    ("prewarm", _src_prewarm), ("slo", _src_slo),

@@ -16,10 +16,60 @@ from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 
+#: (path, one-line description) for every registered debug endpoint —
+#: the GET /debug/ index renders this list (ISSUE 20: the surfaces were
+#: discoverable only by reading docs)
+DEBUG_ENDPOINTS = (
+    ("/debug/trace", "last N query traces (JSON; chrome://tracing "
+                     "loadable per entry; ?n=)"),
+    ("/debug/slowlog", "recent structured slow-query records (JSON)"),
+    ("/debug/stmtsummary", "statement summary current window (JSON; "
+                           "?incarnation=N replays a prior run)"),
+    ("/debug/metrics/summary", "windowed per-metric delta/rate/avg/max "
+                               "(JSON)"),
+    ("/debug/inspection", "automated inspection findings (JSON; "
+                          "?window=, ?incarnation=N)"),
+    ("/debug/programs", "compiled-program catalog (JSON)"),
+    ("/debug/conprof", "continuous profiler collapsed stacks "
+                       "(flamegraph text; ?window=, ?incarnation=N)"),
+    ("/debug/heap", "heap profiler collapsed allocation sites "
+                    "(flamegraph text; ?window=)"),
+    ("/debug/prewarm", "auto-prewarm worker snapshot (JSON)"),
+    ("/debug/flight", "flight recorder: arming, stats, incarnation "
+                      "catalogue (JSON)"),
+    ("/debug/threads", "live python stacks, all threads (text)"),
+)
+
+
+def _prior_incarnation(qs) -> Optional[int]:
+    """``?incarnation=N`` → N when N names a PRIOR run; None means
+    serve the live surface (absent, junk, or the current id)."""
+    from ..obs.flight import current_incarnation
+    try:
+        n = int(qs.get("incarnation", [""])[0])
+    except (ValueError, IndexError):
+        return None
+    return n if 0 < n < current_incarnation() else None
+
+
 def _make_handler(server_ref):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
+
+        def _send_prior(self, tier: str, incarnation: int,
+                        columns) -> None:
+            # a prior incarnation's replayed mem-table rows (the live
+            # endpoint's dict/text shape only exists for the live
+            # stores; dead runs serve rows + column names)
+            from ..obs.flight import active_store
+            store = active_store()
+            rows = store.tier_rows(incarnation, tier) \
+                if store is not None else []
+            self._send(200, json.dumps(
+                {"incarnation": incarnation,
+                 "columns": [c[0] for c in columns],
+                 "rows": rows}, default=str).encode())
 
         def _send(self, code: int, body: bytes,
                   ctype: str = "application/json"):
@@ -53,13 +103,22 @@ def _make_handler(server_ref):
                 self._send(200, json.dumps(recent(), default=str).encode())
                 return
             if parsed.path == "/debug/stmtsummary":
-                from ..obs.stmtsummary import snapshot
+                from ..obs.stmtsummary import COLUMNS, snapshot
+                qs = parse_qs(parsed.query)
+                prior = _prior_incarnation(qs)
+                if prior is not None:
+                    self._send_prior("summary", prior, COLUMNS)
+                    return
                 self._send(200, json.dumps(snapshot(),
                                            default=str).encode())
                 return
             if parsed.path == "/debug/inspection":
                 from ..obs import inspect as oinspect
                 qs = parse_qs(parsed.query)
+                prior = _prior_incarnation(qs)
+                if prior is not None:
+                    self._send_prior("findings", prior, oinspect.COLUMNS)
+                    return
                 # absent -> the bounded default window; window=0 -> the
                 # whole retained ring
                 try:
@@ -81,8 +140,12 @@ def _make_handler(server_ref):
                 # collapsed-stack text (flamegraph.pl / speedscope
                 # ingest it directly); ?window=N bounds to the last N
                 # seconds of retained windows (absent/0 = everything)
-                from ..obs.conprof import collapsed
+                from ..obs.conprof import COLUMNS, collapsed
                 qs = parse_qs(parsed.query)
+                prior = _prior_incarnation(qs)
+                if prior is not None:
+                    self._send_prior("conprof", prior, COLUMNS)
+                    return
                 try:
                     window = float(qs.get("window", ["0"])[0]) or None
                 except ValueError:
@@ -109,6 +172,18 @@ def _make_handler(server_ref):
                 from ..ops.progcache import catalog_snapshot
                 self._send(200, json.dumps(catalog_snapshot(),
                                            default=str).encode())
+                return
+            if parsed.path == "/debug/flight":
+                from ..obs.flight import debug_snapshot
+                self._send(200, json.dumps(debug_snapshot(),
+                                           default=str).encode())
+                return
+            if parsed.path in ("/debug", "/debug/"):
+                rows = "".join(
+                    f'<li><a href="{p}">{p}</a> — {desc}</li>'
+                    for p, desc in DEBUG_ENDPOINTS)
+                self._send(200, ("<h1>debug endpoints</h1><ul>"
+                                 f"{rows}</ul>").encode(), "text/html")
                 return
             if parsed.path == "/debug/prewarm":
                 from ..session.prewarm import stats_snapshot
@@ -154,6 +229,8 @@ def _make_handler(server_ref):
                            b'<a href="/debug/inspection">inspection</a> '
                            b'<a href="/debug/metrics/summary">'
                            b'metrics-summary</a> '
+                           b'<a href="/debug/flight">flight</a> '
+                           b'<a href="/debug/">debug-index</a> '
                            b'<a href="/debug/threads">threads</a>',
                            "text/html")
             else:

@@ -435,6 +435,14 @@ class Server:
         # and the heap-growth / mem-untracked inspection rules
         from ..obs.memprof import MemprofSampler
         self.memprof_sampler = MemprofSampler(storage)
+        # durable flight recorder (obs/flight.py): stamps this boot's
+        # incarnation identity and — when the storage has a data dir —
+        # appends crc-framed observability segments every
+        # tidb_flight_interval, loads prior incarnations read-only, and
+        # arms the atexit/faulthandler black-box flush.  Volatile
+        # storage: identity only, zero flight movement.
+        from ..obs.flight import FlightWriter
+        self.flight_writer = FlightWriter(storage)
         self.host = host
         self.port = port
         self.sock: Optional[socket.socket] = None
@@ -464,6 +472,7 @@ class Server:
         self.metrics_sampler.start()
         self.conprof_sampler.start()
         self.memprof_sampler.start()
+        self.flight_writer.start()
         # device-time truth knobs are process-global module state applied
         # at SET time (session/session.py) — a fresh server re-applies
         # whatever GLOBAL scope the storage carries
@@ -577,6 +586,12 @@ class Server:
         self.metrics_sampler.close()
         self.conprof_sampler.close()
         self.memprof_sampler.close()
+        # flight black box: force-flush the final segment (last trace
+        # ring + processlist) AFTER the samplers stop — their windows
+        # are settled — and BEFORE the WAL checkpoint below, so a clean
+        # shutdown marks this incarnation's record final.  Both wire
+        # modes end here (the aio front end closed above).
+        self.flight_writer.close()
         self.domain.close()
         if self.sock is not None:
             try:

@@ -221,6 +221,15 @@ DEFAULT_SYSVARS: Dict[str, Datum] = {
     # max distinct allocation sites per window; beyond it the
     # least-recently-seen site folds into the '(evicted)' tombstone
     "tidb_memprof_max_sites": 256,
+    # ---- flight recorder (obs/flight.py; GLOBAL scope — the server's
+    # background segment writer re-reads both every tick; inert without
+    # a data dir) --------------------------------------------------------
+    # seconds between durable flight segments (0 pauses the writer
+    # without stopping it)
+    "tidb_flight_interval": 10,
+    # retention bound: newest N segments kept per incarnation (in-file
+    # compaction) and newest N incarnation files kept in the flight dir
+    "tidb_flight_retention": 8,
 }
 
 
@@ -1128,7 +1137,9 @@ class Session:
                      "tidb_memprof_rate",
                      "tidb_memprof_window",
                      "tidb_memprof_history",
-                     "tidb_memprof_max_sites")
+                     "tidb_memprof_max_sites",
+                     "tidb_flight_interval",
+                     "tidb_flight_retention")
 
     @staticmethod
     def _validate_uint_sysvar(name: str, v: Datum) -> int:

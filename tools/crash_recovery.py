@@ -224,6 +224,28 @@ def setup_bank(port: int, accounts: int) -> None:
     c.close()
 
 
+def verify_flight(c) -> int:
+    """Flight-recorder consistency on a restarted server: the
+    ``flight_incarnations`` surface must be queryable, show exactly one
+    RUNNING row (this process), and every prior incarnation must carry
+    a settled clean/torn verdict and a lower id.  Prior incarnations
+    that died before their first flight tick are legitimately absent
+    (zero segments — the recorder's documented blind spot), so the row
+    COUNT is not asserted; tools/postmortem.py --smoke covers the
+    fast-interval path where rows must exist.  Returns the number of
+    prior incarnations visible."""
+    rows = c.query("select incarnation, status from "
+                   "information_schema.flight_incarnations")[1]
+    running = [int(r[0]) for r in rows if r[1] == "running"]
+    assert len(running) == 1, f"running incarnations: {rows}"
+    prior = [(int(r[0]), r[1]) for r in rows if r[1] != "running"]
+    for inc, status in prior:
+        assert status in ("clean", "torn"), (inc, status)
+        assert inc < running[0], \
+            f"prior incarnation {inc} >= running {running[0]}"
+    return len(prior)
+
+
 def verify(port: int, accounts: int, book: Book) -> dict:
     """Post-restart consistency audit; raises AssertionError on any
     durability violation."""
@@ -233,6 +255,7 @@ def verify(port: int, accounts: int, book: Book) -> dict:
            for r in c.query("select id, bal from accounts")[1]}
     ledger = {int(r[0]): (int(r[1]), int(r[2]))
               for r in c.query("select id, acct, delta from ledger")[1]}
+    flight_prior = verify_flight(c)
     c.close()
     assert len(bal) == accounts, f"accounts lost: {len(bal)}"
     # 1. every acked commit fully present
@@ -262,7 +285,7 @@ def verify(port: int, accounts: int, book: Book) -> dict:
     assert total == accounts * OPENING, \
         f"total balance {total} != {accounts * OPENING}"
     return {"acked": len(acked), "transfers_applied": len(ops_seen),
-            "total_balance": total}
+            "total_balance": total, "flight_prior": flight_prior}
 
 
 def run_cycle(idx: int, point, data_dir: str, accounts: int,
